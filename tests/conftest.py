@@ -1,0 +1,51 @@
+"""Pytest configuration: simulate an 8-device TPU-like mesh on CPU.
+
+The reference has no unit-test suite at all -- its "tests" are runtime
+verification scripts that need a real cluster (see SURVEY.md section 4,
+/root/reference/tests/README.md). JAX lets us do better: with
+``--xla_force_host_platform_device_count=8`` every sharding recipe
+(DP/FSDP/TP/PP/SP/ring/domain) is unit-testable on a laptop CPU.
+
+Must set env vars before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep CPU compilation deterministic and quiet in CI.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The hosting environment may pre-register an accelerator plugin that
+# overrides JAX_PLATFORMS at interpreter startup (sitecustomize); force
+# the simulated-CPU backend again post-import.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    """1D 8-way data mesh."""
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(axes={"data": 8}))
+
+
+@pytest.fixture(scope="session")
+def mesh_2d(devices):
+    """2D (data=2, model=4) mesh, the hybrid FSDPxTP shape."""
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(axes={"data": 2, "model": 4}))

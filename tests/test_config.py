@@ -1,0 +1,54 @@
+"""Tests for the unified TrainingConfig (parity: utils/config.py)."""
+import pytest
+
+from tpu_hpc.config import TrainingConfig
+
+
+def test_defaults():
+    c = TrainingConfig()
+    assert c.epochs == 5
+    assert c.compute_dtype == "bfloat16"
+
+
+def test_from_args_overrides():
+    c = TrainingConfig.from_args(
+        ["--epochs", "3", "--learning-rate", "0.01", "--model-parallel", "4"]
+    )
+    assert c.epochs == 3
+    assert c.learning_rate == 0.01
+    assert c.model_parallel == 4
+
+
+def test_from_args_tolerates_unknown_flags():
+    c = TrainingConfig.from_args(["--epochs", "2", "--my-extra-flag", "x"])
+    assert c.epochs == 2
+
+
+def test_yaml_roundtrip(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("epochs: 7\nglobal_batch_size: 64\nprofile: true\n")
+    c = TrainingConfig.from_yaml(str(p))
+    assert c.epochs == 7
+    assert c.global_batch_size == 64
+    assert c.profile is True
+
+
+def test_yaml_unknown_key_rejected(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("nonexistent_key: 1\n")
+    with pytest.raises(ValueError, match="unknown config keys"):
+        TrainingConfig.from_yaml(str(p))
+
+
+def test_cli_overrides_yaml(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("epochs: 7\n")
+    c = TrainingConfig.from_args(["--config", str(p), "--epochs", "9"])
+    assert c.epochs == 9
+
+
+def test_mesh_axes():
+    c = TrainingConfig(data_parallel=2, model_parallel=4)
+    assert c.mesh_axes() == {"data": 2, "model": 4}
+    c2 = TrainingConfig(pipe_parallel=4, data_parallel=2)
+    assert list(c2.mesh_axes()) == ["pipe", "data"]

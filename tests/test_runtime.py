@@ -1,0 +1,142 @@
+"""Tests for runtime: mesh construction, launcher detection, topology."""
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec
+
+from tpu_hpc.runtime import (
+    MeshSpec,
+    build_mesh,
+    get_host_info,
+    local_batch_size,
+    named_sharding,
+)
+from tpu_hpc.runtime.topology import device_summary, topology_report
+
+
+class TestMesh:
+    def test_1d(self, devices):
+        m = build_mesh(MeshSpec(axes={"data": 8}))
+        assert m.shape == {"data": 8}
+
+    def test_2d(self, devices):
+        m = build_mesh(MeshSpec(axes={"data": 2, "model": 4}))
+        assert m.shape == {"data": 2, "model": 4}
+        assert m.axis_names == ("data", "model")
+
+    def test_wildcard(self, devices):
+        m = build_mesh(MeshSpec(axes={"data": -1, "model": 2}))
+        assert m.shape == {"data": 4, "model": 2}
+
+    def test_two_wildcards_rejected(self):
+        with pytest.raises(ValueError, match="at most one"):
+            MeshSpec(axes={"a": -1, "b": -1}).resolved_sizes(8)
+
+    def test_too_many_devices(self, devices):
+        with pytest.raises(ValueError, match="needs"):
+            build_mesh(MeshSpec(axes={"data": 16}))
+
+    def test_subset_of_devices(self, devices):
+        m = build_mesh(MeshSpec(axes={"data": 4}), devices=devices[:4])
+        assert m.shape == {"data": 4}
+
+    def test_sharded_array_placement(self, mesh_2d):
+        x = jnp.arange(32.0).reshape(8, 4)
+        s = named_sharding(mesh_2d, "data", "model")
+        xs = jax.device_put(x, s)
+        assert xs.sharding.is_equivalent_to(s, x.ndim)
+        assert len(xs.addressable_shards) == 8
+        assert xs.addressable_shards[0].data.shape == (4, 1)
+
+    def test_local_batch_size(self, mesh8):
+        assert local_batch_size(32, mesh8, "data") == 4
+        with pytest.raises(ValueError, match="not divisible"):
+            local_batch_size(30, mesh8, "data")
+
+
+class TestHostInfo:
+    def _clear(self, monkeypatch):
+        for v in (
+            "JAX_PROCESS_ID",
+            "JAX_NUM_PROCESSES",
+            "JAX_COORDINATOR_ADDRESS",
+            "TPU_WORKER_ID",
+            "TPU_WORKER_HOSTNAMES",
+            "SLURM_PROCID",
+            "SLURM_NTASKS",
+            "OMPI_COMM_WORLD_RANK",
+            "OMPI_COMM_WORLD_SIZE",
+            "PALS_RANKID",
+            "PALS_SIZE",
+            "PMI_RANK",
+            "PMI_SIZE",
+            "MASTER_ADDR",
+            "MASTER_PORT",
+        ):
+            monkeypatch.delenv(v, raising=False)
+
+    def test_single_fallback(self, monkeypatch):
+        self._clear(monkeypatch)
+        info = get_host_info()
+        assert (info.process_id, info.num_processes) == (0, 1)
+        assert info.launcher == "single"
+        assert not info.is_distributed
+
+    def test_explicit(self, monkeypatch):
+        self._clear(monkeypatch)
+        monkeypatch.setenv("JAX_PROCESS_ID", "3")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "8")
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        info = get_host_info()
+        assert (info.process_id, info.num_processes) == (3, 8)
+        assert info.coordinator_address == "10.0.0.1:1234"
+        assert info.launcher == "explicit"
+
+    def test_tpu_pod(self, monkeypatch):
+        self._clear(monkeypatch)
+        monkeypatch.setenv("TPU_WORKER_ID", "2")
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t0,t1,t2,t3")
+        info = get_host_info()
+        assert (info.process_id, info.num_processes) == (2, 4)
+        assert info.coordinator_address.startswith("t0:")
+        assert info.launcher == "tpu_pod"
+
+    def test_openmpi(self, monkeypatch):
+        self._clear(monkeypatch)
+        monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+        monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+        monkeypatch.setenv("MASTER_ADDR", "head")
+        monkeypatch.setenv("MASTER_PORT", "2222")
+        info = get_host_info()
+        assert info.launcher == "openmpi"
+        assert info.coordinator_address == "head:2222"
+
+    def test_cray_pals(self, monkeypatch):
+        self._clear(monkeypatch)
+        monkeypatch.setenv("PALS_RANKID", "5")
+        monkeypatch.setenv("PALS_SIZE", "8")
+        info = get_host_info()
+        assert info.launcher == "cray_pals"
+        assert info.process_id == 5
+
+    def test_priority_explicit_beats_ompi(self, monkeypatch):
+        self._clear(monkeypatch)
+        monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+        monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+        monkeypatch.setenv("JAX_PROCESS_ID", "0")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+        assert get_host_info().launcher == "explicit"
+
+
+class TestTopology:
+    def test_device_summary(self, devices):
+        recs = device_summary()
+        assert len(recs) == 8
+        assert all("device_kind" in r for r in recs)
+
+    def test_topology_report(self, devices):
+        rep = topology_report()
+        assert rep["global_device_count"] == 8
+        assert rep["process_count"] == 1

@@ -1,0 +1,36 @@
+"""tpu_hpc -- a TPU-native distributed training framework.
+
+Capability match for the reference recipe collection
+``negin513/distributed-pytorch-hpc`` (multi-node PyTorch/NCCL on NCAR
+Derecho), re-designed from scratch for TPU: one ``jax.sharding.Mesh`` +
+PartitionSpec mechanism replaces the DDP/FSDP/DTensor/pipelining wrapper
+zoo; XLA collectives over ICI/DCN replace NCCL over NVLink/Slingshot;
+``jax.distributed.initialize`` replaces the mpiexec/torchrun launcher
+detection matrix.
+
+Layer map (mirrors SURVEY.md section 1):
+  runtime/    distributed init, mesh construction, topology introspection
+  comm/       collective primitives + ICI/DCN benchmark suite
+  parallel/   named parallelism recipes: dp, fsdp, tp, pp, sp, ring, domain
+  models/     llama2, unet, vit, pipeline transformer, synthetic datasets
+  train/      trainer loop, throughput metrics, losses
+  ckpt/       orbax checkpointing + snapshot auto-resume
+  config/     unified dataclass + YAML/CLI config
+  profiling/  jax.profiler wrapper with schedule windows
+  logging_/   host-0 logging, per-process output redirect
+  checks/     environment verification
+  kernels/    pallas kernels (flash / ring attention)
+"""
+
+__version__ = "0.1.0"
+
+from tpu_hpc.runtime import (  # noqa: F401
+    HostInfo,
+    MeshSpec,
+    build_mesh,
+    cleanup_distributed,
+    get_host_info,
+    init_distributed,
+    is_main_host,
+    print_host0,
+)

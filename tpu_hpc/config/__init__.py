@@ -1,0 +1,1 @@
+from tpu_hpc.config.config import TrainingConfig  # noqa: F401

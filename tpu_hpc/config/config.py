@@ -1,0 +1,108 @@
+"""Unified training configuration: dataclass + YAML + CLI.
+
+Parity with the reference's ``utils/config.py`` (TrainingConfig
+dataclass :25-51, ``from_yaml`` :56-71, ``from_args`` :73-122), which
+was defined but never adopted by the example scripts (SURVEY.md 2.7).
+Here it IS the single config layer: every example and the Trainer take
+one of these. ``parse_known_args`` tolerance is kept so recipes can add
+their own flags.
+
+TPU-specific deltas from the reference fields:
+  * ``backend`` (nccl/gloo/mpi) is gone -- XLA owns the transport.
+  * ``use_amp``/``amp_dtype`` become ``param_dtype``/``compute_dtype``:
+    on TPU bf16 compute is the default, not an option bolted on.
+  * mesh axis sizes (data/model/seq/pipe) are config, promoting the
+    reference's hard-coded ``tp_size = 4`` constants
+    (scripts/06_hybrid_parallelism/01_fsdp_tp_hybrid.py:73) to flags.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    # Optimization (reference: utils/config.py:27-35).
+    epochs: int = 5
+    global_batch_size: int = 32
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    momentum: float = 0.9
+    seed: int = 42
+    steps_per_epoch: int = 50
+
+    # Precision (reference AMP block: utils/config.py:40-44).
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+
+    # Mesh (replaces hard-coded tp_size constants; SURVEY.md 5.6).
+    data_parallel: int = -1  # -1 = all remaining devices
+    model_parallel: int = 1
+    seq_parallel: int = 1
+    pipe_parallel: int = 1
+
+    # Checkpointing (reference: utils/config.py:45-47).
+    save_every: int = 0  # epochs; 0 = off
+    checkpoint_dir: str = "checkpoints"
+    resume: bool = True
+
+    # Profiling (reference: utils/config.py:48-50).
+    profile: bool = False
+    profile_dir: str = "profiles"
+    profile_start_step: int = 3
+    profile_num_steps: int = 5
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "TrainingConfig":
+        """Load from a YAML mapping; unknown keys rejected.
+        Parity: utils/config.py:56-71."""
+        import yaml
+
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - fields
+        if unknown:
+            raise ValueError(f"unknown config keys in {path}: {sorted(unknown)}")
+        return cls(**raw)
+
+    @classmethod
+    def from_args(
+        cls, argv: Optional[Sequence[str]] = None
+    ) -> "TrainingConfig":
+        """Build from CLI flags; tolerates extra flags via
+        parse_known_args. Parity: utils/config.py:73-122."""
+        p = argparse.ArgumentParser(add_help=False)
+        p.add_argument("--config", type=str, default=None, help="YAML config path")
+        for f in dataclasses.fields(cls):
+            flag = "--" + f.name.replace("_", "-")
+            if f.type == "bool" or isinstance(f.default, bool):
+                p.add_argument(
+                    flag,
+                    type=lambda s: s.lower() in ("1", "true", "yes"),
+                    default=None,
+                )
+            else:
+                p.add_argument(flag, type=type(f.default), default=None)
+        ns, _ = p.parse_known_args(argv)
+        base = cls.from_yaml(ns.config) if ns.config else cls()
+        for f in dataclasses.fields(cls):
+            v = getattr(ns, f.name, None)
+            if v is not None:
+                setattr(base, f.name, v)
+        return base
+
+    def mesh_axes(self) -> "dict[str, int]":
+        """Ordered mesh axes, dropping degenerate (size-1) ones except
+        data. Data first = bandwidth-tolerant axis on the outer ring."""
+        axes: dict[str, int] = {}
+        if self.pipe_parallel > 1:
+            axes["pipe"] = self.pipe_parallel
+        axes["data"] = self.data_parallel
+        if self.seq_parallel > 1:
+            axes["seq"] = self.seq_parallel
+        if self.model_parallel > 1:
+            axes["model"] = self.model_parallel
+        return axes

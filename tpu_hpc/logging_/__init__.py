@@ -1,0 +1,6 @@
+from tpu_hpc.logging_.logging import (  # noqa: F401
+    get_logger,
+    host_log,
+    verify_min_device_count,
+)
+from tpu_hpc.logging_.redirect import redirect_output  # noqa: F401

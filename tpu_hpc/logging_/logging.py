@@ -1,0 +1,35 @@
+"""Host-aware logging. Parity: utils/logging.py (get_logger :26-39,
+rank_log :42-52, verify_min_gpu_count :55-65)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+_configured = False
+
+
+def get_logger(name: str = "tpu_hpc", level: int = logging.INFO) -> logging.Logger:
+    """Process-safe logger; basicConfig applied once (parity with the
+    import-time basicConfig at utils/logging.py:19-23, but lazy)."""
+    global _configured
+    if not _configured:
+        logging.basicConfig(level=level, format=_FORMAT, stream=sys.stdout)
+        _configured = True
+    return logging.getLogger(name)
+
+
+def host_log(msg: str, *args, logger: logging.Logger | None = None) -> None:
+    """Log only from host 0. Parity: rank_log (utils/logging.py:42-52)."""
+    import jax
+
+    if jax.process_index() == 0:
+        (logger or get_logger()).info(msg, *args)
+
+
+def verify_min_device_count(min_devices: int) -> bool:
+    """Guard for recipes needing N chips. Parity: verify_min_gpu_count
+    (utils/logging.py:55-65)."""
+    import jax
+
+    return jax.device_count() >= min_devices

@@ -1,0 +1,15 @@
+from tpu_hpc.runtime.distributed import (  # noqa: F401
+    HostInfo,
+    cleanup_distributed,
+    get_host_info,
+    init_distributed,
+    is_main_host,
+    print_host0,
+)
+from tpu_hpc.runtime.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    local_batch_size,
+    named_sharding,
+)
+from tpu_hpc.runtime.topology import device_summary, topology_report  # noqa: F401

@@ -1,0 +1,130 @@
+"""Device-mesh construction: the single mechanism behind every strategy.
+
+The reference builds 1D and 2D ``init_device_mesh`` meshes and slices
+sub-meshes (scripts/03_tensor_parallel_tp/01_device_mesh_basics.py:29-73,
+scripts/06_hybrid_parallelism/01_fsdp_tp_hybrid.py:88). Here the mesh is
+not one strategy's plumbing -- it *is* the parallelism engine: DP shards
+the batch over an axis, FSDP shards params over it, TP shards weights
+over another, SP shards the sequence dim, PP/ring use ``shard_map`` over
+an axis. ``MeshSpec`` names the axes once; every recipe in
+``tpu_hpc.parallel`` is a PartitionSpec plan over these names.
+
+On real TPU hardware ``jax.make_mesh`` lays axes onto the ICI torus so
+that the innermost (most communication-hungry) axes ride the
+fastest links -- the TPU analogue of the reference's "TP intra-node on
+NVLink, FSDP across nodes on Slingshot" doctrine
+(fsdp_tp/fsdp_tp_example.py:12-26).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis names used by the recipes. Order matters: earlier axes
+# change slowest across the device list, so put the bandwidth-tolerant
+# axis (data/fsdp, the reference's cross-node axis) first and the
+# latency-sensitive axis (model/tensor, the reference's NVLink axis) last.
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape: ordered ``{axis_name: size}``.
+
+    A size of -1 means "all remaining devices" (at most one axis may use
+    it). Examples::
+
+        MeshSpec(axes={"data": -1})                    # pure DP / FSDP
+        MeshSpec(axes={"data": 2, "model": 4})         # hybrid FSDPxTP
+        MeshSpec(axes={"data": 2, "seq": 4})           # ring attention
+        MeshSpec(axes={"pipe": 4, "data": 2})          # PP x DP
+    """
+
+    axes: Mapping[str, int]
+
+    def resolved_sizes(self, n_devices: int) -> "dict[str, int]":
+        sizes = dict(self.axes)
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        return sizes
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.axes.keys())
+
+
+def build_mesh(
+    spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` from a spec.
+
+    Uses ``jax.make_mesh`` on real hardware (ICI-topology-aware axis
+    assignment); falls back to a plain reshape over the device list when
+    given an explicit device subset (tests, sub-meshes).
+    """
+    use_default = devices is None
+    if use_default:
+        devices = jax.devices()
+    sizes = spec.resolved_sizes(len(devices))
+    total = math.prod(sizes.values())
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices, only {len(devices)} available"
+        )
+    if use_default and total != len(devices):
+        # A whole-job mesh that leaves chips idle is almost always a
+        # misconfiguration (half-throughput job with no error); demand an
+        # explicit device subset when that is truly intended.
+        raise ValueError(
+            f"mesh {sizes} uses {total} of {len(devices)} devices; pass an "
+            f"explicit devices= subset or add a -1 wildcard axis"
+        )
+    shape = tuple(sizes.values())
+    names = tuple(sizes.keys())
+    if use_default:
+        # ICI-topology-aware layout: jax.make_mesh assigns axes onto the
+        # physical torus so inner axes get the fastest links.
+        return jax.make_mesh(shape, names)
+    arr = np.asarray(devices[:total]).reshape(shape)
+    return Mesh(arr, names)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """Shorthand: ``named_sharding(mesh, 'data', None)``."""
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def local_batch_size(global_batch: int, mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    """Per-data-shard batch size, validating divisibility.
+
+    Parity with the reference's DistributedSampler contract: the global
+    batch divides evenly over the data axis
+    (scripts/01_data_parallel_ddp/multinode_ddp_unet.py:283-292).
+    """
+    n = mesh.shape[axis]
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {axis}={n}")
+    return global_batch // n
+
+
+def mesh_axis_size(mesh: Mesh, axis: Optional[str]) -> int:
+    if axis is None:
+        return 1
+    return mesh.shape[axis]
