@@ -1,0 +1,73 @@
+"""Headline benchmark: prints ONE JSON line for the driver.
+
+Current flagship metric (round 1): SimpleUNet DP training throughput
+(samples/s) on the available chip(s) -- the reference's own
+instrumented workload (multinode_ddp_unet.py:348-397). Will move to
+Llama-2 tokens/sec/chip + MFU once the hybrid recipe lands.
+
+vs_baseline: the reference publishes no measured throughput
+(BASELINE.md), so vs_baseline is reported as 1.0 by convention when no
+comparable number exists.
+"""
+import json
+import sys
+
+
+def main() -> int:
+    import jax
+
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.models import datasets, losses
+    from tpu_hpc.models.unet import UNetConfig, apply_unet, init_unet
+    from tpu_hpc.parallel import dp
+    from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+    from tpu_hpc.train import Trainer
+
+    import jax.numpy as jnp
+
+    init_distributed(verbose=False)
+    # epochs=2: epoch 0 absorbs compilation, epoch 1 is the measurement
+    # (same reason the reference skips the first batch in its
+    # throughput accounting, multinode_ddp_unet.py:363).
+    cfg = TrainingConfig(
+        epochs=2,
+        steps_per_epoch=20,
+        global_batch_size=8 * jax.device_count(),
+        learning_rate=1e-3,
+    )
+    mesh = build_mesh(MeshSpec(axes={"data": -1}))
+    ds = datasets.ERA5Synthetic()
+    model_cfg = UNetConfig(
+        in_channels=ds.channels, out_channels=ds.channels,
+        dtype=jnp.bfloat16,
+    )
+    params, model_state = init_unet(
+        jax.random.key(0), model_cfg, ds.sample_shape
+    )
+
+    def forward(p, ms, batch, step_rng):
+        x, y = batch
+        pred, new_ms = apply_unet(p, ms, x, model_cfg, train=True)
+        return losses.lat_weighted_mse(pred, y), new_ms, {}
+
+    trainer = Trainer(
+        cfg, mesh, forward, params, model_state,
+        param_pspecs=dp.param_pspecs(params),
+    )
+    result = trainer.fit(ds)
+    summary = result["epochs"][-1]
+    print(
+        json.dumps(
+            {
+                "metric": "unet_dp_train_throughput",
+                "value": round(summary["items_per_s"], 2),
+                "unit": "samples/s",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""FSDP (ZeRO-3) U-Net training: parameters sharded over the data axis.
+
+Parity with /root/reference/scripts/02_fully_sharded_fsdp/
+multinode_fsdp_unet.py (FSDP FULL_SHARD + size-based auto-wrap + BF16
+mixed precision + gathered checkpoint). TPU-native: the wrap policy
+becomes a size-based shard plan (min 1e5 params, like the reference's
+min_num_params); XLA inserts the per-use all-gather and gradient
+reduce-scatter that FSDP units did by hand.
+
+Run: python train_unet_fsdp.py --epochs 3 [--save-every 1]
+"""
+import sys
+
+import jax
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.models import datasets, losses
+from tpu_hpc.models.unet import UNetConfig, apply_unet, init_unet
+from tpu_hpc.parallel import fsdp
+from tpu_hpc.parallel.plans import describe_pspecs
+from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+from tpu_hpc.train import Trainer
+
+
+def main(argv=None) -> int:
+    cfg = TrainingConfig.from_args(argv)
+    logger = get_logger()
+    init_distributed()
+    mesh = build_mesh(MeshSpec(axes={"data": cfg.data_parallel}))
+
+    ds = datasets.ERA5Synthetic()
+    model_cfg = UNetConfig(in_channels=ds.channels, out_channels=ds.channels)
+    params, model_state = init_unet(
+        jax.random.key(cfg.seed), model_cfg, ds.sample_shape
+    )
+    pspecs = fsdp.param_pspecs(params, axis_size=mesh.shape["data"])
+    if jax.process_index() == 0:
+        logger.info("FSDP shard plan (first 8 entries):")
+        for line in describe_pspecs(params, pspecs)[:8]:
+            logger.info("  %s", line)
+
+    def forward(p, ms, batch, step_rng):
+        x, y = batch
+        pred, new_ms = apply_unet(p, ms, x, model_cfg, train=True)
+        return losses.lat_weighted_mse(pred, y), new_ms, {}
+
+    ckpt_mgr = None
+    if cfg.save_every:
+        from tpu_hpc.ckpt import CheckpointManager
+
+        ckpt_mgr = CheckpointManager(cfg.checkpoint_dir)
+
+    trainer = Trainer(
+        cfg, mesh, forward, params, model_state,
+        param_pspecs=pspecs,
+        batch_pspec=fsdp.batch_pspec(),
+        checkpoint_manager=ckpt_mgr,
+    )
+    result = trainer.fit(ds)
+    if ckpt_mgr is not None:
+        ckpt_mgr.wait()
+    if not result["epochs"]:
+        logger.info("nothing to do: checkpoint already at %d epochs", cfg.epochs)
+        return 0
+    summary = result["epochs"][-1]
+    logger.info(
+        "run summary | final loss %.5f | %.1f samples/s global | "
+        "%.1f samples/s/device",
+        result["final_loss"], summary["items_per_s"],
+        summary["items_per_s_per_device"],
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

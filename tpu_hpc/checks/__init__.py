@@ -1,0 +1,1 @@
+from tpu_hpc.checks.env_check import check_environment, main  # noqa: F401

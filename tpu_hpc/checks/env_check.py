@@ -1,0 +1,167 @@
+"""Pre-flight environment verification.
+
+Parity with /root/reference/tests/check_environment.py (distributed
+env check: host->device map :240-244, library discovery :31-58, env
+dump :263-301, collective smoke test, pass/fail summary :349-373) and
+tests/test_env.py (single-process version-and-smoke check).
+
+TPU translation: NCCL version -> libtpu/jax versions; rank->node map ->
+process->chip map with ICI coords; Slingshot NIC check -> ICI
+coordinate/torus sanity; NCCL env dump -> XLA/TPU env var dump; NCCL
+all-reduce smoke test -> psum over all devices with exact-value check.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_hpc.runtime.topology import topology_report
+
+# Env vars that shape XLA/TPU behavior -- the dump parity of the
+# reference's 25-var NCCL env block (check_environment.py:263-301).
+_ENV_VARS = (
+    "JAX_PLATFORMS",
+    "XLA_FLAGS",
+    "LIBTPU_INIT_ARGS",
+    "TPU_WORKER_ID",
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_CHIPS_PER_HOST_BOUNDS",
+    "TPU_HOST_BOUNDS",
+    "JAX_PROCESS_ID",
+    "JAX_NUM_PROCESSES",
+    "JAX_COORDINATOR_ADDRESS",
+    "JAX_ENABLE_X64",
+    "JAX_DISABLE_JIT",
+)
+
+
+def _library_versions() -> Dict[str, str]:
+    """Version discovery (parity: NCCL version+path, :31-73)."""
+    out = {"python": sys.version.split()[0], "jax": jax.__version__}
+    try:
+        import jaxlib
+
+        out["jaxlib"] = jaxlib.__version__
+    except Exception:
+        pass
+    try:
+        from jax._src.lib import xla_extension_version
+
+        out["xla_extension"] = str(xla_extension_version)
+    except Exception:
+        pass
+    try:
+        import libtpu  # type: ignore
+
+        out["libtpu"] = getattr(libtpu, "__version__", "present")
+    except Exception:
+        out["libtpu"] = "not importable (ok off-TPU)"
+    return out
+
+
+def _smoke_all_reduce() -> Tuple[bool, str]:
+    """All-device psum smoke test with exact expected value.
+
+    Parity with test_env.py:54-79 (world-size-1 NCCL all-reduce) and
+    the device-mesh sanity assert result == sum(range(world_size))
+    (scripts/03_tensor_parallel_tp/01_device_mesh_basics.py:82-87).
+    """
+    try:
+        n = jax.device_count()
+        mesh = jax.make_mesh((n,), ("d",))
+        x = jax.device_put(
+            jnp.arange(n, dtype=jnp.float32),
+            jax.NamedSharding(mesh, jax.P("d")),
+        )
+        total = jax.jit(
+            jax.shard_map(
+                lambda v: jax.lax.psum(v, "d"), mesh=mesh,
+                in_specs=jax.P("d"), out_specs=jax.P(),
+            )
+        )(x)
+        expected = float(sum(range(n)))
+        got = float(np.asarray(total)[0])
+        ok = got == expected
+        return ok, f"psum over {n} devices: got {got}, expected {expected}"
+    except Exception as e:  # pragma: no cover
+        return False, f"all-reduce smoke test raised: {e!r}"
+
+
+def check_environment(verbose: bool = True) -> Dict:
+    """Run all checks; return a report dict with a pass/fail summary
+    (parity: check_environment.py:349-373)."""
+    report = {
+        "versions": _library_versions(),
+        "topology": topology_report(),
+        "env": {k: os.environ.get(k) for k in _ENV_VARS if os.environ.get(k)},
+    }
+    checks: List[Tuple[str, bool, str]] = []
+
+    n_local = jax.local_device_count()
+    checks.append(
+        ("devices_visible", n_local > 0, f"{n_local} local device(s)")
+    )
+    ok, msg = _smoke_all_reduce()
+    checks.append(("all_reduce_smoke", ok, msg))
+
+    backend = jax.default_backend()
+    checks.append(
+        ("accelerator_backend", True, f"backend={backend}"
+         + ("" if backend == "tpu" else " (not TPU -- ok for CPU sim)"))
+    )
+    if backend == "tpu":
+        coords = [getattr(d, "coords", None) for d in jax.local_devices()]
+        checks.append(
+            ("ici_coords", all(c is not None for c in coords),
+             f"chip coords: {coords}")
+        )
+
+    report["checks"] = [
+        {"name": n, "passed": p, "detail": d} for n, p, d in checks
+    ]
+    report["all_passed"] = all(p for _, p, _ in checks)
+
+    if verbose and jax.process_index() == 0:
+        print("=" * 64)
+        print("tpu_hpc environment check")
+        print("=" * 64)
+        for k, v in report["versions"].items():
+            print(f"  {k:>16}: {v}")
+        topo = report["topology"]
+        print(f"  {'backend':>16}: {topo['backend']}")
+        print(
+            f"  {'devices':>16}: {topo['global_device_count']} global / "
+            f"{topo['local_device_count']} local, "
+            f"{topo['process_count']} process(es)"
+        )
+        for d in topo["devices"]:
+            print(f"    device {d['id']}: {d['device_kind']}"
+                  + (f" coords={d['coords']}" if "coords" in d else ""))
+        if report["env"]:
+            print("  relevant env:")
+            for k, v in report["env"].items():
+                print(f"    {k}={v}")
+        print("-" * 64)
+        for c in report["checks"]:
+            mark = "PASS" if c["passed"] else "FAIL"
+            print(f"  [{mark}] {c['name']}: {c['detail']}")
+        print("=" * 64)
+        print("ALL CHECKS PASSED" if report["all_passed"] else "FAILURES PRESENT")
+    return report
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from tpu_hpc.runtime import init_distributed
+
+    init_distributed()
+    report = check_environment(verbose=True)
+    return 0 if report["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+from tpu_hpc.ckpt.checkpoint import CheckpointManager  # noqa: F401

@@ -1,0 +1,9 @@
+from tpu_hpc.comm.primitives import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    broadcast,
+    reduce_scatter,
+    ring_shift,
+)
+from tpu_hpc.comm.bench import CommBenchmark, run_comm_bench  # noqa: F401

@@ -1,0 +1,206 @@
+"""Collective micro-benchmark over ICI/DCN: the ``torch_comm_bench`` port.
+
+Parity with /root/reference/tests/torch_comm_bench.py:
+  * broadcast + all-reduce (plus TPU extras: all-gather, reduce-scatter,
+    ring send/recv) across element counts 10^3..10^8  (:196-240)
+  * N warmup + M timed iterations, barrier-bracketed   (:40-90)
+  * ring bus-bandwidth accounting 2(n-1)/n * size / t  (:92-116)
+  * CSV output with a full environment-metadata header (:137-194)
+  * CLI flags for sizes/warmup/bench/output             (:253-267)
+
+The "barrier" on TPU is ``block_until_ready`` on the input (ensures
+async dispatch has drained) before starting the clock, and on the
+output before stopping it -- the same wall-clock bracketing as the
+reference's ``dist.barrier(); t0; op; synchronize; barrier; t1``.
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import io
+import socket
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_hpc.comm import primitives
+
+DEFAULT_SIZES = tuple(10**k for k in range(3, 9))  # torch_comm_bench.py:174
+OPS = ("broadcast", "all_reduce", "all_gather", "reduce_scatter", "ring_shift")
+
+
+def bus_bandwidth_gb_s(op: str, bytes_per_shard: int, n: int, t: float) -> float:
+    """Ring bus-bandwidth model, matching torch_comm_bench.py:92-116.
+
+    broadcast: size/t. all-reduce: 2(n-1)/n * size/t. all-gather and
+    reduce-scatter move (n-1)/n * size: the standard NCCL-tests busbw
+    factors, applied unchanged to ICI.
+    """
+    if t <= 0:
+        return float("inf")
+    factor = {
+        "broadcast": 1.0,
+        "all_reduce": 2.0 * (n - 1) / n,
+        "all_gather": (n - 1) / n,
+        "reduce_scatter": (n - 1) / n,
+        "ring_shift": 1.0,
+        "all_to_all": (n - 1) / n,
+    }[op]
+    return factor * bytes_per_shard / t / 1e9
+
+
+@dataclasses.dataclass
+class CommBenchmark:
+    """Configurable collective benchmark over one mesh axis."""
+
+    mesh: Mesh
+    axis: str = "data"
+    sizes: Sequence[int] = DEFAULT_SIZES
+    warmup: int = 5  # torch_comm_bench default :255
+    iters: int = 20  # :256
+    ops: Sequence[str] = OPS
+    dtype: str = "float32"
+
+    def _input_for(self, op: str, n_elements: int):
+        """Build the benchmark payload. ``n_elements`` is the per-shard
+        element count (matching the reference, where every rank holds
+        `size` elements)."""
+        n = self.mesh.shape[self.axis]
+        dt = jnp.dtype(self.dtype)
+        if op in ("broadcast", "all_reduce", "all_gather", "ring_shift"):
+            # globally [n*size], sharded over axis: each device holds `size`.
+            x = jnp.arange(n * n_elements, dtype=dt)
+            return jax.device_put(x, NamedSharding(self.mesh, P(self.axis)))
+        elif op == "reduce_scatter":
+            # replicated [n*size] input; output sharded.
+            x = jnp.arange(n * n_elements, dtype=dt)
+            return jax.device_put(x, NamedSharding(self.mesh, P()))
+        raise ValueError(op)
+
+    def run(self) -> List[Dict]:
+        n = self.mesh.shape[self.axis]
+        records = []
+        for op in self.ops:
+            fn = getattr(primitives, op)(self.mesh, self.axis)
+            for size in self.sizes:
+                x = self._input_for(op, size)
+                x.block_until_ready()
+                for _ in range(self.warmup):
+                    fn(x).block_until_ready()
+                times = []
+                for _ in range(self.iters):
+                    x.block_until_ready()  # barrier (ref :44-46)
+                    t0 = time.perf_counter()
+                    out = fn(x)
+                    out.block_until_ready()  # synchronize (ref :52-56)
+                    times.append(time.perf_counter() - t0)
+                times = np.asarray(times)
+                nbytes = size * jnp.dtype(self.dtype).itemsize
+                rec = {
+                    "op": op,
+                    "size_elements": size,
+                    "bytes_per_shard": nbytes,
+                    "world_size": n,
+                    "mean_s": float(times.mean()),
+                    "std_s": float(times.std()),
+                    "min_s": float(times.min()),
+                    "max_s": float(times.max()),
+                    "busbw_GB_s": bus_bandwidth_gb_s(
+                        op, nbytes, n, float(times.mean())
+                    ),
+                }
+                records.append(rec)
+        return records
+
+
+def _env_metadata(mesh: Mesh) -> Dict[str, str]:
+    """CSV metadata header block, parity with torch_comm_bench.py:153-194
+    (host, versions, backend, world size -> TPU equivalents)."""
+    d = jax.devices()[0]
+    return {
+        "hostname": socket.gethostname(),
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": d.device_kind,
+        "process_count": str(jax.process_count()),
+        "global_devices": str(jax.device_count()),
+        "mesh": str(dict(mesh.shape)),
+        "timestamp": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+
+
+def write_csv(records: List[Dict], mesh: Mesh, path: Optional[str]) -> str:
+    """Write benchmark CSV (metadata as comment lines, then rows).
+    Returns the CSV text. Rank-0-only output is implicit: call from
+    host 0 (jax arrays are process-global)."""
+    buf = io.StringIO()
+    for k, v in _env_metadata(mesh).items():
+        buf.write(f"# {k}: {v}\n")
+    if records:
+        w = csv.DictWriter(buf, fieldnames=list(records[0].keys()))
+        w.writeheader()
+        w.writerows(records)
+    text = buf.getvalue()
+    if path and jax.process_index() == 0:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def run_comm_bench(
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+    sizes: Sequence[int] = DEFAULT_SIZES,
+    warmup: int = 5,
+    iters: int = 20,
+    ops: Sequence[str] = OPS,
+    output: Optional[str] = None,
+) -> List[Dict]:
+    """One-call benchmark entry (the ``init_processes`` analogue,
+    torch_comm_bench.py:144-251)."""
+    if mesh is None:
+        from tpu_hpc.runtime import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(axes={axis: -1}))
+    bench = CommBenchmark(
+        mesh=mesh, axis=axis, sizes=sizes, warmup=warmup, iters=iters, ops=ops
+    )
+    records = bench.run()
+    text = write_csv(records, mesh, output)
+    if jax.process_index() == 0 and output is None:
+        print(text)
+    return records
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="XLA collective benchmark over ICI")
+    p.add_argument("--sizes", type=int, nargs="+", default=list(DEFAULT_SIZES))
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--ops", nargs="+", default=list(OPS), choices=OPS)
+    p.add_argument("--output", type=str, default=None)
+    p.add_argument("--axis-size", type=int, default=-1)
+    args = p.parse_args(argv)
+
+    from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+
+    init_distributed()
+    mesh = build_mesh(MeshSpec(axes={"data": args.axis_size}))
+    run_comm_bench(
+        mesh,
+        sizes=args.sizes,
+        warmup=args.warmup,
+        iters=args.iters,
+        ops=args.ops,
+        output=args.output,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Named collective primitives over a mesh axis.
+
+The TPU-native catalogue matching the reference's NCCL primitive set --
+all-reduce, all-gather, reduce-scatter, broadcast, send/recv, all-to-all
+(docs/guide/03_communication_primitives.md:161-270). Each helper jits a
+``shard_map`` program over one mesh axis, so the same function works on
+a real ICI slice or a CPU-simulated mesh.
+
+These exist for three reasons: (1) the comm benchmark suite
+(``tpu_hpc.comm.bench``) times exactly these programs; (2) explicit
+recipes (ring attention, pipeline, halo) build on the in-shard_map
+``jax.lax`` forms; (3) parity so a reference user finds every primitive
+by name. Inside ordinary ``jit`` + sharding code you rarely call these
+-- XLA inserts collectives for you (SURVEY.md 5.8).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _one_axis_program(
+    mesh: Mesh, axis: str, body: Callable, in_spec, out_spec
+):
+    """jit a shard_map program over a single mesh axis."""
+    # check_vma=False: collectives like all_gather leave their output
+    # marked device-varying even though it is value-replicated; these are
+    # single-op programs where the out_spec is the ground truth.
+    f = jax.shard_map(
+        body, mesh=mesh, in_specs=in_spec, out_specs=out_spec, check_vma=False
+    )
+    return jax.jit(f)
+
+
+def all_reduce(mesh: Mesh, axis: str):
+    """Sum across ``axis``; every shard gets the total (NCCL allreduce).
+
+    Input: per-device array of shape [n, ...] stacked on ``axis``
+    (global shape [n*size, ...]); output: same global shape, every
+    shard holding the reduced values (replicated along ``axis``).
+    """
+    def body(x):
+        return jax.lax.psum(x, axis)
+
+    return _one_axis_program(mesh, axis, body, P(axis), P())
+
+
+def all_gather(mesh: Mesh, axis: str):
+    """Concatenate shards along dim 0 on every device (NCCL allgather)."""
+    def body(x):
+        return jax.lax.all_gather(x, axis, tiled=True)
+
+    return _one_axis_program(mesh, axis, body, P(axis), P())
+
+
+def reduce_scatter(mesh: Mesh, axis: str):
+    """Sum across ``axis`` then scatter dim-0 shards (NCCL reducescatter).
+
+    Input: global [m, ...] replicated along ``axis``; output: [m, ...]
+    sharded along ``axis`` with each shard holding its summed slice.
+    """
+    def body(x):
+        return jax.lax.psum_scatter(x, axis, tiled=True)
+
+    return _one_axis_program(mesh, axis, body, P(), P(axis))
+
+
+def broadcast(mesh: Mesh, axis: str, root: int = 0):
+    """Every shard receives root's shard (NCCL broadcast).
+
+    Implemented as a masked psum: zero all non-root shards, sum. On a
+    ring this lowers to the same bandwidth class as NCCL's tree/ring
+    broadcast and stays a single fused XLA collective.
+    """
+    def body(x):
+        idx = jax.lax.axis_index(axis)
+        contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+        return jax.lax.psum(contrib, axis)
+
+    return _one_axis_program(mesh, axis, body, P(axis), P())
+
+
+def ring_shift(mesh: Mesh, axis: str, shift: int = 1):
+    """Neighbor exchange around the ``axis`` ring (the send/recv analogue;
+    reference P2P test: tests/send_recv_test.py). Shard i's data moves to
+    shard (i+shift) mod n via a single ``ppermute`` riding ICI neighbor
+    links."""
+    n = mesh.shape[axis]
+    perm = [(i, (i + shift) % n) for i in range(n)]
+
+    def body(x):
+        return jax.lax.ppermute(x, axis, perm)
+
+    return _one_axis_program(mesh, axis, body, P(axis), P(axis))
+
+
+def all_to_all(mesh: Mesh, axis: str):
+    """Transpose shard dim 0 <-> dim 1 blocks across ``axis`` (NCCL
+    alltoall; the Ulysses building block, SURVEY.md 5.7).
+
+    Input globally sharded [n*a, n*b] on dim 0; output sharded on dim 1.
+    """
+    def body(x):  # local [a, n*b]
+        n = jax.lax.axis_size(axis)
+        a = x.shape[0]
+        xs = x.reshape(a, n, x.shape[1] // n)
+        ys = jax.lax.all_to_all(xs, axis, split_axis=1, concat_axis=0)
+        return ys.reshape(n * a, x.shape[1] // n)
+
+    return _one_axis_program(mesh, axis, body, P(axis), P(None, axis))

@@ -1,0 +1,2 @@
+from tpu_hpc.models import datasets, losses  # noqa: F401
+from tpu_hpc.models.unet import SimpleUNet, UNetConfig  # noqa: F401

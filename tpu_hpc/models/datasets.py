@@ -1,0 +1,154 @@
+"""Synthetic datasets: reproducible workloads without data files.
+
+Parity with the reference's fixture strategy (SURVEY.md section 4):
+  * ERA5-like weather grids   (multinode_ddp_unet.py:145-164; ViT
+    variant tensor_parallel_vit.py:56-75): channels = vars x levels,
+    [lat, lon] spatial grid, input->target regression pairs.
+  * toy regression pairs      (multinode_ddp_basic.py:89-105,
+    distributed_dataloader.py:143-156)
+  * random token streams      (03_pipeline_training.py:220-230)
+
+TPU-first design: a dataset here is an *index-stateless generator* --
+``batch_at(step) -> pytree`` built from a fold-in of seed and step, not
+a stateful iterator. That makes input identical across hosts (each host
+slices its own shard), resumable from any step (checkpoint stores only
+the step counter), and trivially prefetchable. NHWC layout (TPU conv
+native), channels-last -- the reference's NCHW is a CUDA-ism.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_gen(gen_fn, seed: int, batch_size: int, *static):
+    """Cache one jitted generator per (dataset, batch) config so each
+    ``batch_at`` call is a single cached dispatch, not a chain of eager
+    ops (which costs real wall-clock on remote/async transports)."""
+    return jax.jit(functools.partial(gen_fn, seed, batch_size, *static))
+
+
+@dataclasses.dataclass(frozen=True)
+class ERA5Synthetic:
+    """ERA5-like synthetic weather grids.
+
+    Parity: ERA5Dataset (multinode_ddp_unet.py:145-164) -- channels =
+    n_vars x n_levels, default 181x360 global 1-degree grid (odd lat
+    dimension kept deliberately: it exercises the UNet's odd-grid
+    upsampling path, reference :203-213).
+    """
+
+    n_samples: int = 1024
+    n_vars: int = 5
+    n_levels: int = 4
+    lat: int = 181
+    lon: int = 360
+    seed: int = 0
+
+    @property
+    def channels(self) -> int:
+        return self.n_vars * self.n_levels
+
+    @property
+    def sample_shape(self) -> Tuple[int, int, int]:
+        return (self.lat, self.lon, self.channels)  # NHWC
+
+    @staticmethod
+    def _gen(seed, batch_size, lat, lon, channels, step):
+        rng = jax.random.fold_in(jax.random.key(seed), step)
+        ri, rt = jax.random.split(rng)
+        shape = (batch_size, lat, lon, channels)
+        x = jax.random.normal(ri, shape, dtype=jnp.float32)
+        # target = smooth function of input + noise: learnable signal,
+        # same spirit as the reference's random regression pairs.
+        y = 0.5 * x + 0.1 * jax.random.normal(rt, shape, dtype=jnp.float32)
+        return x, y
+
+    def batch_at(self, step: int, batch_size: int) -> Tuple[jax.Array, jax.Array]:
+        """Deterministic (input, target) batch for a global step."""
+        return _jitted_gen(
+            ERA5Synthetic._gen, self.seed, batch_size,
+            self.lat, self.lon, self.channels,
+        )(step)
+
+    def traced_batch(self, step, batch_size: int):
+        """Traceable generator (step may be a tracer) -- lets the Trainer
+        scan whole epochs on-device with zero host->device transfers."""
+        return ERA5Synthetic._gen(
+            self.seed, batch_size, self.lat, self.lon, self.channels, step
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ToyRegression:
+    """20-feature -> 1-target pairs. Parity: MyTrainDataset
+    (multinode_ddp_basic.py:89-105)."""
+
+    n_samples: int = 2048
+    in_features: int = 20
+    out_features: int = 1
+    seed: int = 0
+
+    @staticmethod
+    def _gen(seed, batch_size, in_f, out_f, step):
+        rng = jax.random.fold_in(jax.random.key(seed), step)
+        ri, rt = jax.random.split(rng)
+        x = jax.random.normal(ri, (batch_size, in_f))
+        y = jax.random.normal(rt, (batch_size, out_f))
+        return x, y
+
+    def batch_at(self, step: int, batch_size: int) -> Tuple[jax.Array, jax.Array]:
+        return _jitted_gen(
+            ToyRegression._gen, self.seed, batch_size,
+            self.in_features, self.out_features,
+        )(step)
+
+    def traced_batch(self, step, batch_size: int):
+        return ToyRegression._gen(
+            self.seed, batch_size, self.in_features, self.out_features, step
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenStream:
+    """Random token batches for LLM/PP training. Parity:
+    03_pipeline_training.py:220-230 (inputs + shifted targets)."""
+
+    vocab_size: int = 32000
+    seq_len: int = 2048
+    seed: int = 0
+
+    @staticmethod
+    def _gen(seed, batch_size, seq_len, vocab, step):
+        rng = jax.random.fold_in(jax.random.key(seed), step)
+        tokens = jax.random.randint(
+            rng, (batch_size, seq_len + 1), 0, vocab, dtype=jnp.int32
+        )
+        return tokens[:, :-1], tokens[:, 1:]
+
+    def batch_at(self, step: int, batch_size: int) -> Tuple[jax.Array, jax.Array]:
+        return _jitted_gen(
+            TokenStream._gen, self.seed, batch_size,
+            self.seq_len, self.vocab_size,
+        )(step)
+
+    def traced_batch(self, step, batch_size: int):
+        return TokenStream._gen(
+            self.seed, batch_size, self.seq_len, self.vocab_size, step
+        )
+
+
+def shard_batch(batch, mesh, axis: str = "data"):
+    """Place a host-global batch onto the mesh, batch dim sharded over
+    ``axis`` -- the DistributedSampler equivalent: each data shard sees
+    a distinct slice (multinode_ddp_unet.py:283-292)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = NamedSharding(mesh, PartitionSpec(axis))
+    return jax.tree.map(lambda a: jax.device_put(a, spec), batch)

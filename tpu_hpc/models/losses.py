@@ -1,0 +1,41 @@
+"""Loss functions, including the domain-specific latitude-weighted MSE.
+
+Parity: lat-weighted MSE appears four times in the reference
+(multinode_ddp_unet.py:221-229 and copies -- SURVEY.md 2.3); softmax
+cross-entropy is the LLM/PP loss (03_pipeline_training.py loss_fn).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def latitude_weights(n_lat: int, dtype=jnp.float32) -> jax.Array:
+    """cos(lat) weights normalized to mean 1, for a grid of n_lat rows
+    from -90..90 degrees. Parity: multinode_ddp_unet.py:221-226."""
+    lats = jnp.linspace(-90.0, 90.0, n_lat, dtype=dtype)
+    w = jnp.cos(jnp.deg2rad(lats))
+    return w / w.mean()
+
+
+def lat_weighted_mse(pred: jax.Array, target: jax.Array) -> jax.Array:
+    """Latitude-weighted MSE over NHWC grids (lat = dim 1).
+    Parity: multinode_ddp_unet.py:221-229 (NCHW there, NHWC here)."""
+    w = latitude_weights(pred.shape[1], pred.dtype)
+    se = (pred - target) ** 2
+    return jnp.mean(se * w[None, :, None, None])
+
+
+def mse(pred: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.mean((pred - target) ** 2)
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy over integer targets ([..., V] vs
+    [...]). Computed in float32 regardless of logit dtype (bf16-safe)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,6 @@
+from tpu_hpc.parallel.plans import (  # noqa: F401
+    apply_rules,
+    pspec_tree,
+    shardings_for,
+)
+from tpu_hpc.parallel import dp, fsdp  # noqa: F401

@@ -1,0 +1,27 @@
+"""Data parallelism (the DDP recipe).
+
+Parity: scripts/01_data_parallel_ddp (DDP(model, device_ids=[...]) +
+DistributedSampler). TPU-native version: parameters replicated across
+the ``data`` mesh axis, batch sharded on it. Under ``jit`` XLA emits
+exactly DDP's communication pattern -- a single fused gradient
+all-reduce (psum) over the data axis during backward -- without a
+wrapper object or gradient-bucket machinery: the gradient reduction
+falls out of differentiating the batch-sharded loss mean.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from tpu_hpc.parallel.plans import pspec_tree
+
+
+def param_pspecs(params, axis: str = "data"):
+    """All parameters replicated (DDP keeps a full copy per device)."""
+    del axis
+    return pspec_tree(params, rules=[], default=P())
+
+
+def batch_pspec(axis: str = "data") -> P:
+    """Batch dim sharded over the data axis: the DistributedSampler
+    equivalent (multinode_ddp_unet.py:283-292)."""
+    return P(axis)

@@ -1,0 +1,75 @@
+"""Fully-sharded data parallelism (ZeRO-3) as a sharding plan.
+
+Parity: scripts/02_fully_sharded_fsdp -- FSDP1 `size_based_auto_wrap_policy
+(min_num_params=1e5)` + FULL_SHARD (resnet_fsdp_training.py:193-212).
+
+TPU-native: parameters are sharded over the ``data`` axis along one
+dimension; XLA's SPMD partitioner inserts the all-gather before use and
+reduce-scatter on gradients -- the FSDP unit all-gather/reduce-scatter
+dance (SURVEY call stack 3.1) for free, fused into the step. The
+size-based wrap policy becomes a size-based *shard* policy: tensors
+smaller than ``min_size`` params stay replicated (same motivation --
+tiny tensors aren't worth the comm).
+
+Sharding-strategy matrix parity (docs/guide/05_fully_sharded_fsdp.md:114-156):
+  FULL_SHARD    -> shard_params=True  (this module)
+  SHARD_GRAD_OP -> GSPMD equivalent: keep params replicated, shard
+                   optimizer state; see ``grad_op_pspecs``
+  NO_SHARD      -> dp.param_pspecs (plain DDP)
+  HYBRID_SHARD  -> shard over an inner axis of a 2D data mesh; pass
+                   axis=("replica","fsdp") meshes and shard on "fsdp".
+"""
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import jax
+
+
+def _choose_dim(shape, divisor: int) -> int | None:
+    """Pick the largest dim divisible by the axis size (prefer dim 0 on
+    ties: embedding/vocab-style dims shard best)."""
+    best, best_size = None, -1
+    for i, s in enumerate(shape):
+        if s % divisor == 0 and s > best_size:
+            best, best_size = i, s
+    return best
+
+
+def param_pspecs(params, axis: str = "data", axis_size: int | None = None,
+                 min_size: int = 100_000):
+    """Shard each large-enough tensor along its largest divisible dim.
+
+    ``min_size`` mirrors the reference's min_num_params=1e5 wrap policy
+    (resnet_fsdp_training.py:196).
+    """
+    if axis_size is None:
+        axis_size = jax.device_count()
+
+    def rule(leaf):
+        shape = tuple(leaf.shape)
+        if int(np.prod(shape)) < min_size:
+            return P()
+        dim = _choose_dim(shape, axis_size)
+        if dim is None:
+            return P()
+        spec = [None] * len(shape)
+        spec[dim] = axis
+        return P(*spec)
+
+    return jax.tree.map(rule, params)
+
+
+def grad_op_pspecs(params, axis: str = "data", axis_size: int | None = None,
+                   min_size: int = 100_000):
+    """SHARD_GRAD_OP analogue: params replicated for compute, optimizer
+    state sharded. Returns ``(param_specs, opt_param_specs)`` -- pass
+    them as ``Trainer(param_pspecs=..., opt_param_pspecs=...)``."""
+    replicated = jax.tree.map(lambda _: P(), params)
+    sharded = param_pspecs(params, axis, axis_size, min_size)
+    return replicated, sharded
+
+
+def batch_pspec(axis: str = "data") -> P:
+    return P(axis)

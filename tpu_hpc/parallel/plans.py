@@ -1,0 +1,112 @@
+"""Partition-spec plans: the TPU replacement for wrapper-based parallelism.
+
+The reference expresses each strategy as a different *wrapper object*
+(DDP(model), FSDP(model), parallelize_module(model, plan)). Here every
+strategy is a *plan*: a list of ``(path_regex, PartitionSpec)`` rules
+mapped over the parameter pytree. Same mechanism for DP (everything
+replicated), FSDP (shard a dim over the data axis), TP (Megatron
+col/row rules), and hybrids (rules compose: TP rules first, FSDP fills
+the rest) -- SURVEY.md section 7 "Design stance".
+
+Paths are '/'-joined pytree key paths, e.g. ``enc1/Conv_0/kernel`` for
+flax params or ``blocks/wq`` for manual param dicts.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, List, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rule = Tuple[str, P]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def apply_rules(rules: Sequence[Rule], path: str, default: P = P()) -> P:
+    """First matching rule wins (re.search semantics)."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return default
+
+
+def pspec_tree(params: Any, rules: Sequence[Rule], default: P = P()) -> Any:
+    """Map a rule list over a parameter pytree -> PartitionSpec pytree."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: apply_rules(rules, _path_str(path), default), params
+    )
+
+
+def shardings_for(mesh: Mesh, specs: Any) -> Any:
+    """PartitionSpec pytree -> NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def derived_pspecs(derived_abstract: Any, params: Any, param_specs: Any) -> Any:
+    """Partition specs for a params-derived pytree (optimizer state).
+
+    Optimizer states embed param-shaped subtrees (Adam's mu/nu, SGD's
+    trace) whose key paths end with the originating param's path. Each
+    derived leaf gets the matching param's spec (path suffix + shape
+    equality); everything else (step counters, scalars) is replicated.
+    The reference never faced this: torch optimizers hold per-rank
+    state implicitly; under explicit sharding it must be planned.
+    """
+    by_path = {}
+
+    def record(path, leaf, spec):
+        by_path[_path_str(path)] = (tuple(leaf.shape), spec)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(
+        lambda path, leaf, spec: record(path, leaf, spec), params, param_specs
+    )
+
+    def rule(path, leaf):
+        pstr = _path_str(path)
+        shape = tuple(leaf.shape)
+        for ppath, (pshape, spec) in by_path.items():
+            # Component-aligned suffix match: plain endswith would let
+            # 'w' claim 'dw' or 'proj/kernel' claim 'out_proj/kernel'.
+            if (pstr == ppath or pstr.endswith("/" + ppath)) and shape == pshape:
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, derived_abstract)
+
+
+def describe_plan(params: Any, rules: Sequence[Rule], default: P = P()) -> List[str]:
+    """Human-readable rule-plan dump (path -> spec), for logging --
+    the moral equivalent of printing the reference's TP plan dict
+    (scripts/06_hybrid_parallelism/01_fsdp_tp_hybrid.py:126-152)."""
+    return describe_pspecs(params, pspec_tree(params, rules, default))
+
+
+def describe_pspecs(params: Any, specs: Any) -> List[str]:
+    """Human-readable dump of an already-built PartitionSpec tree."""
+    lines = []
+
+    def visit(path, leaf, spec):
+        lines.append(f"{_path_str(path)}: {spec} {tuple(leaf.shape)}")
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params, specs)
+    return lines

@@ -100,8 +100,12 @@ def build_mesh(
     names = tuple(sizes.keys())
     if use_default:
         # ICI-topology-aware layout: jax.make_mesh assigns axes onto the
-        # physical torus so inner axes get the fastest links.
-        return jax.make_mesh(shape, names)
+        # physical torus so inner axes get the fastest links. Auto axis
+        # types: the framework relies on GSPMD sharding propagation, not
+        # the newer explicit sharding-in-types mode.
+        return jax.make_mesh(
+            shape, names, axis_types=(jax.sharding.AxisType.Auto,) * len(names)
+        )
     arr = np.asarray(devices[:total]).reshape(shape)
     return Mesh(arr, names)
 

@@ -1,0 +1,2 @@
+from tpu_hpc.train.metrics import ThroughputMeter, mfu  # noqa: F401
+from tpu_hpc.train.trainer import Trainer, TrainState  # noqa: F401

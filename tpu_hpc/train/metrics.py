@@ -1,0 +1,88 @@
+"""Throughput accounting, matching the reference's in-loop metrics.
+
+Parity: per-batch + per-epoch global/per-device samples-per-second
+(multinode_ddp_unet.py:334-397), tokens/s + bubble fraction for PP
+(03_pipeline_training.py:280-294), plus MFU accounting (the v4-32
+north-star metric, BASELINE.md) which the reference lacks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ThroughputMeter:
+    """Wall-clock throughput over batches and epochs.
+
+    The reference brackets each batch with cuda.synchronize and
+    multiplies by WORLD_SIZE (multinode_ddp_unet.py:334-361); here the
+    caller brackets with block_until_ready and items are *global*
+    already (jax arrays are process-global), so no world-size fixup.
+    """
+
+    n_devices: int = 1
+    batch_times: List[float] = dataclasses.field(default_factory=list)
+    batch_items: List[int] = dataclasses.field(default_factory=list)
+    _t0: Optional[float] = None
+
+    def start_batch(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end_batch(self, items: int) -> float:
+        assert self._t0 is not None, "start_batch not called"
+        dt = time.perf_counter() - self._t0
+        self.batch_times.append(dt)
+        self.batch_items.append(items)
+        self._t0 = None
+        return dt
+
+    @property
+    def last_throughput(self) -> float:
+        """Global items/s for the most recent batch (:351)."""
+        return self.batch_items[-1] / self.batch_times[-1]
+
+    def epoch_summary(self, skip_first: int = 1) -> Dict[str, float]:
+        """Aggregate items/s over the epoch, skipping warmup batches
+        (first batch carries compile time). Parity :363-398."""
+        times = self.batch_times[skip_first:] or self.batch_times
+        items = self.batch_items[skip_first:] or self.batch_items
+        total_t = sum(times)
+        total_i = sum(items)
+        thpt = total_i / total_t if total_t else 0.0
+        return {
+            "items_per_s": thpt,
+            "items_per_s_per_device": thpt / self.n_devices,
+            "mean_batch_s": total_t / max(len(times), 1),
+            "total_s": total_t,
+            "batches": len(times),
+        }
+
+    def reset(self) -> None:
+        self.batch_times.clear()
+        self.batch_items.clear()
+
+
+def mfu(
+    tokens_per_s: float,
+    n_params: int,
+    n_devices: int,
+    peak_flops_per_device: float,
+    attn_flops_per_token: float = 0.0,
+) -> float:
+    """Model FLOPs utilization: achieved / peak.
+
+    Uses the standard 6N FLOPs/token estimate for dense transformers
+    (fwd 2N + bwd 4N) plus optional explicit attention FLOPs. This is
+    the >=40% target metric on the 7B hybrid (BASELINE.md).
+    """
+    flops_per_token = 6.0 * n_params + attn_flops_per_token
+    achieved = tokens_per_s * flops_per_token
+    return achieved / (peak_flops_per_device * n_devices)
+
+
+def pipeline_bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """(stages-1)/microbatches -- parity: 03_pipeline_training.py:292,
+    docs/guide/07_pipeline_parallel.md:127-143."""
+    return (n_stages - 1) / n_microbatches
