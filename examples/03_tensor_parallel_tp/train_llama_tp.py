@@ -1,0 +1,71 @@
+"""Tensor-parallel Llama training: the Megatron col/row plan on TPU.
+
+Parity with /root/reference/scripts/03_tensor_parallel_tp/ and
+fsdp_tp/tensor_parallel_example.py: 1D ``model`` mesh, Colwise
+wq/wk/wv/w1/w3, Rowwise wo/w2 -- one all-reduce per attention/FFN
+block. Here the plan is a PartitionSpec rule list (parallel/tp.py) and
+XLA inserts the collectives; on hardware they ride ICI.
+
+Run (single host, all chips as TP): python train_llama_tp.py \
+    --model-parallel 4 --data-parallel 1
+"""
+import sys
+
+import jax
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.models import datasets, llama2
+from tpu_hpc.parallel import tp
+from tpu_hpc.parallel.plans import describe_pspecs
+from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+from tpu_hpc.train import Trainer
+
+
+def main(argv=None) -> int:
+    cfg = TrainingConfig.from_args(argv)
+    logger = get_logger()
+    init_distributed()  # before any device query (multi-host contract)
+    if cfg.model_parallel == 1:
+        cfg.model_parallel = jax.device_count()
+        cfg.data_parallel = 1
+    mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
+    logger.info("mesh: %s", dict(mesh.shape))
+
+    model_cfg = llama2.LlamaConfig(
+        dim=256, n_layers=2, n_heads=8, vocab_size=4096,
+        multiple_of=64, max_seq_len=512,
+    )
+    tp.validate_tp_degree(
+        model_cfg.n_heads, model_cfg.kv_heads, cfg.model_parallel
+    )
+    params = llama2.init_llama(jax.random.key(cfg.seed), model_cfg)
+    specs = tp.param_pspecs(params, tp.llama_rules())
+    for line in describe_pspecs(params, specs)[:8]:
+        logger.info("plan: %s", line)
+
+    ds = datasets.TokenStream(
+        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
+    )
+    trainer = Trainer(
+        cfg,
+        mesh,
+        llama2.make_forward(model_cfg),
+        params,
+        param_pspecs=specs,
+    )
+    result = trainer.fit(ds)
+    summary = result["epochs"][-1]
+    tokens_per_s = summary["items_per_s"] * model_cfg.max_seq_len
+    logger.info(
+        "run summary | final loss %.5f | %.0f tokens/s global | "
+        "%.0f tokens/s/device",
+        result["final_loss"],
+        tokens_per_s,
+        tokens_per_s / mesh.size,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

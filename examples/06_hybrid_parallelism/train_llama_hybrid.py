@@ -1,0 +1,73 @@
+"""Hybrid FSDP x TP (+Megatron-SP) Llama training on a 2D mesh.
+
+Parity with /root/reference/scripts/06_hybrid_parallelism/
+01_fsdp_tp_hybrid.py and fsdp_tp/fsdp_tp_example.py: 2D (data, model)
+mesh, Megatron TP plan per block + SequenceParallel activation
+layouts, then ZeRO-3 over the data axis. The north-star workload
+(SURVEY.md section 3.2) -- on hardware, TP collectives ride the inner
+ICI axis, FSDP all-gather/reduce-scatter the outer.
+
+Run: python train_llama_hybrid.py --data-parallel 2 --model-parallel 4
+"""
+import sys
+
+import jax
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.models import datasets, llama2
+from tpu_hpc.parallel import hybrid, tp
+from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+from tpu_hpc.train import Trainer
+
+
+def main(argv=None) -> int:
+    cfg = TrainingConfig.from_args(argv)
+    logger = get_logger()
+    init_distributed()  # before any device query (multi-host contract)
+    if cfg.model_parallel == 1:
+        cfg.model_parallel = min(4, jax.device_count())
+    mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
+    dp_size = mesh.shape["data"]
+    logger.info("mesh: %s (TP inner/ICI-minor, FSDP outer)", dict(mesh.shape))
+
+    model_cfg = llama2.LlamaConfig(
+        dim=256, n_layers=2, n_heads=8, vocab_size=4096,
+        multiple_of=64, max_seq_len=512,
+    )
+    tp.validate_tp_degree(
+        model_cfg.n_heads, model_cfg.kv_heads, cfg.model_parallel
+    )
+    params = llama2.init_llama(jax.random.key(cfg.seed), model_cfg)
+    specs = hybrid.hybrid_pspecs(
+        params, tp.llama_rules(), data_size=dp_size
+    )
+    constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
+
+    ds = datasets.TokenStream(
+        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
+    )
+    trainer = Trainer(
+        cfg,
+        mesh,
+        llama2.make_forward(model_cfg, constrain),
+        params,
+        param_pspecs=specs,
+    )
+    result = trainer.fit(ds)
+    summary = result["epochs"][-1]
+    tokens_per_s = summary["items_per_s"] * model_cfg.max_seq_len
+    flops = model_cfg.flops_per_token(ds.seq_len) * tokens_per_s
+    logger.info(
+        "run summary | final loss %.5f | %.0f tokens/s global | "
+        "%.0f tokens/s/device | model TFLOP/s %.2f",
+        result["final_loss"],
+        tokens_per_s,
+        tokens_per_s / mesh.size,
+        flops / 1e12,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,206 @@
+"""Llama-2 model + TP/hybrid plan tests.
+
+What the reference could never unit-test (no cluster-free mode,
+SURVEY.md section 4) we verify on the 8-device CPU mesh: model
+correctness (shapes, causality, GQA), TP-sharded forward equals
+replicated forward numerically, and the hybrid 2D recipe trains.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_hpc.models import datasets, llama2
+from tpu_hpc.parallel import hybrid, tp
+from tpu_hpc.parallel.plans import pspec_tree, shardings_for
+
+
+TINY = llama2.LlamaConfig(
+    dim=64,
+    n_layers=2,
+    n_heads=4,
+    vocab_size=256,
+    multiple_of=32,
+    max_seq_len=64,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama2.init_llama(jax.random.key(0), TINY)
+
+
+def test_forward_shape(tiny_params):
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = llama2.apply_llama(tiny_params, tokens, TINY)
+    assert logits.shape == (2, 16, TINY.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality(tiny_params):
+    """Logits at position t must not depend on tokens after t."""
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, TINY.vocab_size, (1, 16)).astype(np.int32)
+    b = a.copy()
+    b[0, 10:] = rng.integers(0, TINY.vocab_size, 6)
+    la = llama2.apply_llama(tiny_params, jnp.asarray(a), TINY)
+    lb = llama2.apply_llama(tiny_params, jnp.asarray(b), TINY)
+    np.testing.assert_allclose(la[0, :10], lb[0, :10], atol=1e-5)
+    assert not np.allclose(la[0, 10:], lb[0, 10:], atol=1e-5)
+
+
+def test_gqa_matches_mha_head_count():
+    """GQA param shapes: kv projections carry kv_heads * head_dim."""
+    cfg = llama2.LlamaConfig(
+        dim=64, n_layers=1, n_heads=8, n_kv_heads=2, vocab_size=64,
+        multiple_of=16, dtype=jnp.float32,
+    )
+    params = llama2.init_llama(jax.random.key(0), cfg)
+    att = params["layers_0"]["attention"]
+    assert att["wq"]["kernel"].shape == (64, 64)
+    assert att["wk"]["kernel"].shape == (64, 2 * cfg.head_dim)
+    logits = llama2.apply_llama(
+        params, jnp.zeros((1, 8), jnp.int32), cfg
+    )
+    assert logits.shape == (1, 8, 64)
+
+
+def test_ffn_hidden_rule():
+    """2/3 rule + multiple_of rounding parity (reference :231-272)."""
+    cfg = llama2.LlamaConfig(dim=4096, multiple_of=256)
+    # int(2*16384/3) = 10922 -> rounded up to 11008 (Llama-2 7B value)
+    assert cfg.ffn_hidden == 11008
+
+
+def test_rope_rotation_is_norm_preserving():
+    cos, sin = llama2.rope_cos_sin(16, 8)
+    x = jax.random.normal(jax.random.key(1), (2, 16, 4, 8))
+    r = llama2.apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        jnp.linalg.norm(x, axis=-1), jnp.linalg.norm(r, axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is unrotated
+    np.testing.assert_allclose(r[:, 0], x[:, 0], atol=1e-6)
+
+
+def test_tp_rules_cover_llama(tiny_params):
+    """Every matmul-bearing param gets a model-axis shard."""
+    specs = tp.param_pspecs(tiny_params, tp.llama_rules())
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    }
+    assert flat["tok_embeddings/embedding"] == P("model", None)
+    assert flat["layers_0/attention/wq/kernel"] == P(None, "model")
+    assert flat["layers_0/attention/wo/kernel"] == P("model", None)
+    assert flat["layers_0/feed_forward/w1/kernel"] == P(None, "model")
+    assert flat["layers_0/feed_forward/w2/kernel"] == P("model", None)
+    assert flat["output/kernel"] == P(None, "model")
+    assert flat["norm/scale"] == P()
+
+
+def test_tp_forward_matches_replicated(mesh_2d, tiny_params):
+    """TP-sharded forward == replicated forward (the correctness bar
+    the reference asserts by inspection, 01_device_mesh_basics.py:82-87
+    -- here it is a numeric equality test)."""
+    tokens = jax.random.randint(jax.random.key(2), (4, 16), 0, 256)
+    expected = llama2.apply_llama(tiny_params, tokens, TINY)
+
+    specs = tp.param_pspecs(tiny_params, tp.llama_rules())
+    sharded = jax.jit(
+        lambda t: t, out_shardings=shardings_for(mesh_2d, specs)
+    )(tiny_params)
+
+    fn = jax.jit(lambda p, t: llama2.apply_llama(p, t, TINY))
+    got = fn(sharded, tokens)
+    np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+
+def test_tp_sp_forward_matches_replicated(mesh_2d, tiny_params):
+    """Megatron-SP activation constraint preserves numerics."""
+    tokens = jax.random.randint(jax.random.key(3), (4, 16), 0, 256)
+    expected = llama2.apply_llama(tiny_params, tokens, TINY)
+
+    specs = tp.param_pspecs(tiny_params, tp.llama_rules())
+    sharded = jax.jit(
+        lambda t: t, out_shardings=shardings_for(mesh_2d, specs)
+    )(tiny_params)
+    constrain = tp.sp_constrain(mesh_2d, dp_axis="data", sp_axis="model")
+    fn = jax.jit(
+        lambda p, t: llama2.apply_llama(p, t, TINY, constrain=constrain)
+    )
+    got = fn(sharded, tokens)
+    np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+
+def test_hybrid_pspecs_compose(tiny_params):
+    """FSDP extends the TP plan on remaining dims, honoring min_size."""
+    specs = hybrid.hybrid_pspecs(
+        tiny_params, tp.llama_rules(), data_size=2, min_size=1000
+    )
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    }
+    # wq kernel (64, 64): model on dim 1 from TP, data fills dim 0.
+    assert flat["layers_0/attention/wq/kernel"] == P("data", "model")
+    # embedding (256, 64): model on dim 0, data fills dim 1.
+    assert flat["tok_embeddings/embedding"] == P("model", "data")
+    # tiny norm scales stay replicated.
+    assert flat["norm/scale"] == P()
+
+
+def test_hybrid_training_step(mesh_2d, tiny_params):
+    """Full hybrid FSDPxTP+SP training steps on the 2D mesh (parity:
+    fsdp_tp_example.py train loop :203-211). Targets are random tokens
+    so loss sits near ln(vocab); we verify the step executes under the
+    2D plan, loss is sane, and params actually move."""
+    import numpy as np
+
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.train import Trainer
+
+    cfg = TrainingConfig(
+        epochs=1, steps_per_epoch=4, global_batch_size=4,
+        learning_rate=3e-3, weight_decay=0.01,
+    )
+    ds = datasets.TokenStream(vocab_size=TINY.vocab_size, seq_len=16)
+    constrain = tp.sp_constrain(mesh_2d)
+    trainer = Trainer(
+        cfg,
+        mesh_2d,
+        llama2.make_forward(TINY, constrain),
+        tiny_params,
+        param_pspecs=hybrid.hybrid_pspecs(
+            tiny_params, tp.llama_rules(), data_size=2, min_size=1000
+        ),
+        batch_pspec=P("data"),
+    )
+    w_before = np.asarray(
+        jax.device_get(trainer.state.params["output"]["kernel"])
+    )
+    for i in range(3):
+        metrics = trainer.train_step(ds.batch_at(i, 4))
+        loss = float(metrics["loss"])
+        assert np.isfinite(loss)
+        # random targets: loss stays near ln(vocab)
+        assert abs(loss - np.log(TINY.vocab_size)) < 1.0
+    w_after = np.asarray(
+        jax.device_get(trainer.state.params["output"]["kernel"])
+    )
+    assert not np.allclose(w_before, w_after)
+
+
+def test_validate_tp_degree():
+    tp.validate_tp_degree(8, 8, 4)
+    with pytest.raises(ValueError):
+        tp.validate_tp_degree(6, 6, 4)
+    with pytest.raises(ValueError):
+        tp.validate_tp_degree(8, 2, 4)

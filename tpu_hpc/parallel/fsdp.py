@@ -27,11 +27,14 @@ from jax.sharding import PartitionSpec as P
 import jax
 
 
-def _choose_dim(shape, divisor: int) -> int | None:
+def _choose_dim(shape, divisor: int, exclude: tuple = ()) -> int | None:
     """Pick the largest dim divisible by the axis size (prefer dim 0 on
-    ties: embedding/vocab-style dims shard best)."""
+    ties: embedding/vocab-style dims shard best). ``exclude`` skips
+    dims already claimed by another axis (hybrid composition)."""
     best, best_size = None, -1
     for i, s in enumerate(shape):
+        if i in exclude:
+            continue
         if s % divisor == 0 and s > best_size:
             best, best_size = i, s
     return best

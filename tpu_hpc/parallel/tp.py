@@ -1,0 +1,113 @@
+"""Tensor parallelism: the Megatron column/row plan as PartitionSpecs.
+
+Parity: scripts/03_tensor_parallel_tp (Colwise->Rowwise MLP pairing,
+02_basic_tensor_parallel.py:64-71; ViT plan tensor_parallel_vit.py:
+352-361) and the Llama block plan in scripts/06_hybrid_parallelism/
+01_fsdp_tp_hybrid.py:110-152: wq/wk/wv/w1/w3 Colwise, wo/w2 Rowwise,
+tok_embeddings Rowwise, output Colwise, norms SequenceParallel.
+
+TPU-native: "Colwise" = shard the kernel's output-features dim on the
+``model`` mesh axis; "Rowwise" = shard the input-features dim. XLA's
+SPMD partitioner then places exactly one all-reduce (or
+reduce-scatter under SP) per attention/FFN block -- the same comm
+pattern DTensor produces, but fused into the jitted step and free to
+overlap with compute. Megatron-SP is an *activation* layout (sequence
+dim sharded on ``model`` between blocks), expressed here as a
+with_sharding_constraint hook threaded through the model
+(models/llama2.py ``constrain``) instead of DTensor Shard(1) plans.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_hpc.parallel.plans import Rule, pspec_tree
+
+
+def llama_rules(axis: str = "model") -> List[Rule]:
+    """Megatron TP plan for the Llama param tree (parity:
+    01_fsdp_tp_hybrid.py:110-152, expressed as path-regex rules)."""
+    return [
+        # Rowwise embedding: vocab dim sharded; each shard owns a vocab
+        # slice, XLA masks+psums the gather (reference tok_embeddings
+        # Rowwise, :113-117).
+        (r"tok_embeddings/embedding$", P(axis, None)),
+        # Colwise attention inputs: heads shard across TP.
+        (r"attention/w[qkv]/kernel$", P(None, axis)),
+        # Rowwise attention output: input-features sharded, psum after.
+        (r"attention/wo/kernel$", P(axis, None)),
+        # SwiGLU: w1/w3 Colwise, w2 Rowwise (reference :144-150).
+        (r"feed_forward/w[13]/kernel$", P(None, axis)),
+        (r"feed_forward/w2/kernel$", P(axis, None)),
+        # LM head Colwise (reference output plan :118-122).
+        (r"^output/kernel$", P(None, axis)),
+        # Norm scales replicated (SP shards their *activations*).
+        (r"norm/scale$", P()),
+    ]
+
+
+def mlp_rules(axis: str = "model") -> List[Rule]:
+    """Generic Colwise->Rowwise pairing for a 2-layer MLP stack:
+    odd layers shard outputs, even layers shard inputs (parity:
+    02_basic_tensor_parallel.py:64-71)."""
+    return [
+        (r"(up|fc1|in)/kernel$", P(None, axis)),
+        (r"(down|fc2|out)/kernel$", P(axis, None)),
+    ]
+
+
+def vit_rules(axis: str = "model") -> List[Rule]:
+    """ViT block plan (parity: tensor_parallel_vit.py:352-361): q/k/v +
+    fc1 Colwise, out_proj + fc2 Rowwise, patch embed + norms
+    replicated."""
+    return [
+        (r"(q|k|v)_proj/kernel$", P(None, axis)),
+        (r"out_proj/kernel$", P(axis, None)),
+        (r"fc1/kernel$", P(None, axis)),
+        (r"fc2/kernel$", P(axis, None)),
+    ]
+
+
+def param_pspecs(params: Any, rules: Sequence[Rule]) -> Any:
+    """Rule list -> full PartitionSpec tree (unmatched leaves
+    replicated)."""
+    return pspec_tree(params, rules, default=P())
+
+
+def sp_constrain(
+    mesh: Mesh,
+    dp_axis: Optional[str] = "data",
+    sp_axis: str = "model",
+) -> Callable[[jax.Array], jax.Array]:
+    """Megatron-SP activation hook: pin [B, S, D] residual-stream
+    activations to (dp, sp, None) -- sequence dim sharded on the TP
+    axis between blocks. XLA turns the TP all-reduces into
+    reduce-scatter + all-gather pairs around each block, cutting
+    activation memory by the TP degree (parity: SequenceParallel norms
+    + Shard(1) layouts, 01_fsdp_tp_hybrid.py:126-152).
+    """
+    spec = NamedSharding(mesh, P(dp_axis, sp_axis, None))
+
+    def constrain(x: jax.Array) -> jax.Array:
+        if x.ndim == 3:
+            return jax.lax.with_sharding_constraint(x, spec)
+        return x
+
+    return constrain
+
+
+def validate_tp_degree(
+    n_heads: int, kv_heads: int, tp: int
+) -> None:
+    """Head-divisibility guard (parity: the reference's head-sharding
+    constraint, tensor_parallel_vit.py:107-123 and the TP-degree rule
+    docs/guide/06_tensor_parallel.md:79-101)."""
+    if n_heads % tp != 0:
+        raise ValueError(f"n_heads={n_heads} not divisible by tp={tp}")
+    if kv_heads % tp != 0:
+        raise ValueError(
+            f"n_kv_heads={kv_heads} not divisible by tp={tp}; "
+            "GQA requires kv_heads % tp == 0"
+        )
