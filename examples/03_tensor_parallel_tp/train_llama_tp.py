@@ -26,23 +26,32 @@ def main(argv=None) -> int:
     cfg = TrainingConfig.from_args(argv)
     logger = get_logger()
     init_distributed()  # before any device query (multi-host contract)
-    if cfg.model_parallel == 1:
-        cfg.model_parallel = jax.device_count()
-        cfg.data_parallel = 1
-    mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
-    logger.info("mesh: %s", dict(mesh.shape))
-
     model_cfg = llama2.LlamaConfig(
         dim=256, n_layers=2, n_heads=8, vocab_size=4096,
         multiple_of=64, max_seq_len=512,
     )
+    if cfg.model_parallel == 1:
+        # Auto: widest TP the devices + head counts allow (1 = pure DP).
+        cfg.model_parallel = tp.auto_tp_degree(
+            jax.device_count(), model_cfg.n_heads, model_cfg.kv_heads
+        )
+        cfg.data_parallel = jax.device_count() // cfg.model_parallel
+    mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
+    logger.info("mesh: %s", dict(mesh.shape))
+
     tp.validate_tp_degree(
         model_cfg.n_heads, model_cfg.kv_heads, cfg.model_parallel
     )
     params = llama2.init_llama(jax.random.key(cfg.seed), model_cfg)
-    specs = tp.param_pspecs(params, tp.llama_rules())
-    for line in describe_pspecs(params, specs)[:8]:
-        logger.info("plan: %s", line)
+    # Degenerate TP (one device / indivisible heads): replicated specs.
+    specs = (
+        tp.param_pspecs(params, tp.llama_rules())
+        if cfg.model_parallel > 1
+        else None
+    )
+    if specs is not None:
+        for line in describe_pspecs(params, specs)[:8]:
+            logger.info("plan: %s", line)
 
     ds = datasets.TokenStream(
         vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len

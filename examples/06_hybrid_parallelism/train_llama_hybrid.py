@@ -25,24 +25,35 @@ def main(argv=None) -> int:
     cfg = TrainingConfig.from_args(argv)
     logger = get_logger()
     init_distributed()  # before any device query (multi-host contract)
-    if cfg.model_parallel == 1:
-        cfg.model_parallel = min(4, jax.device_count())
-    mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
-    dp_size = mesh.shape["data"]
-    logger.info("mesh: %s (TP inner/ICI-minor, FSDP outer)", dict(mesh.shape))
-
     model_cfg = llama2.LlamaConfig(
         dim=256, n_layers=2, n_heads=8, vocab_size=4096,
         multiple_of=64, max_seq_len=512,
     )
+    if cfg.model_parallel == 1:
+        # Auto: TP up to 4-wide (the reference's node-size cap,
+        # tensor_parallel_vit.py:273); 1 = pure FSDP fallback.
+        cfg.model_parallel = tp.auto_tp_degree(
+            jax.device_count(), model_cfg.n_heads, model_cfg.kv_heads, cap=4
+        )
+    mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
+    dp_size = mesh.shape["data"]
+    logger.info("mesh: %s (TP inner/ICI-minor, FSDP outer)", dict(mesh.shape))
+
     tp.validate_tp_degree(
         model_cfg.n_heads, model_cfg.kv_heads, cfg.model_parallel
     )
     params = llama2.init_llama(jax.random.key(cfg.seed), model_cfg)
-    specs = hybrid.hybrid_pspecs(
-        params, tp.llama_rules(), data_size=dp_size
-    )
-    constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
+    if cfg.model_parallel > 1:
+        specs = hybrid.hybrid_pspecs(
+            params, tp.llama_rules(), data_size=dp_size
+        )
+        constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
+    else:
+        # Degenerate model axis: pure ZeRO-3 over data (P2 recipe).
+        from tpu_hpc.parallel import fsdp
+
+        specs = fsdp.param_pspecs(params, axis="data", axis_size=dp_size)
+        constrain = lambda x: x  # noqa: E731
 
     ds = datasets.TokenStream(
         vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len

@@ -204,3 +204,30 @@ def test_validate_tp_degree():
         tp.validate_tp_degree(6, 6, 4)
     with pytest.raises(ValueError):
         tp.validate_tp_degree(8, 2, 4)
+
+
+def test_auto_tp_degree():
+    # 8 devices, 8 heads: full TP; cap enforces the node-size rule.
+    assert tp.auto_tp_degree(8, 8, 8) == 8
+    assert tp.auto_tp_degree(8, 8, 8, cap=4) == 4
+    # 6 devices, 8 heads: only 2 divides both.
+    assert tp.auto_tp_degree(6, 8, 8) == 2
+    # GQA: kv_heads constrains harder than n_heads.
+    assert tp.auto_tp_degree(8, 8, 2) == 2
+    # Nothing fits -> 1 (pure-DP fallback).
+    assert tp.auto_tp_degree(1, 8, 8) == 1
+    assert tp.auto_tp_degree(5, 8, 8) == 1
+
+
+def test_mlp_rules_anchor_on_path_components():
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_hpc.parallel.plans import apply_rules
+
+    rules = tp.mlp_rules()
+    # 'main' must not be claimed by the 'in' rule, 'group' not by 'up'.
+    assert apply_rules(rules, "main/kernel") == P()
+    assert apply_rules(rules, "group/kernel") == P()
+    assert apply_rules(rules, "in/kernel") == P(None, "model")
+    assert apply_rules(rules, "block/up/kernel") == P(None, "model")
+    assert apply_rules(rules, "block/down/kernel") == P("model", None)

@@ -1,0 +1,151 @@
+"""PipelineTransformer: a causal LM built for stage-wise pipelining.
+
+Parity: the reference's PipelineTransformer
+(scripts/04_pipeline_parallel_pp/03_pipeline_training.py:51-120) defines
+four *named* stage blocks (stage0..3) so torch's tracer can cut at
+attribute boundaries (:92-103,180-184).
+
+TPU-native: stages are not named attributes but an *array axis* -- the
+per-stage block params are stacked on a leading dim and sharded over the
+``pipe`` mesh axis (see tpu_hpc.parallel.pp). Embedding and LM head run
+outside the pipelined body, replicated over the pipe axis (negligible
+FLOPs; keeps the pipelined body one homogeneous SPMD program). The
+stage block itself is ``layers_per_stage`` pre-LN causal transformer
+layers, matching the reference's stage contents (:62-88).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class PipeConfig:
+    vocab_size: int = 1000
+    dim: int = 256
+    n_heads: int = 8
+    n_stages: int = 4
+    layers_per_stage: int = 2
+    max_seq_len: int = 128
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+
+    @property
+    def n_layers(self) -> int:
+        return self.n_stages * self.layers_per_stage
+
+
+class CausalLayer(nn.Module):
+    """Pre-LN causal self-attention + GELU MLP (the reference stage
+    block's layer, 03_pipeline_training.py:62-88)."""
+
+    cfg: PipeConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        B, L, D = x.shape
+        H = cfg.n_heads
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        qkv = nn.Dense(3 * D, dtype=cfg.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, L, H, D // H)
+        k = k.reshape(B, L, H, D // H)
+        v = v.reshape(B, L, H, D // H)
+        scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(D // H)
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhlm,bmhd->blhd", attn.astype(x.dtype), v)
+        x = x + nn.Dense(D, dtype=cfg.dtype, name="proj")(
+            out.reshape(B, L, D)
+        )
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        h = nn.Dense(cfg.mlp_ratio * D, dtype=cfg.dtype, name="fc1")(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(D, dtype=cfg.dtype, name="fc2")(h)
+
+
+class StageBlock(nn.Module):
+    """One pipeline stage: layers_per_stage causal layers.
+    Shape-preserving ([B, L, D] -> [B, L, D]) as pp.pipelined requires."""
+
+    cfg: PipeConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i in range(self.cfg.layers_per_stage):
+            x = CausalLayer(self.cfg, name=f"layer_{i}")(x)
+        return x
+
+
+def init_pipeline_transformer(rng: jax.Array, cfg: PipeConfig) -> Dict:
+    """Returns {embed: {tok, pos}, stages: <stacked [S, ...]>, head:
+    {ln_scale, ln_bias, kernel}}. ``stages`` is vmapped-init so every
+    stage gets an independent draw, stacked ready for P(pipe) sharding."""
+    k_emb, k_pos, k_stage, k_head = jax.random.split(rng, 4)
+    dummy = jnp.zeros((1, min(8, cfg.max_seq_len), cfg.dim), cfg.dtype)
+    block = StageBlock(cfg)
+    stage_keys = jax.random.split(k_stage, cfg.n_stages)
+    stages = jax.vmap(lambda k: block.init(k, dummy)["params"])(stage_keys)
+    return {
+        "embed": {
+            "tok": jax.random.normal(
+                k_emb, (cfg.vocab_size, cfg.dim), jnp.float32
+            ) * 0.02,
+            "pos": jax.random.normal(
+                k_pos, (cfg.max_seq_len, cfg.dim), jnp.float32
+            ) * 0.02,
+        },
+        "stages": stages,
+        "head": {
+            "ln_scale": jnp.ones((cfg.dim,), jnp.float32),
+            "ln_bias": jnp.zeros((cfg.dim,), jnp.float32),
+            "kernel": jax.random.normal(
+                k_head, (cfg.dim, cfg.vocab_size), jnp.float32
+            ) * 0.02,
+        },
+    }
+
+
+def embed(params: Dict, tokens: jax.Array, cfg: PipeConfig) -> jax.Array:
+    """[.., L] int tokens -> [.., L, D] activations (token + learned
+    positional embedding, reference :64-66)."""
+    x = params["embed"]["tok"][tokens] + params["embed"]["pos"][: tokens.shape[-1]]
+    return x.astype(cfg.dtype)
+
+
+def head(params: Dict, x: jax.Array, cfg: PipeConfig) -> jax.Array:
+    """Final LayerNorm + LM head -> fp32 logits (reference :89-91)."""
+    h = params["head"]
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    x = (x - mu) * jax.lax.rsqrt(var + 1e-5)
+    x = x * h["ln_scale"] + h["ln_bias"]
+    return (x @ h["kernel"]).astype(jnp.float32)
+
+
+def make_stage_fn(cfg: PipeConfig):
+    """stage_fn(stage_params, x) for tpu_hpc.parallel.pp.pipelined."""
+    block = StageBlock(cfg)
+
+    def stage_fn(stage_params, x):
+        return block.apply({"params": stage_params}, x)
+
+    return stage_fn
+
+
+def apply_sequential(params: Dict, tokens: jax.Array, cfg: PipeConfig) -> jax.Array:
+    """Single-device oracle: run all stages in order, no pipelining.
+    The correctness reference for the pipeline schedules (the role the
+    reference's full-model-on-every-rank construction plays,
+    03_pipeline_training.py:166-171)."""
+    x = embed(params, tokens, cfg)
+    stage_fn = make_stage_fn(cfg)
+    for s in range(cfg.n_stages):
+        x = stage_fn(jax.tree.map(lambda a: a[s], params["stages"]), x)
+    return head(params, x, cfg)

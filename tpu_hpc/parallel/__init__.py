@@ -3,4 +3,4 @@ from tpu_hpc.parallel.plans import (  # noqa: F401
     pspec_tree,
     shardings_for,
 )
-from tpu_hpc.parallel import dp, fsdp, hybrid, tp  # noqa: F401
+from tpu_hpc.parallel import dp, fsdp, hybrid, pp, tp  # noqa: F401

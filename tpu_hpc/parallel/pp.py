@@ -1,0 +1,315 @@
+"""Pipeline parallelism over a mesh axis: GPipe and 1F1B schedules.
+
+Parity: scripts/04_pipeline_parallel_pp/ -- manual stage send/recv
+(01_manual_model_split.py:100-130), traced pipeline + ScheduleGPipe /
+Schedule1F1B (02_pipeline_schedules.py:63-115), full training with
+per-stage optimizers (03_pipeline_training.py:198-252), bubble-fraction
+accounting (:292-293).
+
+TPU-native design. The reference traces the model with torch.export and
+ships a different submodule to each rank, then runs an imperative
+send/recv schedule. Neither maps to XLA: a jitted program must be one
+SPMD computation. Instead:
+
+- Stages are *structural*: per-stage parameters are stacked on a leading
+  dim and sharded over the ``pipe`` mesh axis, so each device holds
+  exactly its stage's weights (the reference's PipelineTransformer names
+  its stages for the same reason -- 03_pipeline_training.py:92-103).
+- The schedule is a ``shard_map`` tick loop: every tick each stage runs
+  one microbatch through its block and hands the activation to its
+  right neighbor with a single ``ppermute`` (a neighbor hop on the ICI
+  torus -- the literal hardware analogue of ``dist.send(rank+1)``).
+- **GPipe** needs no hand-written backward: differentiating through the
+  tick loop transposes every ``ppermute``, which *is* the reverse
+  pipeline (cotangents hop leftward in reverse tick order).
+- **1F1B** is an explicit combined forward/backward tick program wired
+  in via ``jax.custom_vjp``: stage s runs forward of microbatch f at
+  tick ``f+s`` and backward of microbatch b at tick ``2S-1-s+b``, so at
+  most ``2(S-s)-1`` activations are live per stage -- O(S) instead of
+  GPipe's O(M) -- at the cost of recomputing each stage forward once
+  from a saved input (remat, the standard TPU trade of FLOPs for HBM).
+
+Stage functions must be shape-preserving (activation in == activation
+out), which transformer blocks are. Embedding/head run *outside* the
+pipelined body, replicated over the pipe axis -- they are a rounding
+error of the FLOPs, and keeping the pipelined body homogeneous is what
+makes it a single SPMD program (no per-stage control flow).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_hpc.runtime.mesh import PIPE_AXIS
+
+# stage_fn(stage_params, x_microbatch) -> y_microbatch (same shape)
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """Idle fraction of the pipeline: (S-1)/(M+S-1).
+
+    The reference reports the approximation (S-1)/M
+    (03_pipeline_training.py:292, 07_pipeline_parallel.md:127-143);
+    this is the exact closed form (equal for M >> S).
+    """
+    return (n_stages - 1) / (n_microbatches + n_stages - 1)
+
+
+def microbatch(x: jax.Array, n_microbatches: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...] (the reference's chunking:
+    02_pipeline_schedules.py microbatch split)."""
+    if x.shape[0] % n_microbatches != 0:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {n_microbatches} microbatches"
+        )
+    return x.reshape(n_microbatches, x.shape[0] // n_microbatches, *x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [B, ...]."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
+def stack_stage_params(per_stage: list) -> Any:
+    """Stack a list of per-stage param pytrees on a new leading dim
+    (to be sharded P(pipe_axis))."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def _local_stage(stacked: Any) -> Any:
+    """Under shard_map the stacked params have local leading dim 1."""
+    return jax.tree.map(lambda a: a[0], stacked)
+
+
+def _fwd_program(stage_fn: StageFn, axis: str, n_stages: int):
+    """The GPipe forward tick loop (runs under shard_map).
+
+    Local views: ``stacked`` [1, ...] (this stage's params), ``xs``
+    [M, mb, ...] (all microbatches, replicated over the pipe axis).
+    Returns ys [M, mb, ...], valid on every stage (psum-broadcast).
+    """
+    S = n_stages
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def program(stacked, xs):
+        p = _local_stage(stacked)
+        sid = jax.lax.axis_index(axis)
+        M = xs.shape[0]
+
+        def tick(carry, t):
+            state, ys = carry
+            mb = jnp.clip(t, 0, M - 1)
+            inp = jnp.where(
+                sid == 0,
+                jax.lax.dynamic_index_in_dim(xs, mb, 0, keepdims=False),
+                state,
+            )
+            out = stage_fn(p, inp)
+            # Last stage finished microbatch t-(S-1) this tick.
+            oidx = t - (S - 1)
+            valid = (sid == S - 1) & (oidx >= 0)
+            oclip = jnp.clip(oidx, 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(ys, oclip, 0, keepdims=False)
+            ys = jax.lax.dynamic_update_index_in_dim(
+                ys, jnp.where(valid, out, cur), oclip, 0
+            )
+            if S > 1:
+                state = jax.lax.ppermute(out, axis, fwd_perm)
+            return (state, ys), None
+
+        state0 = jnp.zeros_like(xs[0])
+        ys0 = jnp.zeros_like(xs)
+        (_, ys), _ = jax.lax.scan(
+            tick, (state0, ys0), jnp.arange(M + S - 1)
+        )
+        # Only the last stage holds real outputs; broadcast along the
+        # pipe ring so downstream (replicated head/loss) sees them.
+        if S > 1:
+            ys = jax.lax.psum(
+                jnp.where(sid == S - 1, ys, jnp.zeros_like(ys)), axis
+            )
+        return ys
+
+    return program
+
+
+def _fwd_bwd_program_1f1b(stage_fn: StageFn, axis: str, n_stages: int):
+    """The 1F1B combined forward+backward tick loop (under shard_map).
+
+    Schedule (stage s, 0-indexed): forward of microbatch f at tick
+    ``f + s``; backward of microbatch b at tick ``(2S-1-s) + b``. Each
+    tick does at most one forward and one backward -- the steady-state
+    "one forward, one backward" interleave of Schedule1F1B
+    (02_pipeline_schedules.py:98-115). Live stage inputs per stage s:
+    ``2(S-s)-1`` <= 2S-1, held in a depth-2S circular buffer; backward
+    recomputes the stage forward from the saved input (remat).
+
+    Returns (grads_stacked [1,...], gxs [M, mb, ...]) given output
+    cotangents ybar.
+    """
+    S = n_stages
+    D = 2 * S  # circular buffer depth >= max in-flight microbatches
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+    bwd_perm = [(i, i - 1) for i in range(1, S)]
+
+    def program(stacked, xs, ybar):
+        p = _local_stage(stacked)
+        sid = jax.lax.axis_index(axis)
+        M = xs.shape[0]
+
+        def tick(carry, t):
+            buf, fwd_state, bwd_state, grads, gxs = carry
+            # -- forward slot: microbatch f = t - s --
+            f = t - sid
+            do_fwd = (f >= 0) & (f < M)
+            fclip = jnp.clip(f, 0, M - 1)
+            inp = jnp.where(
+                sid == 0,
+                jax.lax.dynamic_index_in_dim(xs, fclip, 0, keepdims=False),
+                fwd_state,
+            )
+            slot = jnp.where(do_fwd, f % D, D - 1)
+            old = jax.lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+            buf = jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(do_fwd, inp, old), slot, 0
+            )
+            out = stage_fn(p, inp)
+            # -- backward slot: microbatch b = t - (2S-1-s) --
+            b = t - (2 * S - 1 - sid)
+            do_bwd = (b >= 0) & (b < M)
+            bclip = jnp.clip(b, 0, M - 1)
+            binp = jax.lax.dynamic_index_in_dim(
+                buf, bclip % D, 0, keepdims=False
+            )
+            _, vjp = jax.vjp(stage_fn, p, binp)  # remat of the forward
+            gin = jnp.where(
+                sid == S - 1,
+                jax.lax.dynamic_index_in_dim(ybar, bclip, 0, keepdims=False),
+                bwd_state,
+            )
+            pg, xg = vjp(gin)
+            grads = jax.tree.map(
+                lambda g, a: g + jnp.where(do_bwd, a, jnp.zeros_like(a)),
+                grads, pg,
+            )
+            # Stage 0's input cotangent is the pipeline's d(loss)/d(xs).
+            gcur = jax.lax.dynamic_index_in_dim(gxs, bclip, 0, keepdims=False)
+            gxs = jax.lax.dynamic_update_index_in_dim(
+                gxs, jnp.where(do_bwd & (sid == 0), xg, gcur), bclip, 0
+            )
+            if S > 1:
+                fwd_state = jax.lax.ppermute(out, axis, fwd_perm)
+                bwd_state = jax.lax.ppermute(xg, axis, bwd_perm)
+            return (buf, fwd_state, bwd_state, grads, gxs), None
+
+        mbshape = xs.shape[1:]
+        carry0 = (
+            jnp.zeros((D,) + mbshape, xs.dtype),     # buf
+            jnp.zeros(mbshape, xs.dtype),            # fwd_state
+            jnp.zeros(mbshape, xs.dtype),            # bwd_state
+            jax.tree.map(jnp.zeros_like, p),         # grads
+            jnp.zeros_like(xs),                      # gxs
+        )
+        (_, _, _, grads, gxs), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(M + 2 * S - 1)
+        )
+        # grads are per-stage-local: restore the stacked leading dim.
+        grads = jax.tree.map(lambda g: g[None], grads)
+        # gxs lives on stage 0 only; broadcast like the forward outputs.
+        if S > 1:
+            sid = jax.lax.axis_index(axis)
+            gxs = jax.lax.psum(
+                jnp.where(sid == 0, gxs, jnp.zeros_like(gxs)), axis
+            )
+        return grads, gxs
+
+    return program
+
+
+def pipelined(
+    stage_fn: StageFn,
+    mesh: Mesh,
+    axis: str = PIPE_AXIS,
+    schedule: str = "gpipe",
+    batch_spec: P = P(),
+):
+    """Build ``fn(stacked_params, xs) -> ys``: the pipelined, jit-able,
+    differentiable forward over ``mesh`` axis ``axis``.
+
+    ``stacked_params``: per-stage params stacked on dim 0 (shard it
+    P(axis) -- see :func:`stage_pspecs`). ``xs``: [M, mb, ...]
+    microbatched activations. ``schedule``: "gpipe" (autodiff backward,
+    O(M) live activations) or "1f1b" (custom_vjp interleaved backward,
+    O(S) live activations + forward remat). The returned function is
+    *not* jitted -- trace it into your training step so XLA schedules
+    the surrounding embed/head/optimizer with it.
+    """
+    S = mesh.shape[axis]
+    fwd = jax.shard_map(
+        _fwd_program(stage_fn, axis, S),
+        mesh=mesh,
+        in_specs=(P(axis), batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    )
+    if schedule == "gpipe":
+        return fwd
+    if schedule != "1f1b":
+        raise ValueError(f"unknown schedule {schedule!r} (gpipe|1f1b)")
+
+    bwd = jax.shard_map(
+        _fwd_bwd_program_1f1b(stage_fn, axis, S),
+        mesh=mesh,
+        in_specs=(P(axis), batch_spec, batch_spec),
+        out_specs=(P(axis), batch_spec),
+        check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def pipe(stacked, xs):
+        return fwd(stacked, xs)
+
+    def pipe_fwd(stacked, xs):
+        return fwd(stacked, xs), (stacked, xs)
+
+    def pipe_bwd(res, ybar):
+        stacked, xs = res
+        return bwd(stacked, xs, ybar)
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+    return pipe
+
+
+def stage_pspecs(stacked_params: Any, axis: str = PIPE_AXIS) -> Any:
+    """PartitionSpec tree sharding the stacked leading dim over the
+    pipe axis (each device holds its stage's weights -- the reference's
+    build_stage(rank) ownership model, 02_pipeline_schedules.py:92)."""
+    return jax.tree.map(
+        lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params
+    )
+
+
+def manual_stage_step(
+    mesh: Mesh, axis: str = PIPE_AXIS
+) -> Callable[[jax.Array], jax.Array]:
+    """One explicit activation hand-off to the next stage -- the
+    educational send/recv building block (parity:
+    01_manual_model_split.py:102-130, where each microbatch moves with
+    dist.send/dist.recv). Here it is one neighbor ``ppermute`` hop."""
+    S = mesh.shape[axis]
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    def shift(x):
+        return jax.lax.ppermute(x, axis, perm)
+
+    return jax.jit(
+        jax.shard_map(
+            shift, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+            check_vma=False,
+        )
+    )

@@ -53,8 +53,10 @@ def mlp_rules(axis: str = "model") -> List[Rule]:
     odd layers shard outputs, even layers shard inputs (parity:
     02_basic_tensor_parallel.py:64-71)."""
     return [
-        (r"(up|fc1|in)/kernel$", P(None, axis)),
-        (r"(down|fc2|out)/kernel$", P(axis, None)),
+        # (^|/) anchors on a path-component boundary so e.g. a layer
+        # named 'main' is not claimed by the 'in' rule.
+        (r"(^|/)(up|fc1|in)/kernel$", P(None, axis)),
+        (r"(^|/)(down|fc2|out)/kernel$", P(axis, None)),
     ]
 
 
@@ -63,10 +65,10 @@ def vit_rules(axis: str = "model") -> List[Rule]:
     fc1 Colwise, out_proj + fc2 Rowwise, patch embed + norms
     replicated."""
     return [
-        (r"(q|k|v)_proj/kernel$", P(None, axis)),
-        (r"out_proj/kernel$", P(axis, None)),
-        (r"fc1/kernel$", P(None, axis)),
-        (r"fc2/kernel$", P(axis, None)),
+        (r"(^|/)[qkv]_proj/kernel$", P(None, axis)),
+        (r"(^|/)out_proj/kernel$", P(axis, None)),
+        (r"(^|/)fc1/kernel$", P(None, axis)),
+        (r"(^|/)fc2/kernel$", P(axis, None)),
     ]
 
 
@@ -96,6 +98,22 @@ def sp_constrain(
         return x
 
     return constrain
+
+
+def auto_tp_degree(
+    n_devices: int, n_heads: int, kv_heads: int, cap: Optional[int] = None
+) -> int:
+    """Largest valid TP degree: divides the device count and both head
+    counts (the constraint validate_tp_degree enforces), optionally
+    capped (the reference caps TP at the 4-GPU node size,
+    tensor_parallel_vit.py:273). Returns 1 when nothing fits -- callers
+    then fall back to pure DP, the reference's world_size==1 pattern."""
+    limit = min(n_devices, cap or n_devices)
+    return max(
+        d
+        for d in range(1, limit + 1)
+        if n_devices % d == 0 and n_heads % d == 0 and kv_heads % d == 0
+    )
 
 
 def validate_tp_degree(
