@@ -38,7 +38,9 @@ def main(argv=None) -> int:
     logger = get_logger()
     init_distributed()
     if cfg.pipe_parallel == 1:
-        cfg.pipe_parallel = jax.device_count()
+        # Auto: stages fill whatever the (explicit) data axis leaves.
+        dp = cfg.data_parallel if cfg.data_parallel > 0 else 1
+        cfg.pipe_parallel = jax.device_count() // dp
     mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
     # On one device mesh_axes() drops the degenerate pipe axis; train
     # unpipelined (the reference's world_size==1 fallback pattern).

@@ -1,0 +1,146 @@
+"""Domain-parallel training: spatially-sharded convs with halo exchange.
+
+Implements the strategy directory the reference advertises but does not
+ship (/root/reference/docs/guide/10_domain_parallel.md:156-172 lists
+scripts/07_domain_parallel_shardtensor/*; SURVEY.md 0 confirms it is
+absent). Covers all four advertised scripts in one runnable file:
+
+  * ``--demo``  -- why naive spatial splitting fails (boundary
+    corruption at tile seams) and how the halo exchange fixes it
+    (doc :69-103), printed as max-abs-error vs the single-device conv.
+  * default     -- domain-parallel training of a conv stack on
+    ERA5-like weather grids over a (data, spatial) mesh: latitude bands
+    sharded across the ``spatial`` axis (neighbor ppermute halos over
+    ICI), batch across ``data`` -- the domain+DP composition of the
+    doc's final script. Activation memory per device drops by the
+    spatial degree: the SciML activation-wall motivation (:13-32).
+
+Run (8 simulated devices):
+  TPU_HPC_SIM_DEVICES=8 python train_domain_parallel.py --spatial-parallel 4
+"""
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.models import datasets, losses
+from tpu_hpc.parallel import domain
+from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+from tpu_hpc.train import Trainer
+
+
+def init_conv_stack(rng, channels, hidden, n_layers):
+    """[3,3,.,.] HWIO kernels + biases; last layer maps back to
+    ``channels`` (the regression head of the reference's U-Net demo)."""
+    params = {}
+    dims = [channels] + [hidden] * (n_layers - 1) + [channels]
+    for i, (cin, cout) in enumerate(zip(dims[:-1], dims[1:])):
+        rng, k = jax.random.split(rng)
+        std = (2.0 / (9 * cin)) ** 0.5
+        params[f"w{i}"] = std * jax.random.normal(
+            k, (3, 3, cin, cout), jnp.float32
+        )
+        params[f"b{i}"] = jnp.zeros((cout,), jnp.float32)
+    return params
+
+
+def conv_stack(axis_name, params, x):
+    """The domain program: every conv re-exchanges halos first."""
+    n = len(params) // 2
+    h = x
+    for i in range(n):
+        h = domain.halo_conv2d(
+            h, params[f"w{i}"], params[f"b{i}"], axis_name=axis_name
+        )
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def run_demo(mesh, logger) -> None:
+    x = jax.random.normal(jax.random.key(0), (2, 32, 16, 3))
+    kernel = 0.1 * jax.random.normal(jax.random.key(1), (3, 3, 3, 3))
+    want = jax.lax.conv_general_dilated(
+        x, kernel, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    naive = domain.domain_parallel(
+        lambda ax, p, t: domain.naive_split_conv2d(t, p, axis_name=ax),
+        mesh,
+    )(kernel, x)
+    halo = domain.domain_parallel(
+        lambda ax, p, t: domain.halo_conv2d(t, p, axis_name=ax),
+        mesh,
+    )(kernel, x)
+    err_naive = float(jnp.abs(naive - want).max())
+    err_halo = float(jnp.abs(halo - want).max())
+    logger.info(
+        "naive split: max |err| vs single-device = %.2e  <- seam rows "
+        "corrupted (every tile zero-padded its own borders)", err_naive,
+    )
+    logger.info(
+        "halo exchange: max |err| = %.2e  <- exact (neighbors' edge "
+        "rows exchanged via ppermute before each conv)", err_halo,
+    )
+
+
+def main(argv=None) -> int:
+    cfg = TrainingConfig.from_args(argv)
+    extra = argparse.ArgumentParser(add_help=False)
+    extra.add_argument("--spatial-parallel", type=int, default=4)
+    extra.add_argument("--hidden", type=int, default=64)
+    extra.add_argument("--layers", type=int, default=3)
+    extra.add_argument("--lat", type=int, default=180)
+    extra.add_argument("--lon", type=int, default=360)
+    extra.add_argument("--demo", action="store_true")
+    ns, _ = extra.parse_known_args(argv)
+
+    logger = get_logger()
+    init_distributed()
+    spatial = min(ns.spatial_parallel, jax.device_count())
+    while jax.device_count() % spatial:  # degree must divide devices
+        spatial -= 1
+    mesh = build_mesh(MeshSpec(axes={"data": -1, "spatial": spatial}))
+    logger.info(
+        "mesh: %s (latitude bands on 'spatial', batch on 'data')",
+        dict(mesh.shape),
+    )
+    if ns.demo:
+        run_demo(mesh, logger)
+        return 0
+
+    # lat=180 default: divisible latitude bands (the odd-grid 181 case
+    # stays the U-Net's job; domain tiles must divide evenly).
+    ds = datasets.ERA5Synthetic(lat=ns.lat, lon=ns.lon)
+    params = init_conv_stack(
+        jax.random.key(cfg.seed), ds.channels, ns.hidden, ns.layers
+    )
+    model = domain.domain_parallel(conv_stack, mesh)
+
+    def forward(p, ms, batch, step_rng):
+        x, y = batch
+        pred = model(p, x)
+        return losses.lat_weighted_mse(pred, y), ms, {}
+
+    trainer = Trainer(
+        cfg, mesh, forward, params,
+        batch_pspec=P("data", "spatial"),
+    )
+    result = trainer.fit(ds)
+    summary = result["epochs"][-1]
+    logger.info(
+        "run summary | final loss %.5f | %.1f samples/s global | "
+        "lat %d split %d-way -> %d rows/device held",
+        result["final_loss"],
+        summary["items_per_s"],
+        ds.lat, spatial, ds.lat // spatial,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -95,6 +95,22 @@ def test_pp_composes_with_dp(setup):
     np.testing.assert_allclose(float(loss), float(oracle), atol=1e-5)
 
 
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_ppxdp_grads_match_oracle(setup, schedule):
+    """Regression: 1F1B's custom vjp must psum stage grads over the
+    data axis (shard_map's own transpose does this for GPipe; the
+    hand-written backward once dropped it, silently training on
+    half-batch gradients)."""
+    _, params, tokens, targets = setup
+    mesh2 = build_mesh(MeshSpec(axes={"data": 2, "pipe": 4}))
+    from jax.sharding import PartitionSpec as P
+
+    loss_fn = _pipe_loss_fn(mesh2, schedule, batch_spec=P(None, "data"))
+    g_pipe = jax.jit(jax.grad(loss_fn))(params, tokens, targets)
+    g_oracle = jax.jit(jax.grad(_oracle_loss))(params, tokens, targets)
+    _tree_allclose(g_pipe, g_oracle, atol=2e-4)
+
+
 def test_bubble_fraction():
     # 4 stages, 8 microbatches: 3 idle ticks of 11 total.
     assert pp.bubble_fraction(4, 8) == pytest.approx(3 / 11)

@@ -1,0 +1,247 @@
+"""Sequence-parallel family: flash kernel, Ring Attention, Ulysses.
+
+The reference documents these designs but ships no code (SURVEY.md 0:
+scripts/05_sequence_parallel_sp is advertised in docs/guide/
+08_sequence_parallel.md:161-185 yet absent) -- so the oracle here is
+mathematical: exact agreement with single-device full softmax
+attention, forward and backward.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hpc.kernels.attention import (
+    MASK_VALUE,
+    attention_reference,
+    blockwise_attention,
+    flash_attention,
+    lse_merge,
+)
+from tpu_hpc.parallel.ring_attention import make_ring_attn_fn, ring_attention
+from tpu_hpc.parallel.sp_ulysses import (
+    make_ulysses_attn_fn,
+    ulysses_attention,
+    validate_ulysses_degree,
+)
+from tpu_hpc.runtime import MeshSpec, build_mesh
+
+
+def full_attention_oracle(q, k, v, causal=True):
+    """Dense softmax attention in fp64-ish fp32, the ground truth."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def rand_qkv(key, b=2, s=32, hq=4, hkv=4, d=8):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, hkv, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.fixture(scope="module")
+def sp_mesh(devices):
+    return build_mesh(MeshSpec(axes={"data": 2, "context": 4}))
+
+
+class TestReferencePath:
+    def test_matches_oracle(self):
+        q, k, v = rand_qkv(jax.random.key(0))
+        out, lse = attention_reference(q, k, v, causal=True)
+        want = full_attention_oracle(q, k, v, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-5)
+
+    def test_lse_values(self):
+        # lse must equal log sum exp of the masked score rows.
+        q, k, v = rand_qkv(jax.random.key(1), s=8)
+        _, lse = attention_reference(q, k, v, causal=True)
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((8, 8), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+        want = jax.nn.logsumexp(s, axis=-1).transpose(0, 2, 1)
+        np.testing.assert_allclose(lse, want, atol=1e-5)
+
+    def test_fully_masked_chunk_is_noop(self):
+        # kv chunk strictly in the future: out 0, lse = MASK_VALUE.
+        q, k, v = rand_qkv(jax.random.key(2), s=8)
+        out, lse = attention_reference(
+            q, k, v, causal=True, q_offset=0, kv_offset=100
+        )
+        np.testing.assert_allclose(out, jnp.zeros_like(out))
+        assert bool(jnp.all(lse <= MASK_VALUE * 0.5))
+
+    def test_chunked_merge_equals_full(self):
+        # Split KV in two chunks, merge with lse_merge -> full result.
+        q, k, v = rand_qkv(jax.random.key(3))
+        half = k.shape[1] // 2
+        o1, l1 = attention_reference(
+            q, k[:, :half], v[:, :half], causal=True, kv_offset=0
+        )
+        o2, l2 = attention_reference(
+            q, k[:, half:], v[:, half:], causal=True, kv_offset=half
+        )
+        out, _ = lse_merge(o1, l1, o2, l2)
+        want = full_attention_oracle(q, k, v, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-5)
+
+
+class TestFlashKernel:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = rand_qkv(jax.random.key(4), s=32)
+        out, lse = flash_attention(
+            q, k, v, jnp.int32(0), jnp.int32(0),
+            causal, None, 8, 8, True,  # interpret mode on CPU
+        )
+        want, want_lse = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, want, atol=1e-5)
+        np.testing.assert_allclose(lse, want_lse, atol=1e-5)
+
+    def test_offsets(self):
+        q, k, v = rand_qkv(jax.random.key(5), s=16)
+        out, lse = flash_attention(
+            q, k, v, jnp.int32(16), jnp.int32(0),
+            True, None, 8, 8, True,
+        )
+        want, want_lse = attention_reference(
+            q, k, v, causal=True, q_offset=16, kv_offset=0
+        )
+        np.testing.assert_allclose(out, want, atol=1e-5)
+        np.testing.assert_allclose(lse, want_lse, atol=1e-5)
+
+    def test_grad_via_remat_bwd(self):
+        q, k, v = rand_qkv(jax.random.key(6), s=16)
+
+        def f_pallas(q, k, v):
+            out, _ = blockwise_attention(
+                q, k, v, causal=True, impl="pallas_interpret",
+                block_q=8, block_k=8,
+            )
+            return jnp.sum(out * out)
+
+        def f_ref(q, k, v):
+            out, _ = attention_reference(q, k, v, causal=True)
+            return jnp.sum(out * out)
+
+        gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+class TestRingAttention:
+    def test_matches_oracle(self, sp_mesh):
+        q, k, v = rand_qkv(jax.random.key(7), b=2, s=32)
+        attn = make_ring_attn_fn(sp_mesh, "data", "context", impl="xla")
+        out = jax.jit(attn)(q, k, v)
+        want = full_attention_oracle(q, k, v, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_non_causal(self, sp_mesh):
+        q, k, v = rand_qkv(jax.random.key(8), b=2, s=32)
+        attn = make_ring_attn_fn(
+            sp_mesh, "data", "context", causal=False, impl="xla"
+        )
+        out = jax.jit(attn)(q, k, v)
+        want = full_attention_oracle(q, k, v, causal=False)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_gqa(self, sp_mesh):
+        q, k, v = rand_qkv(jax.random.key(9), b=2, s=32, hq=4, hkv=2)
+        attn = make_ring_attn_fn(sp_mesh, "data", "context", impl="xla")
+        out = jax.jit(attn)(q, k, v)
+        kr = jnp.repeat(k, 2, axis=2)
+        vr = jnp.repeat(v, 2, axis=2)
+        want = full_attention_oracle(q, kr, vr, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_grad_matches_oracle(self, sp_mesh):
+        q, k, v = rand_qkv(jax.random.key(10), b=2, s=32)
+        attn = make_ring_attn_fn(sp_mesh, "data", "context", impl="xla")
+
+        def loss_ring(q, k, v):
+            return jnp.sum(attn(q, k, v) ** 2)
+
+        def loss_full(q, k, v):
+            return jnp.sum(full_attention_oracle(q, k, v) ** 2)
+
+        gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+class TestUlysses:
+    def test_degree_validation(self):
+        with pytest.raises(ValueError):
+            validate_ulysses_degree(6, 4)
+        validate_ulysses_degree(8, 4)
+
+    def test_matches_oracle(self, sp_mesh):
+        q, k, v = rand_qkv(jax.random.key(11), b=2, s=32)
+        attn = make_ulysses_attn_fn(
+            sp_mesh, "data", "context", impl="xla"
+        )
+        out = jax.jit(attn)(q, k, v)
+        want = full_attention_oracle(q, k, v, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_gqa_repeat(self, sp_mesh):
+        # kv_heads=2 < degree=4: KV repeated up to Hq before exchange.
+        q, k, v = rand_qkv(jax.random.key(12), b=2, s=32, hq=4, hkv=2)
+        attn = make_ulysses_attn_fn(
+            sp_mesh, "data", "context", impl="xla"
+        )
+        out = jax.jit(attn)(q, k, v)
+        kr = jnp.repeat(k, 2, axis=2)
+        vr = jnp.repeat(v, 2, axis=2)
+        want = full_attention_oracle(q, kr, vr, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_grad_matches_oracle(self, sp_mesh):
+        q, k, v = rand_qkv(jax.random.key(13), b=2, s=32)
+        attn = make_ulysses_attn_fn(
+            sp_mesh, "data", "context", impl="xla"
+        )
+
+        def loss_u(q, k, v):
+            return jnp.sum(attn(q, k, v) ** 2)
+
+        def loss_full(q, k, v):
+            return jnp.sum(full_attention_oracle(q, k, v) ** 2)
+
+        gu = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+        gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gu, gf):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+class TestLlamaWithRing:
+    def test_llama_cp_forward_matches_local(self, sp_mesh):
+        """The full model with ring attention == local attention."""
+        from tpu_hpc.models import llama2
+        from tpu_hpc.parallel.ring_attention import cp_constrain
+
+        cfg = llama2.LlamaConfig(
+            dim=32, n_layers=2, n_heads=4, vocab_size=64,
+            multiple_of=16, max_seq_len=32, dtype=jnp.float32,
+        )
+        params = llama2.init_llama(jax.random.key(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.key(1), (2, 32), 0, 64, dtype=jnp.int32
+        )
+        local = llama2.apply_llama(params, tokens, cfg)
+        attn = make_ring_attn_fn(sp_mesh, "data", "context", impl="xla")
+        con = cp_constrain(sp_mesh, "data", "context")
+        ringed = jax.jit(
+            lambda p, t: llama2.apply_llama(p, t, cfg, con, attn)
+        )(params, tokens)
+        np.testing.assert_allclose(ringed, local, atol=2e-4)

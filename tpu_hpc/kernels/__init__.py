@@ -1,0 +1,7 @@
+from tpu_hpc.kernels.attention import (  # noqa: F401
+    blockwise_attention,
+    flash_attention,
+    attention_reference,
+    lse_merge,
+    MASK_VALUE,
+)

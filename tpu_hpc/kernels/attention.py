@@ -1,0 +1,346 @@
+"""Blockwise (flash) attention with log-sum-exp output.
+
+The compute core of the sequence-parallel family (SURVEY.md 5.7): both
+Ring Attention (parallel/ring_attention.py) and Ulysses
+(parallel/sp_ulysses.py) need an attention op that (a) handles a causal
+mask expressed in *global* coordinates via q/kv offsets, and (b) returns
+the per-row log-sum-exp so partial results from different KV chunks can
+be merged exactly (the online-softmax identity the reference documents
+in docs/guide/08_sequence_parallel.md:84-142 but never implements).
+
+Two interchangeable implementations:
+  * ``attention_reference`` -- pure jnp, differentiable, runs anywhere.
+    XLA already fuses this well on TPU for moderate sequence lengths.
+  * ``flash_attention`` -- a Pallas TPU kernel: online softmax over KV
+    blocks, fp32 accumulators in VMEM scratch, bf16 matmuls on the MXU,
+    causal blocks above the diagonal skipped. Forward-only; gradients
+    come from a custom_vjp whose backward rematerialises through the
+    reference path (a hand-written backward kernel is a later
+    optimisation).
+
+Layout convention: [B, S, H, D] (model order, models/llama2.py);
+LSE is [B, S, H] fp32. Masking uses a large finite negative instead of
+-inf so both forward and backward stay NaN-free on fully-masked rows.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MASK_VALUE = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Pure-XLA reference path (differentiable, runs on any backend)
+# ---------------------------------------------------------------------------
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_offset: jax.Array | int = 0,
+    kv_offset: jax.Array | int = 0,
+    sm_scale: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Softmax attention of a Q chunk against a KV chunk.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]. Returns (out [B, Sq, H, D]
+    in q.dtype, lse [B, Sq, H] fp32). ``causal`` masks using global
+    positions ``q_offset + i >= kv_offset + j``; a fully-masked row
+    yields out=0, lse=MASK_VALUE (so it merges as a no-op).
+    """
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        rows = q_offset + jnp.arange(q.shape[1])[:, None]
+        cols = kv_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(rows >= cols, s, MASK_VALUE)
+    m = jnp.max(s, axis=-1)
+    m_safe = jnp.where(m <= MASK_VALUE * 0.5, 0.0, m)
+    p = jnp.where(
+        s > MASK_VALUE * 0.5, jnp.exp(s - m_safe[..., None]), 0.0
+    )
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    out = out / l_safe.transpose(0, 2, 1)[..., None].astype(out.dtype)
+    lse = m + jnp.log(l_safe)  # fully masked: MASK_VALUE + 0
+    return out.astype(q.dtype), lse.transpose(0, 2, 1)
+
+
+def lse_merge(
+    o1: jax.Array, lse1: jax.Array, o2: jax.Array, lse2: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Exactly merge two attention partials over disjoint KV sets.
+
+    o*: [B, S, H, D], lse*: [B, S, H]. The online-softmax identity
+    (reference doc 08_sequence_parallel.md:120-139), written so that a
+    MASK_VALUE (empty) side is an exact no-op and gradients are
+    NaN-free.
+    """
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m <= MASK_VALUE * 0.5, 0.0, m)
+    w1 = jnp.exp(lse1 - m_safe)
+    w2 = jnp.exp(lse2 - m_safe)
+    denom = w1 + w2
+    denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+    lse = m + jnp.log(denom_safe)
+    wo1 = (w1 / denom_safe)[..., None].astype(o1.dtype)
+    wo2 = (w2 / denom_safe)[..., None].astype(o2.dtype)
+    return o1 * wo1 + o2 * wo2, lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash kernel (forward)
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(
+    qo_ref,  # SMEM (1, 1) int32: global q offset
+    ko_ref,  # SMEM (1, 1) int32: global kv offset
+    q_ref,   # VMEM (1, block_q, D)
+    k_ref,   # VMEM (1, block_k, D)
+    v_ref,   # VMEM (1, block_k, D)
+    o_ref,   # VMEM (1, block_q, D)
+    lse_ref,  # VMEM (1, block_q, 1) -- trailing 1 keeps TPU tiling legal
+    acc_ref,  # scratch (block_q, D) f32
+    m_ref,    # scratch (block_q, 1) f32
+    l_ref,    # scratch (block_q, 1) f32
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, MASK_VALUE)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qo_ref[0, 0] + qi * block_q
+    k_start = ko_ref[0, 0] + ki * block_k
+    # Causal skip: KV block entirely in the future of this Q block.
+    live = (
+        (q_start + block_q - 1 >= k_start) if causal else (ki >= 0)
+    )
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, MASK_VALUE)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        m_safe = jnp.where(m_new <= MASK_VALUE * 0.5, 0.0, m_new)
+        p = jnp.where(s > MASK_VALUE * 0.5, jnp.exp(s - m_safe), 0.0)
+        alpha = jnp.exp(m_prev - m_safe)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = alpha * acc_ref[:] + pv
+        m_ref[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l_safe)
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset: jax.Array,
+    kv_offset: jax.Array,
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """[B, Sq, H, D] x [B, Sk, H, D] -> (out, lse [B, Sq, H])."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lens ({sq}, {sk}) must divide blocks "
+            f"({block_q}, {block_k})"
+        )
+    # [B, S, H, D] -> [B*H, S, D]: heads become the parallel grid dim.
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
+
+    grid = (b * h, sq // block_q, sk // block_k)
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    smem = pl.BlockSpec(
+        (1, 1), lambda bh, i, j: (0, 0), memory_space=pltpu.SMEM
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            smem,
+            smem,
+            pl.BlockSpec(
+                (1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q, 1), lambda bh, i, j: (bh, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qo, ko, qt, kt, vt)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
+    return out, lse  # lse [B, Sq, H]
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+)
+def flash_attention(
+    q, k, v, q_offset, kv_offset,
+    causal=True, sm_scale=None, block_q=512, block_k=512,
+    interpret=False,
+):
+    """Pallas flash attention: (out, lse), same contract as
+    ``attention_reference``. Backward rematerialises through the
+    reference path (correct everywhere; a dedicated bwd kernel is a
+    planned optimisation)."""
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    return _flash_forward(
+        q, k, v, q_offset, kv_offset,
+        causal=causal, sm_scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, q_offset, kv_offset,
+               causal, sm_scale, block_q, block_k, interpret):
+    out = flash_attention(
+        q, k, v, q_offset, kv_offset,
+        causal, sm_scale, block_q, block_k, interpret,
+    )
+    return out, (q, k, v, q_offset, kv_offset)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret,
+               residuals, grads):
+    q, k, v, q_offset, kv_offset = residuals
+
+    def ref(q_, k_, v_):
+        return attention_reference(
+            q_, k_, v_, causal=causal,
+            q_offset=q_offset, kv_offset=kv_offset, sm_scale=sm_scale,
+        )
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(grads)
+    return dq, dk, dv, None, None
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher
+# ---------------------------------------------------------------------------
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_offset: jax.Array | int = 0,
+    kv_offset: jax.Array | int = 0,
+    sm_scale: Optional[float] = None,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Chunk attention with LSE; ``impl`` in {auto, xla, pallas,
+    pallas_interpret}. ``auto`` picks the Pallas kernel on TPU and the
+    XLA path elsewhere (CPU-simulated meshes in tests)."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return attention_reference(
+            q, k, v, causal=causal,
+            q_offset=q_offset, kv_offset=kv_offset, sm_scale=sm_scale,
+        )
+    if impl in ("pallas", "pallas_interpret"):
+        return flash_attention(
+            q, k, v,
+            jnp.asarray(q_offset, jnp.int32),
+            jnp.asarray(kv_offset, jnp.int32),
+            causal, sm_scale, block_q, block_k,
+            impl == "pallas_interpret",
+        )
+    raise ValueError(f"unknown attention impl: {impl!r}")

@@ -34,6 +34,11 @@ import jax
 import jax.numpy as jnp
 
 Constrain = Callable[[jax.Array], jax.Array]
+# (q [B,S,Hq,D], k [B,S,Hkv,D], v) -> [B,S,Hq,D]; plugs ring/Ulysses
+# sequence-parallel attention (parallel/ring_attention.py,
+# parallel/sp_ulysses.py) into the block without the model knowing
+# about meshes. None -> local full attention.
+AttnFn = Optional[Callable[[jax.Array, jax.Array, jax.Array], jax.Array]]
 
 
 def _identity(x: jax.Array) -> jax.Array:
@@ -166,6 +171,7 @@ class Attention(nn.Module):
 
     cfg: LlamaConfig
     out_std: float
+    attn_fn: AttnFn = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -180,23 +186,24 @@ class Attention(nn.Module):
         k = _dense(n_kv * hd, std, cfg.dtype, "wk")(x)
         v = _dense(n_kv * hd, std, cfg.dtype, "wv")(x)
 
-        q = q.reshape(b, s, n_kv, groups, hd)
-        k = k.reshape(b, s, n_kv, hd)
-        v = v.reshape(b, s, n_kv, hd)
-
         cos, sin = rope_cos_sin(s, hd)
         q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), cos, sin)
-        q = q.reshape(b, s, n_kv, groups, hd)
-        k = apply_rope(k, cos, sin)
+        k = apply_rope(k.reshape(b, s, n_kv, hd), cos, sin)
+        v = v.reshape(b, s, n_kv, hd)
 
-        # scores [B, Hkv, G, S, S], fp32 softmax with causal mask.
-        scale = hd ** -0.5
-        scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k) * scale
-        scores = scores.astype(jnp.float32)
-        causal = jnp.tril(jnp.ones((s, s), dtype=bool))
-        scores = jnp.where(causal, scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+        if self.attn_fn is not None:
+            out = self.attn_fn(q, k, v)
+        else:
+            # scores [B, Hkv, G, S, S], fp32 softmax, causal mask; GQA
+            # via a grouped query view -- no materialised repeat_kv.
+            q = q.reshape(b, s, n_kv, groups, hd)
+            scale = hd ** -0.5
+            scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k) * scale
+            scores = scores.astype(jnp.float32)
+            causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+            scores = jnp.where(causal, scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
         out = out.reshape(b, s, cfg.n_heads * hd)
         return _dense(cfg.dim, self.out_std, cfg.dtype, "wo")(out)
 
@@ -229,6 +236,7 @@ class TransformerBlock(nn.Module):
     cfg: LlamaConfig
     layer_id: int
     constrain: Constrain = _identity
+    attn_fn: AttnFn = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -238,7 +246,7 @@ class TransformerBlock(nn.Module):
         )
         out_std = 0.02 / (2 * depth) ** 0.5
         h = x + self.constrain(
-            Attention(cfg, out_std, name="attention")(
+            Attention(cfg, out_std, self.attn_fn, name="attention")(
                 RMSNorm(cfg.norm_eps, name="attention_norm")(x)
             )
         )
@@ -255,6 +263,7 @@ class Llama(nn.Module):
 
     cfg: LlamaConfig
     constrain: Constrain = _identity
+    attn_fn: AttnFn = None
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -272,7 +281,9 @@ class Llama(nn.Module):
         if cfg.remat:
             block = nn.remat(TransformerBlock)
         for i in range(cfg.n_layers):
-            x = block(cfg, i, self.constrain, name=f"layers_{i}")(x)
+            x = block(
+                cfg, i, self.constrain, self.attn_fn, name=f"layers_{i}"
+            )(x)
         x = RMSNorm(cfg.norm_eps, name="norm")(x)
         logits = nn.Dense(
             cfg.vocab_size,
@@ -288,6 +299,9 @@ class Llama(nn.Module):
 def init_llama(
     rng: jax.Array, cfg: LlamaConfig, constrain: Constrain = _identity
 ) -> Dict:
+    # attn_fn never affects the param tree (the attention op itself is
+    # parameter-free), so init always uses the local-attention path --
+    # a mesh-bound attn_fn could not run on the tiny init sample anyway.
     model = Llama(cfg, constrain)
     sample = jnp.zeros((1, min(8, cfg.max_seq_len)), jnp.int32)
     return model.init(rng, sample)["params"]
@@ -298,19 +312,24 @@ def apply_llama(
     tokens: jax.Array,
     cfg: LlamaConfig,
     constrain: Constrain = _identity,
+    attn_fn: AttnFn = None,
 ) -> jax.Array:
     """[B, S] int tokens -> [B, S, vocab] fp32 logits."""
-    return Llama(cfg, constrain).apply({"params": params}, tokens)
+    return Llama(cfg, constrain, attn_fn).apply({"params": params}, tokens)
 
 
-def make_forward(cfg: LlamaConfig, constrain: Constrain = _identity):
+def make_forward(
+    cfg: LlamaConfig,
+    constrain: Constrain = _identity,
+    attn_fn: AttnFn = None,
+):
     """Trainer-contract forward: next-token cross-entropy on (inputs,
     targets) token batches (datasets.TokenStream)."""
     from tpu_hpc.models.losses import cross_entropy
 
     def forward(params, model_state, batch, step_rng):
         inputs, targets = batch
-        logits = apply_llama(params, inputs, cfg, constrain)
+        logits = apply_llama(params, inputs, cfg, constrain, attn_fn)
         return cross_entropy(logits, targets), model_state, {}
 
     return forward

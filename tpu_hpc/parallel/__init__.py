@@ -3,4 +3,17 @@ from tpu_hpc.parallel.plans import (  # noqa: F401
     pspec_tree,
     shardings_for,
 )
-from tpu_hpc.parallel import dp, fsdp, hybrid, pp, tp  # noqa: F401
+from tpu_hpc.parallel import (  # noqa: F401
+    dp,
+    fsdp,
+    hybrid,
+    pp,
+    ring_attention,
+    sp_ulysses,
+    tp,
+)
+# Megatron-SP (norms/elementwise on sequence-sharded activations
+# between TP blocks) lives in tp.sp_constrain -- it is an activation
+# layout of the TP recipe, not a separate mechanism (SURVEY.md 5.7).
+sp_megatron = tp
+

@@ -138,7 +138,23 @@ def _fwd_program(stage_fn: StageFn, axis: str, n_stages: int):
     return program
 
 
-def _fwd_bwd_program_1f1b(stage_fn: StageFn, axis: str, n_stages: int):
+def _spec_axes(spec: P) -> tuple:
+    """Mesh axes mentioned in a PartitionSpec (flattening tuples)."""
+    axes = []
+    for part in spec:
+        if part is None:
+            continue
+        if isinstance(part, (tuple, list)):
+            axes.extend(part)
+        else:
+            axes.append(part)
+    return tuple(axes)
+
+
+def _fwd_bwd_program_1f1b(
+    stage_fn: StageFn, axis: str, n_stages: int,
+    grad_reduce_axes: tuple = (),
+):
     """The 1F1B combined forward+backward tick loop (under shard_map).
 
     Schedule (stage s, 0-indexed): forward of microbatch f at tick
@@ -218,6 +234,15 @@ def _fwd_bwd_program_1f1b(stage_fn: StageFn, axis: str, n_stages: int):
         (_, _, _, grads, gxs), _ = jax.lax.scan(
             tick, carry0, jnp.arange(M + 2 * S - 1)
         )
+        # Stage params are replicated over any batch-sharding axes
+        # (e.g. "data" in a PPxDP mesh), so each data shard has only
+        # its own microbatches' contribution -- sum them. This is the
+        # psum shard_map's own transpose inserts on the GPipe path;
+        # a custom_vjp must supply it by hand.
+        if grad_reduce_axes:
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, grad_reduce_axes), grads
+            )
         # grads are per-stage-local: restore the stacked leading dim.
         grads = jax.tree.map(lambda g: g[None], grads)
         # gxs lives on stage 0 only; broadcast like the forward outputs.
@@ -262,8 +287,9 @@ def pipelined(
     if schedule != "1f1b":
         raise ValueError(f"unknown schedule {schedule!r} (gpipe|1f1b)")
 
+    reduce_axes = tuple(a for a in _spec_axes(batch_spec) if a != axis)
     bwd = jax.shard_map(
-        _fwd_bwd_program_1f1b(stage_fn, axis, S),
+        _fwd_bwd_program_1f1b(stage_fn, axis, S, reduce_axes),
         mesh=mesh,
         in_specs=(P(axis), batch_spec, batch_spec),
         out_specs=(P(axis), batch_spec),

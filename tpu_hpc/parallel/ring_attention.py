@@ -1,0 +1,142 @@
+"""Ring Attention: context parallelism over the sequence dimension.
+
+Implements what the reference only documents (the Ring Attention
+pseudocode in docs/guide/08_sequence_parallel.md:84-142 -- K/V ring
+rotation with online-softmax/LSE merge; the `scripts/05_sequence_
+parallel_sp` directory it advertises does not exist, SURVEY.md 0).
+
+TPU-native design: the ICI torus is literally a ring, so the K/V
+rotation is a single `ppermute` hop per step riding neighbor links,
+overlapped by XLA with the blockwise attention compute. Each device
+holds one sequence chunk of Q/K/V; at step i it attends its Q chunk
+against the KV chunk that originated on device (me - i) mod n, merges
+via the exact LSE identity (kernels/attention.py), and forwards KV to
+its right neighbor. The blockwise compute is the Pallas flash kernel on
+TPU (causal blocks above the diagonal skipped in-kernel), the XLA path
+on CPU meshes.
+
+Unlike Ulysses (sp_ulysses.py) there is no head-count constraint and
+the memory/comm pattern scales across hosts (DCN) -- the tradeoff table
+the reference gives in 08_sequence_parallel.md:144-154.
+
+Known further optimisation (later round): zigzag chunk ordering to
+balance causal work across the ring.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_hpc.kernels.attention import blockwise_attention, lse_merge, MASK_VALUE
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """In-shard_map form. q: [B, S_local, Hq, D]; k, v: [B, S_local,
+    Hkv, D] -- the local sequence shards. Returns [B, S_local, Hq, D].
+
+    GQA (Hkv < Hq) is handled by repeating KV chunk-locally -- the
+    ring only ever moves the small Hkv chunks.
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    groups = q.shape[2] // k.shape[2]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def chunk(k_cur, v_cur, step):
+        if groups > 1:
+            k_cur = jnp.repeat(k_cur, groups, axis=2)
+            v_cur = jnp.repeat(v_cur, groups, axis=2)
+        # After `step` rotations device `me` holds the chunk that
+        # originated on device (me - step) mod n.
+        src = jax.lax.rem(me - step + n, n)
+        return blockwise_attention(
+            q, k_cur, v_cur,
+            causal=causal,
+            q_offset=me * s_local,
+            kv_offset=src * s_local,
+            impl=impl, block_q=block_q, block_k=block_k,
+        )
+
+    def body(carry, step):
+        k_cur, v_cur, out, lse = carry
+        o_i, lse_i = chunk(k_cur, v_cur, step)
+        out, lse = lse_merge(out, lse, o_i.astype(jnp.float32), lse_i)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, out, lse), None
+
+    out0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full(q.shape[:3], MASK_VALUE, jnp.float32)
+    (k_last, v_last, out, lse), _ = jax.lax.scan(
+        body, (k, v, out0, lse0), jnp.arange(n - 1)
+    )
+    # Final step needs no trailing rotation (saves one KV ring hop).
+    o_i, lse_i = chunk(k_last, v_last, n - 1)
+    out, lse = lse_merge(out, lse, o_i.astype(jnp.float32), lse_i)
+    return out.astype(q.dtype)
+
+
+def make_ring_attn_fn(
+    mesh: Mesh,
+    dp_axis: Optional[str] = "data",
+    sp_axis: str = "context",
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+    """Model-facing attention hook (models/llama2.py ``attn_fn``):
+    wraps ``ring_attention`` in a shard_map over (batch=dp, seq=sp) so
+    it drops into an otherwise GSPMD-jitted step."""
+    spec = P(dp_axis, sp_axis, None, None)
+
+    def inner(q, k, v):
+        return ring_attention(
+            q, k, v, sp_axis,
+            causal=causal, impl=impl, block_q=block_q, block_k=block_k,
+        )
+
+    def attn_fn(q, k, v):
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    return attn_fn
+
+
+def cp_constrain(
+    mesh: Mesh,
+    dp_axis: Optional[str] = "data",
+    sp_axis: str = "context",
+) -> Callable[[jax.Array], jax.Array]:
+    """Context-parallel activation layout: residual-stream [B, S, D]
+    activations sequence-sharded on ``sp_axis`` everywhere. Everything
+    except attention is token-local, so GSPMD keeps it communication-
+    free; attention itself is the ring (make_ring_attn_fn)."""
+    from jax.sharding import NamedSharding
+
+    spec = NamedSharding(mesh, P(dp_axis, sp_axis, None))
+
+    def constrain(x: jax.Array) -> jax.Array:
+        if x.ndim == 3:
+            return jax.lax.with_sharding_constraint(x, spec)
+        return x
+
+    return constrain

@@ -1,0 +1,105 @@
+"""DeepSpeed-Ulysses sequence parallelism: all-to-all head scatter.
+
+Implements the design the reference documents but never ships
+(docs/guide/08_sequence_parallel.md:43-80: all-to-all scatter-heads /
+gather-sequence before attention, the inverse after; head-count
+divisibility constraint; best within a node -- here, within an ICI
+axis).
+
+TPU-native: `jax.lax.all_to_all` over a mesh axis lowers to the XLA
+AllToAll riding ICI. Inside the exchange each device holds the *full*
+sequence for H/n heads, so plain (flash) attention applies -- no LSE
+merging needed, which is why Ulysses is the cheap option when the head
+count allows it (tradeoff vs ring: 08_sequence_parallel.md:144-154).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_hpc.kernels.attention import blockwise_attention
+
+
+def validate_ulysses_degree(n_heads: int, degree: int) -> None:
+    """Ulysses shards heads across the sequence group: Hq % n == 0
+    (the constraint documented at 08_sequence_parallel.md:74-77)."""
+    if n_heads % degree != 0:
+        raise ValueError(
+            f"Ulysses needs n_heads % degree == 0, got "
+            f"{n_heads} % {degree}"
+        )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """In-shard_map form. q: [B, S_local, Hq, D]; k, v: [B, S_local,
+    Hkv, D]. All-to-all to [B, S, H/n, D], full attention locally,
+    all-to-all back. KV heads are repeated up to Hq first when GQA
+    grouping does not divide by the degree."""
+    n = jax.lax.axis_size(axis_name)
+    validate_ulysses_degree(q.shape[2], n)
+    if k.shape[2] % n != 0:
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+
+    def scatter_heads(x):  # [B, S_local, H, D] -> [B, S, H/n, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    groups = qg.shape[2] // kg.shape[2]
+    if groups > 1:
+        kg = jnp.repeat(kg, groups, axis=2)
+        vg = jnp.repeat(vg, groups, axis=2)
+    out, _ = blockwise_attention(
+        qg, kg, vg, causal=causal,
+        impl=impl, block_q=block_q, block_k=block_k,
+    )
+    # gather heads / scatter sequence: the inverse exchange.
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def make_ulysses_attn_fn(
+    mesh: Mesh,
+    dp_axis: Optional[str] = "data",
+    sp_axis: str = "context",
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+    """Model-facing attention hook (models/llama2.py ``attn_fn``),
+    mirror of ring_attention.make_ring_attn_fn."""
+    spec = P(dp_axis, sp_axis, None, None)
+
+    def inner(q, k, v):
+        return ulysses_attention(
+            q, k, v, sp_axis,
+            causal=causal, impl=impl, block_q=block_q, block_k=block_k,
+        )
+
+    def attn_fn(q, k, v):
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    return attn_fn

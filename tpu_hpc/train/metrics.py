@@ -83,6 +83,9 @@ def mfu(
 
 
 def pipeline_bubble_fraction(n_stages: int, n_microbatches: int) -> float:
-    """(stages-1)/microbatches -- parity: 03_pipeline_training.py:292,
-    docs/guide/07_pipeline_parallel.md:127-143."""
-    return (n_stages - 1) / n_microbatches
+    """Exact pipeline idle fraction; delegates to the single source of
+    truth in parallel/pp.py (the reference reports the (S-1)/M
+    approximation instead -- 03_pipeline_training.py:292)."""
+    from tpu_hpc.parallel.pp import bubble_fraction
+
+    return bubble_fraction(n_stages, n_microbatches)
