@@ -1,20 +1,160 @@
 """Headline benchmark: prints ONE JSON line for the driver.
 
-Current flagship metric (round 1): SimpleUNet DP training throughput
-(samples/s) on the available chip(s) -- the reference's own
-instrumented workload (multinode_ddp_unet.py:348-397). Will move to
-Llama-2 tokens/sec/chip + MFU once the hybrid recipe lands.
+Flagship metric: Llama-2 training throughput in tokens/sec/chip with
+MFU accounting -- the BASELINE.md north-star metric (Llama-2 hybrid
+FSDPxTP at >=40% MFU; the reference itself publishes no measured
+throughput, so ``vs_baseline`` reports achieved-MFU / 0.40 against
+that stated target). Runs whatever chips are visible: 1 chip = pure
+compute path (TP/FSDP add nothing on one device), N chips = hybrid
+recipe via the same code path as examples/06.
 
-vs_baseline: the reference publishes no measured throughput
-(BASELINE.md), so vs_baseline is reported as 1.0 by convention when no
-comparable number exists.
+The model is sized to the single-chip HBM (v5e ~16 GiB): a ~170M-param
+Llama with head_dim 128 (MXU-native), seq 2048, bf16 compute, per-block
+remat, and the Pallas flash-attention kernel.
+
+Secondary workload: ``--workload unet`` keeps the reference's own
+instrumented DP U-Net throughput (multinode_ddp_unet.py:348-397).
 """
+import argparse
 import json
 import sys
 
+# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
 
-def main() -> int:
+
+def peak_flops_per_chip(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 197e12  # conservative default (v5e class)
+
+
+def bench_llama(
+    steps: int = 20, remat: bool = False, batch_per_dp: int = 4,
+    attn: str = "flash",
+) -> dict:
+    """Best measured single-chip config (v5e): no remat (model fits
+    HBM comfortably; remat costs ~14% -- 40.8% vs 47.2% MFU), Pallas
+    flash attention (+8 MFU points over the XLA einsum path), batch 4
+    (batch 8 loses ~3.6 points to memory pressure)."""
     import jax
+    import jax.numpy as jnp
+
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.kernels.attention import blockwise_attention
+    from tpu_hpc.models import datasets, llama2
+    from tpu_hpc.parallel import fsdp, hybrid, tp
+    from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+    from tpu_hpc.train import Trainer
+
+    init_distributed(verbose=False)
+    n_dev = jax.device_count()
+    model_cfg = llama2.LlamaConfig(
+        dim=1024, n_layers=8, n_heads=8, vocab_size=32000,
+        multiple_of=256, max_seq_len=2048, remat=remat,
+    )
+
+    def flash(q, k, v):
+        # Pallas flash on TPU, XLA path elsewhere.
+        if q.shape[2] != k.shape[2]:
+            g = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
+        out, _ = blockwise_attention(q, k, v, causal=True)
+        return out
+
+    def make_attn_fn(mesh, tp_size):
+        if attn == "xla":
+            return None  # the model's einsum path (XLA-fused)
+        if mesh.size == 1:
+            return flash
+        # Multi-chip: XLA has no SPMD partitioning rule for a Pallas
+        # call, so run it under shard_map -- heads on the TP axis
+        # (each shard does full-sequence attention for its heads),
+        # batch on data.
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("data", None, "model" if tp_size > 1 else None, None)
+        return jax.shard_map(
+            flash, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False,
+        )
+
+    tp_size = tp.auto_tp_degree(
+        n_dev, model_cfg.n_heads, model_cfg.kv_heads, cap=4
+    ) if n_dev > 1 else 1
+    dp_size = n_dev // tp_size
+    axes = {"data": dp_size}
+    if tp_size > 1:
+        axes["model"] = tp_size
+    mesh = build_mesh(MeshSpec(axes=axes))
+
+    params = llama2.init_llama(jax.random.key(0), model_cfg)
+    if tp_size > 1:
+        specs = hybrid.hybrid_pspecs(
+            params, tp.llama_rules(), data_size=dp_size
+        )
+        constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
+    elif dp_size > 1:
+        specs = fsdp.param_pspecs(params, axis="data", axis_size=dp_size)
+        constrain = lambda x: x  # noqa: E731
+    else:
+        specs = None
+        constrain = lambda x: x  # noqa: E731
+
+    cfg = TrainingConfig(
+        epochs=2,  # epoch 0 absorbs compilation; epoch 1 is measured
+        steps_per_epoch=steps,
+        global_batch_size=batch_per_dp * dp_size,
+        learning_rate=3e-4,
+        weight_decay=0.1,
+    )
+    ds = datasets.TokenStream(
+        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
+    )
+    trainer = Trainer(
+        cfg, mesh,
+        llama2.make_forward(
+            model_cfg, constrain, make_attn_fn(mesh, tp_size)
+        ),
+        params, param_pspecs=specs,
+    )
+    result = trainer.fit(ds)
+    summary = result["epochs"][-1]
+    tokens_per_s = summary["items_per_s"] * model_cfg.max_seq_len
+    flops_per_token = model_cfg.flops_per_token(model_cfg.max_seq_len)
+    peak = peak_flops_per_chip(jax.devices()[0])
+    mfu = tokens_per_s * flops_per_token / (peak * n_dev)
+    print(
+        f"llama bench | mesh {axes} | {tokens_per_s:.0f} tokens/s | "
+        f"{tokens_per_s / n_dev:.0f} tokens/s/chip | MFU {mfu:.1%} "
+        f"(peak {peak / 1e12:.0f} TF/chip, "
+        f"{flops_per_token / 1e6:.0f} MFLOP/token)",
+        file=sys.stderr,
+    )
+    return {
+        "metric": "llama2_train_tokens_per_s_per_chip",
+        "value": round(tokens_per_s / n_dev, 1),
+        "unit": "tokens/s/chip",
+        # Reference publishes no measured numbers (BASELINE.md);
+        # compare against its stated >=40%-MFU target instead.
+        "vs_baseline": round(mfu / 0.40, 3),
+    }
+
+
+def bench_unet(steps: int = 20) -> dict:
+    import jax
+    import jax.numpy as jnp
 
     from tpu_hpc.config import TrainingConfig
     from tpu_hpc.models import datasets, losses
@@ -23,15 +163,10 @@ def main() -> int:
     from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
     from tpu_hpc.train import Trainer
 
-    import jax.numpy as jnp
-
     init_distributed(verbose=False)
-    # epochs=2: epoch 0 absorbs compilation, epoch 1 is the measurement
-    # (same reason the reference skips the first batch in its
-    # throughput accounting, multinode_ddp_unet.py:363).
     cfg = TrainingConfig(
         epochs=2,
-        steps_per_epoch=20,
+        steps_per_epoch=steps,
         global_batch_size=8 * jax.device_count(),
         learning_rate=1e-3,
     )
@@ -56,16 +191,30 @@ def main() -> int:
     )
     result = trainer.fit(ds)
     summary = result["epochs"][-1]
-    print(
-        json.dumps(
-            {
-                "metric": "unet_dp_train_throughput",
-                "value": round(summary["items_per_s"], 2),
-                "unit": "samples/s",
-                "vs_baseline": 1.0,
-            }
-        )
+    return {
+        "metric": "unet_dp_train_throughput",
+        "value": round(summary["items_per_s"], 2),
+        "unit": "samples/s",
+        "vs_baseline": 1.0,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--workload", choices=("llama", "unet"), default="llama"
     )
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--attn", choices=("flash", "xla"), default="flash")
+    args = ap.parse_args()
+    rec = (
+        bench_llama(args.steps, args.remat, args.batch, args.attn)
+        if args.workload == "llama"
+        else bench_unet(args.steps)
+    )
+    print(json.dumps(rec))
     return 0
 
 

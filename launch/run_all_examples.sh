@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Smoke-run every parallelism strategy end-to-end -- the per-strategy
+# PBS runners collapsed into one script (parity:
+# run_tensor_parallel.sh:64-78 runs all TP examples,
+# run_pipeline_parallel.sh:73-92 runs both schedules, etc.).
+#
+# Local / simulated: SIM=8 ./run_all_examples.sh
+# On a slice:        via ./tpu_vm_run.sh launch/run_all_examples.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SIM="${SIM:-}"
+if [[ -n "${SIM}" ]]; then
+    export TPU_HPC_SIM_DEVICES="${SIM}"
+    echo ">> simulated ${SIM}-device CPU mesh"
+fi
+FAST="--epochs 1 --steps-per-epoch 3 --global-batch-size 8"
+
+run() { echo; echo "=== $* ==="; python "$@"; }
+
+run examples/01_data_parallel_dp/train_unet_dp.py       ${FAST}
+run examples/02_fully_sharded_fsdp/train_unet_fsdp.py   ${FAST}
+run examples/03_tensor_parallel_tp/train_llama_tp.py    ${FAST}
+run examples/03_tensor_parallel_tp/train_vit_tp.py      ${FAST} --global-batch-size 4
+run examples/04_pipeline_parallel_pp/train_pipeline.py  ${FAST} --global-batch-size 16 --schedule gpipe
+run examples/04_pipeline_parallel_pp/train_pipeline.py  ${FAST} --global-batch-size 16 --schedule 1f1b
+run examples/05_sequence_parallel/train_llama_sp.py     ${FAST} --global-batch-size 4 --attn ring --seq-len 128
+run examples/05_sequence_parallel/train_llama_sp.py     ${FAST} --global-batch-size 4 --attn ulysses --seq-len 128
+run examples/06_hybrid_parallelism/train_llama_hybrid.py ${FAST}
+run examples/07_domain_parallel/train_domain_parallel.py --demo
+run examples/07_domain_parallel/train_domain_parallel.py ${FAST} --global-batch-size 4 --lat 32 --lon 64 --hidden 16
+
+echo; echo "ALL EXAMPLES COMPLETED"

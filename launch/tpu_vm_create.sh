@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Provision a TPU pod slice (the reference's "#PBS -l select=..." block,
+# torchrun_multigpu_pbs.sh:7-16, re-expressed as a queued resource).
+# Copy, edit the variables, run. Requires: gcloud auth + quota.
+set -euo pipefail
+
+# ---- edit these -------------------------------------------------------------
+TPU_NAME="${TPU_NAME:-tpu-hpc-dev}"
+ZONE="${ZONE:-us-central2-b}"
+ACCELERATOR_TYPE="${ACCELERATOR_TYPE:-v4-32}"   # v4-8 | v4-32 | v5litepod-16 ...
+RUNTIME_VERSION="${RUNTIME_VERSION:-tpu-ubuntu2204-base}"
+SPOT="${SPOT:-false}"                           # preemptible capacity
+# -----------------------------------------------------------------------------
+
+extra=()
+[[ "${SPOT}" == "true" ]] && extra+=(--spot)
+
+echo ">> creating ${ACCELERATOR_TYPE} slice '${TPU_NAME}' in ${ZONE}"
+gcloud compute tpus queued-resources create "${TPU_NAME}-qr" \
+    --node-id "${TPU_NAME}" \
+    --zone "${ZONE}" \
+    --accelerator-type "${ACCELERATOR_TYPE}" \
+    --runtime-version "${RUNTIME_VERSION}" \
+    "${extra[@]}"
+
+echo ">> waiting for the slice to become ACTIVE"
+gcloud compute tpus queued-resources describe "${TPU_NAME}-qr" \
+    --zone "${ZONE}" --format='value(state.state)'
+
+cat <<EOF
+Next steps:
+  ./tpu_vm_setup.sh     # install the framework on every worker
+  ./tpu_vm_run.sh examples/06_hybrid_parallelism/train_llama_hybrid.py
+EOF

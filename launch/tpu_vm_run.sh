@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Run a training script on every worker of a slice -- the mpiexec
+# equivalent (reference: `mpiexec -n $TOTAL --ppn 4 --cpu-bind none
+# python <example>.py`, run_fsdp.sh:63-70). One process per host, 4
+# chips each; jax.distributed.initialize() inside the framework does
+# the rendezvous the reference needed MASTER_ADDR/MPI broadcasts for
+# (utils/distributed.py:103-121).
+#
+# Usage:
+#   ./tpu_vm_run.sh examples/02_fully_sharded_fsdp/train_unet_fsdp.py --epochs 3
+#   LOG_DIR=logs ./tpu_vm_run.sh bench.py
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:-tpu-hpc-dev}"
+ZONE="${ZONE:-us-central2-b}"
+LOG_DIR="${LOG_DIR:-}"
+
+SCRIPT="${1:?usage: tpu_vm_run.sh <script.py> [args...]}"
+shift || true
+ARGS="$*"
+
+# Per-worker output capture (parity: the per-rank redirect
+# utils/redirect.py -- here stdout tee'd per worker by gcloud).
+REDIRECT=""
+if [[ -n "${LOG_DIR}" ]]; then
+    REDIRECT="mkdir -p ~/tpu_hpc_logs && exec > >(tee ~/tpu_hpc_logs/\$(hostname).out) 2>&1;"
+fi
+
+echo ">> launching ${SCRIPT} ${ARGS} on all workers of ${TPU_NAME}"
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
+    --command "
+        ${REDIRECT}
+        source ~/tpu-hpc-venv/bin/activate
+        cd ~/tpu_hpc_repo
+        python ${SCRIPT} ${ARGS}
+    "
+
+if [[ -n "${LOG_DIR}" ]]; then
+    mkdir -p "${LOG_DIR}"
+    gcloud compute tpus tpu-vm scp --recurse \
+        "${TPU_NAME}:~/tpu_hpc_logs/*" "${LOG_DIR}/" \
+        --zone "${ZONE}" --worker=all || true
+fi

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Install the framework on every worker of a slice (the reference's
+# "module load conda; conda activate" block, run_fsdp.sh:18-22 -- here a
+# one-time rsync + pip install instead of a shared filesystem module).
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:-tpu-hpc-dev}"
+ZONE="${ZONE:-us-central2-b}"
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+
+echo ">> copying the repo to all workers"
+gcloud compute tpus tpu-vm scp --recurse "${REPO_DIR}" "${TPU_NAME}:~/tpu_hpc_repo" \
+    --zone "${ZONE}" --worker=all
+
+echo ">> installing on all workers"
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
+    --command "
+        set -e
+        python3 -m venv ~/tpu-hpc-venv 2>/dev/null || true
+        source ~/tpu-hpc-venv/bin/activate
+        pip -q install -U pip
+        pip -q install 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+        pip -q install -e ~/tpu_hpc_repo
+        python -c 'import tpu_hpc, jax; print(jax.devices())'
+    "
+echo ">> done; use ./tpu_vm_run.sh to launch training"

@@ -1,0 +1,89 @@
+"""Native C++ data pipeline: build, determinism, prefetch ordering,
+statistics, and Trainer integration via the host-fed path."""
+import numpy as np
+import pytest
+
+from tpu_hpc.native import NativeERA5Stream, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="g++ unavailable"
+)
+
+
+def make_stream(**kw):
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("lat", 8)
+    kw.setdefault("lon", 16)
+    kw.setdefault("channels", 3)
+    return NativeERA5Stream(**kw)
+
+
+def test_deterministic_across_instances():
+    a = make_stream(seed=7)
+    b = make_stream(seed=7)
+    xa, ya = a.batch_at(0, 4)
+    xb, yb = b.batch_at(0, 4)
+    np.testing.assert_array_equal(xa, xb)
+    np.testing.assert_array_equal(ya, yb)
+    a.close(); b.close()
+
+
+def test_random_access_equals_sequential():
+    """Prefetch-ring batches must be byte-identical to synchronous
+    random-access generation (the determinism contract)."""
+    seq = make_stream(seed=3)
+    ra = make_stream(seed=3)
+    got = [seq.next() for _ in range(5)]
+    # Out-of-order access on the second stream bypasses its ring.
+    for step in (4, 2, 0, 3, 1):
+        x, y = ra.batch_at(step, 4)
+        np.testing.assert_array_equal(x, got[step][0])
+        np.testing.assert_array_equal(y, got[step][1])
+    seq.close(); ra.close()
+
+
+def test_distinct_steps_and_seeds():
+    s = make_stream(seed=0)
+    x0, _ = s.batch_at(0, 4)
+    x1, _ = s.batch_at(1, 4)
+    assert np.abs(x0 - x1).max() > 0.1
+    s.close()
+    s2 = make_stream(seed=1)
+    x0b, _ = s2.batch_at(0, 4)
+    assert np.abs(x0 - x0b).max() > 0.1
+    s2.close()
+
+
+def test_gaussian_statistics():
+    s = make_stream(batch_size=32, lat=16, lon=32, channels=4)
+    x, y = s.batch_at(0, 32)
+    assert abs(float(x.mean())) < 0.02
+    assert abs(float(x.std()) - 1.0) < 0.02
+    # y = 0.5x + 0.1n -> residual std 0.1.
+    resid = y - 0.5 * x
+    assert abs(float(resid.std()) - 0.1) < 0.01
+    s.close()
+
+
+def test_trainer_host_fed_path(mesh8):
+    """The stream satisfies the Trainer's dataset contract (no
+    traced_batch attribute -> per-step host-fed loop)."""
+    import jax.numpy as jnp
+
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.train import Trainer
+
+    s = make_stream(batch_size=8, lat=8, lon=16, channels=3)
+    params = {"w": jnp.zeros((3, 3))}
+
+    def forward(p, ms, batch, rng):
+        x, y = batch
+        pred = jnp.einsum("bhwc,cd->bhwd", x, p["w"])
+        return jnp.mean((pred - y) ** 2), ms, {}
+
+    cfg = TrainingConfig(
+        epochs=1, steps_per_epoch=3, global_batch_size=8
+    )
+    result = Trainer(cfg, mesh8, forward, params).fit(s)
+    assert np.isfinite(result["final_loss"])
+    s.close()

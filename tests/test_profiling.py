@@ -1,0 +1,73 @@
+"""Profiling wrapper: window triggering, trace artifacts, Trainer wiring."""
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_hpc.profiling import TrainingProfiler, training_profiler
+
+
+def test_window_triggering(tmp_path):
+    prof = TrainingProfiler(str(tmp_path), start_step=2, num_steps=3)
+    prof.step(0)
+    assert not prof.active
+    prof.step(2)
+    assert prof.active
+    jnp.ones(8).block_until_ready()  # give the trace something
+    prof.step(5)
+    assert not prof.active
+    # A trace directory with events must exist (TensorBoard layout).
+    assert glob.glob(
+        os.path.join(str(tmp_path), "plugins", "profile", "*")
+    )
+
+
+def test_chunk_boundary_triggering(tmp_path):
+    """Regression: chunked loops only call step() at epoch boundaries
+    (0, 20, 40...); a window like [3, 8) must still open at the first
+    boundary past start_step."""
+    prof = TrainingProfiler(str(tmp_path), start_step=3, num_steps=5)
+    prof.step(0)
+    assert not prof.active
+    prof.step(20)
+    assert prof.active
+    jnp.ones(8).block_until_ready()
+    prof.step(40)
+    assert not prof.active
+
+
+def test_context_manager_stops_on_error(tmp_path):
+    try:
+        with training_profiler(str(tmp_path), start_step=0) as prof:
+            prof.step(0)
+            assert prof.active
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert not prof.active
+
+
+def test_trainer_profile_flag(tmp_path, mesh8):
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.models import datasets
+    from tpu_hpc.train import Trainer
+
+    ds = datasets.ToyRegression()
+    params = {"w": jnp.zeros((20, 1))}
+
+    def forward(p, ms, batch, rng):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2), ms, {}
+
+    cfg = TrainingConfig(
+        epochs=2, steps_per_epoch=2, global_batch_size=8,
+        profile=True, profile_dir=str(tmp_path), profile_start_step=2,
+        profile_num_steps=2,
+    )
+    result = Trainer(cfg, mesh8, forward, params).fit(ds)
+    assert np.isfinite(result["final_loss"])
+    assert glob.glob(
+        os.path.join(str(tmp_path), "plugins", "profile", "*")
+    )

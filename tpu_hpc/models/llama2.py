@@ -62,6 +62,10 @@ class LlamaConfig:
     depth_init: bool = True
     dtype: Any = jnp.bfloat16  # compute dtype; params stay fp32
     remat: bool = False
+    # One-hot-matmul embedding lookup instead of gather: rides the MXU
+    # and its transpose is a matmul instead of a scatter-add (TPU
+    # scatters serialize -- this is the standard iota-embed trick).
+    iota_embed: bool = True
 
     @property
     def kv_heads(self) -> int:
@@ -276,7 +280,14 @@ class Llama(nn.Module):
             embedding_init=nn.initializers.normal(stddev=1.0),
             name="tok_embeddings",
         )
-        x = self.constrain(emb(tokens))
+        if cfg.iota_embed:
+            # lookup == one_hot @ table (exact: one-hot rows select the
+            # same bf16-cast values the gather would).
+            onehot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+            x = jnp.dot(onehot, emb.embedding.astype(cfg.dtype))
+        else:
+            x = emb(tokens)
+        x = self.constrain(x)
         block = TransformerBlock
         if cfg.remat:
             block = nn.remat(TransformerBlock)

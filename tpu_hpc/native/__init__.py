@@ -1,0 +1,4 @@
+from tpu_hpc.native.dataloader import (  # noqa: F401
+    NativeERA5Stream,
+    native_available,
+)

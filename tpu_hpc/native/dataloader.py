@@ -1,0 +1,167 @@
+"""ctypes binding for the C++ data pipeline (src/dataloader.cpp).
+
+The library is built on first use with g++ (no pybind11 in the image;
+ctypes keeps the binding dependency-free). Role parity with the
+reference's DataLoader(num_workers=4, pin_memory=True) input path
+(multinode_ddp_unet.py:283-292): background native threads keep batches
+ahead of the training loop.
+
+Use ``models.datasets.ERA5Synthetic`` (on-device traced generation) for
+synthetic benchmarks; use this loader where the host must produce the
+data (real datasets, CPU-side preprocessing).
+"""
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "dataloader.cpp")
+_LIB = os.path.join(_HERE, "libtpu_hpc_data.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> None:
+    subprocess.run(
+        [
+            "g++", "-O3", "-march=native", "-std=c++17", "-shared",
+            "-fPIC", "-pthread", _SRC, "-o", _LIB,
+        ],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            ):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+        except (OSError, subprocess.CalledProcessError) as e:
+            _build_error = str(e)
+            return None
+        lib.era5_gen.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.era5_prefetcher_create.restype = ctypes.c_void_p
+        lib.era5_prefetcher_create.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.era5_prefetcher_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.era5_prefetcher_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    """True when the C++ library built (g++ present); callers fall back
+    to the on-device generator otherwise."""
+    return _load() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+@dataclasses.dataclass
+class NativeERA5Stream:
+    """Host-side ERA5-like stream with native prefetching.
+
+    Same dataset contract as models/datasets.py (``batch_at(step,
+    batch_size)``; deterministic in (seed, step)) so the Trainer's
+    host-fed path accepts it directly. Sequential consumption rides the
+    C++ prefetch ring; random access falls back to synchronous
+    generation (still deterministic, same bytes).
+    """
+
+    batch_size: int
+    lat: int = 181
+    lon: int = 360
+    channels: int = 20
+    seed: int = 0
+    prefetch_depth: int = 4
+    n_threads: int = 2
+
+    def __post_init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                f"native dataloader unavailable: {_build_error}"
+            )
+        self._lib = lib
+        self._handle = lib.era5_prefetcher_create(
+            self.batch_size, self.lat, self.lon, self.channels,
+            self.seed, self.prefetch_depth, self.n_threads,
+        )
+        self._next_seq = 0
+
+    @property
+    def sample_shape(self) -> Tuple[int, int, int]:
+        return (self.lat, self.lon, self.channels)
+
+    def _alloc(self) -> Tuple[np.ndarray, np.ndarray]:
+        shape = (self.batch_size, self.lat, self.lon, self.channels)
+        return (
+            np.empty(shape, np.float32), np.empty(shape, np.float32)
+        )
+
+    def next(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Next sequential batch from the prefetch ring."""
+        x, y = self._alloc()
+        step = ctypes.c_int64()
+        self._lib.era5_prefetcher_next(
+            self._handle, _fptr(x), _fptr(y), ctypes.byref(step)
+        )
+        self._next_seq = step.value + 1
+        return x, y
+
+    def batch_at(self, step: int, batch_size: int):
+        """Random-access batch (Trainer contract). Sequential calls are
+        served by the prefetch ring; out-of-order steps generate
+        synchronously -- identical bytes either way."""
+        if batch_size != self.batch_size:
+            raise ValueError(
+                f"batch {batch_size} != stream batch {self.batch_size}"
+            )
+        if step == self._next_seq:
+            return self.next()
+        x, y = self._alloc()
+        self._lib.era5_gen(
+            self.batch_size, self.lat, self.lon, self.channels,
+            self.seed, step, _fptr(x), _fptr(y),
+        )
+        return x, y
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.era5_prefetcher_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,191 @@
+// Native host-side data pipeline: deterministic synthetic ERA5-like
+// batch generation + a threaded prefetch ring.
+//
+// Role parity: the reference's hot-loop input path is
+// DataLoader(pin_memory=True, num_workers=4) feeding H2D copies
+// (multinode_ddp_unet.py:283-292,334-339) -- CPython worker processes
+// around native torch collate kernels. Here the same layer is a small
+// C++ library driven through ctypes: worker threads generate batches
+// ahead of the training loop into a bounded ring so the host never
+// stalls the device queue. The on-device (traced) generator in
+// models/datasets.py stays the fast path for synthetic data; this is
+// the host path a real-dataset loader would extend (file readers drop
+// in where gen_batch() is).
+//
+// Determinism contract (matches models/datasets.py's index-stateless
+// design): batch contents depend only on (seed, step), never on thread
+// scheduling -- each step's batch is generated wholly by one worker
+// from a splitmix64-derived per-step stream.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// splitmix64: seed -> well-mixed 64-bit stream key.
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// xoshiro256** -- fast, high-quality, per-step-seeded.
+struct Rng {
+  uint64_t s[4];
+  explicit Rng(uint64_t seed) {
+    uint64_t x = seed;
+    for (auto& si : s) si = x = splitmix64(x);
+  }
+  static inline uint64_t rotl(uint64_t v, int k) {
+    return (v << k) | (v >> (64 - k));
+  }
+  inline uint64_t next() {
+    const uint64_t result = rotl(s[1] * 5, 7) * 9;
+    const uint64_t t = s[1] << 17;
+    s[2] ^= s[0]; s[3] ^= s[1]; s[1] ^= s[2]; s[0] ^= s[3];
+    s[2] ^= t; s[3] = rotl(s[3], 45);
+    return result;
+  }
+  // uniform in (0, 1]: never 0, so log() below is safe.
+  inline double uniform() {
+    return ((next() >> 11) + 1) * (1.0 / 9007199254740993.0);
+  }
+};
+
+struct GenConfig {
+  int64_t batch, lat, lon, ch;
+  uint64_t seed;
+  int64_t elems() const { return batch * lat * lon * ch; }
+};
+
+// Deterministic (seed, step) -> (x, y) batch. y = 0.5x + 0.1*noise,
+// the same learnable-signal scheme as datasets.ERA5Synthetic._gen.
+void gen_batch(const GenConfig& cfg, int64_t step, float* x, float* y) {
+  Rng rng(splitmix64(cfg.seed ^ splitmix64(static_cast<uint64_t>(step))));
+  const int64_t n = cfg.elems();
+  // Box-Muller, two normals per round.
+  for (int64_t i = 0; i < n; i += 2) {
+    double u1 = rng.uniform(), u2 = rng.uniform();
+    double r = std::sqrt(-2.0 * std::log(u1));
+    double a = 6.283185307179586 * u2;
+    x[i] = static_cast<float>(r * std::cos(a));
+    if (i + 1 < n) x[i + 1] = static_cast<float>(r * std::sin(a));
+  }
+  for (int64_t i = 0; i < n; i += 2) {
+    double u1 = rng.uniform(), u2 = rng.uniform();
+    double r = std::sqrt(-2.0 * std::log(u1));
+    double a = 6.283185307179586 * u2;
+    y[i] = 0.5f * x[i] + 0.1f * static_cast<float>(r * std::cos(a));
+    if (i + 1 < n)
+      y[i + 1] = 0.5f * x[i + 1] + 0.1f * static_cast<float>(r * std::sin(a));
+  }
+}
+
+struct Slot {
+  int64_t step;
+  std::vector<float> x, y;
+};
+
+// Bounded prefetch ring: workers claim the next step atomically,
+// generate into a free slot, publish; next() pops in step order.
+class Prefetcher {
+ public:
+  Prefetcher(GenConfig cfg, int depth, int n_threads)
+      : cfg_(cfg), depth_(depth), next_gen_(0), next_out_(0), stop_(false) {
+    for (int t = 0; t < n_threads; ++t)
+      workers_.emplace_back([this] { Work(); });
+  }
+
+  ~Prefetcher() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_free_.notify_all();
+    cv_ready_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void Next(float* x, float* y, int64_t* step_out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    const int64_t want = next_out_++;
+    cv_ready_.wait(lk, [&] { return ready_.count(want) || stop_; });
+    if (stop_) return;
+    Slot slot = std::move(ready_[want]);
+    ready_.erase(want);
+    lk.unlock();
+    cv_free_.notify_all();
+    std::memcpy(x, slot.x.data(), slot.x.size() * sizeof(float));
+    std::memcpy(y, slot.y.data(), slot.y.size() * sizeof(float));
+    *step_out = slot.step;
+  }
+
+ private:
+  void Work() {
+    for (;;) {
+      int64_t step;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_free_.wait(lk, [&] {
+          return stop_ ||
+                 (next_gen_ - next_out_) < static_cast<int64_t>(depth_);
+        });
+        if (stop_) return;
+        step = next_gen_++;
+      }
+      Slot slot;
+      slot.step = step;
+      slot.x.resize(cfg_.elems());
+      slot.y.resize(cfg_.elems());
+      gen_batch(cfg_, step, slot.x.data(), slot.y.data());
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        ready_[step] = std::move(slot);
+      }
+      cv_ready_.notify_all();
+    }
+  }
+
+  GenConfig cfg_;
+  int depth_;
+  int64_t next_gen_, next_out_;
+  bool stop_;
+  std::mutex mu_;
+  std::condition_variable cv_free_, cv_ready_;
+  std::map<int64_t, Slot> ready_;
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Synchronous deterministic generation (random access by step).
+void era5_gen(int64_t batch, int64_t lat, int64_t lon, int64_t ch,
+              uint64_t seed, int64_t step, float* x, float* y) {
+  GenConfig cfg{batch, lat, lon, ch, seed};
+  gen_batch(cfg, step, x, y);
+}
+
+void* era5_prefetcher_create(int64_t batch, int64_t lat, int64_t lon,
+                             int64_t ch, uint64_t seed, int depth,
+                             int n_threads) {
+  GenConfig cfg{batch, lat, lon, ch, seed};
+  return new Prefetcher(cfg, depth, n_threads);
+}
+
+void era5_prefetcher_next(void* p, float* x, float* y, int64_t* step_out) {
+  static_cast<Prefetcher*>(p)->Next(x, y, step_out);
+}
+
+void era5_prefetcher_destroy(void* p) { delete static_cast<Prefetcher*>(p); }
+
+}  // extern "C"

@@ -1,0 +1,5 @@
+from tpu_hpc.profiling.profiler import (  # noqa: F401
+    TrainingProfiler,
+    device_memory_summary,
+    training_profiler,
+)

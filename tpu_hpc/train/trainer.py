@@ -241,6 +241,14 @@ class Trainer:
         # the per-step loop. A resume landing mid-epoch runs a shorter
         # first chunk so checkpoint cadence stays epoch-aligned.
         scanned = hasattr(dataset, "traced_batch")
+        prof = None
+        if cfg.profile:
+            from tpu_hpc.profiling import TrainingProfiler
+
+            prof = TrainingProfiler(
+                cfg.profile_dir, cfg.profile_start_step,
+                cfg.profile_num_steps,
+            )
         done = start_step
         while done < total_steps:
             epoch = done // steps_per_epoch
@@ -254,6 +262,10 @@ class Trainer:
             # under-reports on asynchronous transports. Note: the chunk
             # containing the first step also pays XLA compilation.
             jax.device_get(self.state.step)  # drain pending work
+            if prof is not None:
+                # Chunked loops advance a whole epoch per dispatch, so
+                # the window opens/closes at chunk boundaries.
+                prof.step(done)
             self.meter.reset()
             self.meter.start_batch()
             if scanned:
@@ -286,6 +298,8 @@ class Trainer:
                 and done % (cfg.save_every * steps_per_epoch) == 0
             ):
                 self.checkpoint_manager.save(self.state)
+        if prof is not None:
+            prof.stop()
         return {
             "epochs": run_summaries,
             "final_loss": float(jax.device_get(last_metrics["loss"]))
