@@ -1,0 +1,93 @@
+"""ResNet CIFAR benchmark under DDP or FSDP (ZeRO-3).
+
+Parity with two reference workloads in one script:
+  * scripts/main.py:249,268-306 -- the ResNet-18/50/101/152 CIFAR-10
+    benchmark with synthetic-data mode and backend switch; epoch-time
+    records appended to a benchmark log (:381-397).
+  * scripts/02_fully_sharded_fsdp/resnet_fsdp_training.py -- FSDP wrap
+    with min_num_params=1e5 + FULL_SHARD and the CIFAR conv1 surgery
+    (:186-212).
+
+TPU-native: ``--strategy ddp`` replicates params (NO_SHARD),
+``--strategy fsdp`` shards every >=1e5-param tensor over the data axis
+(FULL_SHARD); both are PartitionSpec plans over the same jitted step.
+
+Run: TPU_HPC_SIM_DEVICES=8 python train_resnet_fsdp.py --depth 18 --strategy fsdp
+"""
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.models import datasets, resnet
+from tpu_hpc.parallel import dp, fsdp
+from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+from tpu_hpc.train import Trainer
+
+
+def main(argv=None) -> int:
+    cfg = TrainingConfig.from_args(argv)
+    extra = argparse.ArgumentParser(add_help=False)
+    extra.add_argument("--depth", type=int, default=18,
+                       choices=sorted(resnet.STAGE_SIZES))
+    extra.add_argument("--strategy", choices=("ddp", "fsdp"),
+                       default="fsdp")
+    extra.add_argument("--log-file", default="resnet_benchmark.log")
+    ns, _ = extra.parse_known_args(argv)
+
+    logger = get_logger()
+    init_distributed()
+    mesh = build_mesh(MeshSpec(axes={"data": -1}))
+    model_cfg = resnet.ResNetConfig(depth=ns.depth)
+    params, model_state = resnet.init_resnet(
+        jax.random.key(cfg.seed), model_cfg
+    )
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    logger.info(
+        "ResNet-%d (%.1fM params) | %s over %d devices",
+        ns.depth, n_params / 1e6, ns.strategy, mesh.size,
+    )
+
+    specs = (
+        fsdp.param_pspecs(params, axis_size=mesh.shape["data"])
+        if ns.strategy == "fsdp"
+        else dp.param_pspecs(params)
+    )
+    ds = datasets.CIFARSynthetic()
+    trainer = Trainer(
+        cfg, mesh, resnet.make_forward(model_cfg), params, model_state,
+        param_pspecs=specs,
+    )
+    t0 = time.perf_counter()
+    result = trainer.fit(ds)
+    wall = time.perf_counter() - t0
+    summary = result["epochs"][-1]
+    logger.info(
+        "run summary | final loss %.5f | %.1f images/s global | "
+        "%.1f images/s/device",
+        result["final_loss"],
+        summary["items_per_s"],
+        summary["items_per_s_per_device"],
+    )
+    # Append-only benchmark record (parity: scripts/main.py:381-397,
+    # which keys records by backend + NCCL version; here mesh + jax).
+    if jax.process_index() == 0:
+        with open(ns.log_file, "a") as f:
+            f.write(json.dumps({
+                "model": f"resnet{ns.depth}",
+                "strategy": ns.strategy,
+                "devices": mesh.size,
+                "jax": jax.__version__,
+                "epochs": cfg.epochs,
+                "wall_s": round(wall, 2),
+                "images_per_s": round(summary["items_per_s"], 2),
+            }) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

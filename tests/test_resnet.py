@@ -1,0 +1,84 @@
+"""ResNet family: shapes, depth variants, batch-stats updates, FSDP
+training step (the reference could only validate these by running on
+the cluster -- resnet_fsdp_training.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hpc.models import datasets, resnet
+
+
+@pytest.mark.parametrize("depth", [18, 50])
+def test_forward_shape(depth):
+    cfg = resnet.ResNetConfig(depth=depth, num_classes=10)
+    params, ms = resnet.init_resnet(jax.random.key(0), cfg)
+    x = jnp.zeros((2, 32, 32, 3))
+    logits, _ = resnet.apply_resnet(params, ms, x, cfg, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_param_counts_match_torchvision():
+    """CIFAR-stem ResNet-18 ~= 11.2M params, ResNet-50 ~= 23.5M --
+    the torchvision sizes the reference instantiates (scripts/
+    main.py:249) minus the stem difference."""
+    p18, _ = resnet.init_resnet(
+        jax.random.key(0), resnet.ResNetConfig(depth=18)
+    )
+    n18 = sum(p.size for p in jax.tree.leaves(p18))
+    assert 10.5e6 < n18 < 11.5e6
+    p50, _ = resnet.init_resnet(
+        jax.random.key(0), resnet.ResNetConfig(depth=50)
+    )
+    n50 = sum(p.size for p in jax.tree.leaves(p50))
+    assert 23e6 < n50 < 24.5e6
+
+
+def test_imagenet_stem_downsamples():
+    cfg = resnet.ResNetConfig(depth=18, cifar_stem=False)
+    params, ms = resnet.init_resnet(
+        jax.random.key(0), cfg, sample_shape=(64, 64, 3)
+    )
+    x = jnp.zeros((1, 64, 64, 3))
+    logits, _ = resnet.apply_resnet(params, ms, x, cfg, train=False)
+    assert logits.shape == (1, 10)
+
+
+def test_batch_stats_update():
+    cfg = resnet.ResNetConfig(depth=18)
+    params, ms = resnet.init_resnet(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3)) + 3.0
+    _, new_ms = resnet.apply_resnet(params, ms, x, cfg, train=True)
+    before = jax.tree.leaves(ms["batch_stats"])
+    after = jax.tree.leaves(new_ms["batch_stats"])
+    assert any(
+        float(jnp.abs(a - b).max()) > 1e-6
+        for a, b in zip(before, after)
+    )
+
+
+def test_fsdp_training_step(mesh8):
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.parallel import fsdp
+    from tpu_hpc.train import Trainer
+
+    cfg_m = resnet.ResNetConfig(depth=18)
+    params, ms = resnet.init_resnet(jax.random.key(0), cfg_m)
+    specs = fsdp.param_pspecs(params, axis_size=8)
+    # The wrap policy must actually shard something big and leave
+    # small tensors replicated.
+    from jax.sharding import PartitionSpec as P
+    flat = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert any(s != P() for s in flat) and any(s == P() for s in flat)
+
+    cfg = TrainingConfig(
+        epochs=1, steps_per_epoch=2, global_batch_size=16,
+        learning_rate=1e-2,
+    )
+    trainer = Trainer(
+        cfg, mesh8, resnet.make_forward(cfg_m), params, ms,
+        param_pspecs=specs,
+    )
+    result = trainer.fit(datasets.CIFARSynthetic())
+    assert np.isfinite(result["final_loss"])

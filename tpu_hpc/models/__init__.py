@@ -2,4 +2,5 @@ from tpu_hpc.models import datasets, losses  # noqa: F401
 from tpu_hpc.models.llama2 import Llama, LlamaConfig  # noqa: F401
 from tpu_hpc.models.pipeline_transformer import PipeConfig  # noqa: F401
 from tpu_hpc.models.unet import SimpleUNet, UNetConfig  # noqa: F401
+from tpu_hpc.models.resnet import ResNet, ResNetConfig  # noqa: F401
 from tpu_hpc.models.vit import SimpleViT, ViTConfig  # noqa: F401

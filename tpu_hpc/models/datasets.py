@@ -116,6 +116,46 @@ class ToyRegression:
 
 
 @dataclasses.dataclass(frozen=True)
+class CIFARSynthetic:
+    """Synthetic CIFAR-shaped (image, label) batches -- the reference's
+    ``--use_syn`` mode for the ResNet benchmark (scripts/main.py:
+    268-271), which exists so throughput runs need no data download."""
+
+    n_classes: int = 10
+    size: int = 32
+    channels: int = 3
+    seed: int = 0
+
+    @property
+    def sample_shape(self) -> Tuple[int, int, int]:
+        return (self.size, self.size, self.channels)
+
+    @staticmethod
+    def _gen(seed, batch_size, size, channels, n_classes, step):
+        rng = jax.random.fold_in(jax.random.key(seed), step)
+        ri, rl = jax.random.split(rng)
+        x = jax.random.normal(
+            ri, (batch_size, size, size, channels), dtype=jnp.float32
+        )
+        labels = jax.random.randint(
+            rl, (batch_size,), 0, n_classes, dtype=jnp.int32
+        )
+        return x, labels
+
+    def batch_at(self, step: int, batch_size: int):
+        return _jitted_gen(
+            CIFARSynthetic._gen, self.seed, batch_size,
+            self.size, self.channels, self.n_classes,
+        )(step)
+
+    def traced_batch(self, step, batch_size: int):
+        return CIFARSynthetic._gen(
+            self.seed, batch_size, self.size, self.channels,
+            self.n_classes, step,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class TokenStream:
     """Random token batches for LLM/PP training. Parity:
     03_pipeline_training.py:220-230 (inputs + shifted targets)."""

@@ -1,0 +1,191 @@
+"""ResNet-18/34/50/101/152 for image classification benchmarks.
+
+Capability parity with the reference's torchvision ResNet usage: the
+CIFAR-10 benchmark driver (scripts/main.py:249,268-306: ResNet-18/50/
+101/152 selectable, synthetic-data mode) and the FSDP example's
+CIFAR-adapted ResNet-18 (resnet_fsdp_training.py:186-191, whose conv1/
+maxpool surgery -- 3x3 stem, no maxpool -- is the ``cifar_stem``
+flag here).
+
+TPU-first: NHWC, flax BatchNorm with explicit batch_stats state (same
+scheme as unet.py), bf16-capable compute dtype, post-activation
+residual blocks exactly as torchvision (BasicBlock for 18/34,
+Bottleneck with expansion 4 for 50/101/152).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+STAGE_SIZES = {
+    18: (2, 2, 2, 2),
+    34: (3, 4, 6, 3),
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 18
+    num_classes: int = 10
+    # CIFAR stem surgery: 3x3/stride-1 conv1, no maxpool (parity:
+    # resnet_fsdp_training.py:188-190). False = ImageNet 7x7/stride-2.
+    cifar_stem: bool = True
+    dtype: Any = jnp.float32
+
+    @property
+    def stage_sizes(self) -> Sequence[int]:
+        return STAGE_SIZES[self.depth]
+
+    @property
+    def bottleneck(self) -> bool:
+        return self.depth >= 50
+
+
+def _conv(features, kernel, strides, dtype, name):
+    return nn.Conv(
+        features, (kernel, kernel), strides=(strides, strides),
+        padding="SAME", use_bias=False, dtype=dtype, name=name,
+    )
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        use_avg = not train
+        h = _conv(self.features, 3, self.strides, self.dtype, "conv1")(x)
+        h = nn.BatchNorm(
+            use_running_average=use_avg, dtype=self.dtype, name="bn1"
+        )(h)
+        h = nn.relu(h)
+        h = _conv(self.features, 3, 1, self.dtype, "conv2")(h)
+        h = nn.BatchNorm(
+            use_running_average=use_avg, dtype=self.dtype, name="bn2"
+        )(h)
+        if x.shape != h.shape:
+            x = _conv(self.features, 1, self.strides, self.dtype, "down")(x)
+            x = nn.BatchNorm(
+                use_running_average=use_avg, dtype=self.dtype, name="down_bn"
+            )(x)
+        return nn.relu(x + h)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        use_avg = not train
+        out_f = self.features * 4
+        h = _conv(self.features, 1, 1, self.dtype, "conv1")(x)
+        h = nn.BatchNorm(
+            use_running_average=use_avg, dtype=self.dtype, name="bn1"
+        )(h)
+        h = nn.relu(h)
+        h = _conv(self.features, 3, self.strides, self.dtype, "conv2")(h)
+        h = nn.BatchNorm(
+            use_running_average=use_avg, dtype=self.dtype, name="bn2"
+        )(h)
+        h = nn.relu(h)
+        h = _conv(out_f, 1, 1, self.dtype, "conv3")(h)
+        h = nn.BatchNorm(
+            use_running_average=use_avg, dtype=self.dtype, name="bn3"
+        )(h)
+        if x.shape != h.shape:
+            x = _conv(out_f, 1, self.strides, self.dtype, "down")(x)
+            x = nn.BatchNorm(
+                use_running_average=use_avg, dtype=self.dtype, name="down_bn"
+            )(x)
+        return nn.relu(x + h)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        use_avg = not train
+        x = x.astype(cfg.dtype)
+        if cfg.cifar_stem:
+            x = _conv(64, 3, 1, cfg.dtype, "conv1")(x)
+        else:
+            x = _conv(64, 7, 2, cfg.dtype, "conv1")(x)
+        x = nn.BatchNorm(
+            use_running_average=use_avg, dtype=cfg.dtype, name="bn1"
+        )(x)
+        x = nn.relu(x)
+        if not cfg.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block = Bottleneck if cfg.bottleneck else BasicBlock
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            features = 64 * (2 ** stage)
+            for b in range(n_blocks):
+                strides = 2 if (b == 0 and stage > 0) else 1
+                x = block(
+                    features, strides, cfg.dtype,
+                    name=f"stage{stage + 1}_block{b}",
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            cfg.num_classes, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="fc",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def init_resnet(
+    rng: jax.Array, cfg: ResNetConfig,
+    sample_shape: Tuple[int, int, int] = (32, 32, 3),
+) -> Tuple[Dict, Dict]:
+    """(params, model_state) -- model_state carries BatchNorm running
+    stats, same contract as unet.init_unet."""
+    variables = ResNet(cfg).init(
+        rng, jnp.zeros((1, *sample_shape), jnp.float32), train=False
+    )
+    params = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    return params, model_state
+
+
+def apply_resnet(params, model_state, x, cfg: ResNetConfig,
+                 train: bool = True):
+    """Returns (logits, new_model_state)."""
+    model = ResNet(cfg)
+    if train:
+        out, updates = model.apply(
+            {"params": params, **model_state}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        return out, {**model_state, **updates}
+    out = model.apply({"params": params, **model_state}, x, train=False)
+    return out, model_state
+
+
+def make_forward(cfg: ResNetConfig):
+    """Trainer-contract forward: softmax CE + accuracy on (image,
+    label) batches (datasets.CIFARSynthetic)."""
+    from tpu_hpc.models.losses import cross_entropy
+
+    def forward(params, model_state, batch, step_rng):
+        x, labels = batch
+        logits, new_ms = apply_resnet(params, model_state, x, cfg,
+                                      train=True)
+        acc = jnp.mean(
+            (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        )
+        return cross_entropy(logits, labels), new_ms, {"accuracy": acc}
+
+    return forward
