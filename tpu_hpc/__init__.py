@@ -34,36 +34,9 @@ import os as _os
 # section 4: "multi-node without a cluster: not solved").
 _sim = _os.environ.get("TPU_HPC_SIM_DEVICES")
 if _sim:
-    import jax as _jax
+    from tpu_hpc.runtime.sim import force_sim_devices as _force_sim
 
-    try:  # private API; degrade to best-effort flag setting if moved
-        from jax._src.xla_bridge import (
-            backends_are_initialized as _backends_up,
-        )
-    except ImportError:  # pragma: no cover
-        _backends_up = lambda: False  # noqa: E731
-    if _backends_up():
-        raise RuntimeError(
-            "TPU_HPC_SIM_DEVICES is set but the JAX backend is already "
-            "initialized -- import tpu_hpc (or set the variable) before "
-            "the first jax.devices()/jit call."
-        )
-    _flags = _os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" in _flags:
-        import re as _re
-
-        _flags = _re.sub(
-            r"--xla_force_host_platform_device_count=\d+",
-            f"--xla_force_host_platform_device_count={_sim}",
-            _flags,
-        )
-        _os.environ["XLA_FLAGS"] = _flags
-    else:
-        _os.environ["XLA_FLAGS"] = (
-            _flags + f" --xla_force_host_platform_device_count={_sim}"
-        ).strip()
-    _os.environ["JAX_PLATFORMS"] = "cpu"
-    _jax.config.update("jax_platforms", "cpu")
+    _force_sim(int(_sim))
 
 from tpu_hpc.runtime import (  # noqa: F401
     HostInfo,

@@ -52,6 +52,42 @@ def make_optimizer(cfg: TrainingConfig) -> optax.GradientTransformation:
     return optax.sgd(cfg.learning_rate, momentum=cfg.momentum)
 
 
+def make_step_fn(
+    forward: ForwardFn,
+    optimizer: optax.GradientTransformation,
+    seed: int,
+) -> Callable[[Any, Any], Tuple[Any, Dict]]:
+    """The training-step body as a free function: forward, backward,
+    optimizer update. The Trainer jits this; checks/fit.py AOT-lowers
+    the very same function against abstract 7B-scale inputs, so the fit
+    analysis certifies the real step, not a lookalike."""
+
+    def step(state: "TrainState", batch) -> Tuple["TrainState", Dict]:
+        step_rng = jax.random.fold_in(jax.random.key(seed), state.step)
+
+        def loss_fn(p):
+            loss, new_ms, aux = forward(p, state.model_state, batch, step_rng)
+            return loss, (new_ms, aux)
+
+        (loss, (new_ms, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **aux}
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt,
+                model_state=new_ms,
+            ),
+            metrics,
+        )
+
+    return step
+
+
 class Trainer:
     def __init__(
         self,
@@ -128,40 +164,14 @@ class Trainer:
             model_state=model_state,
         )
 
+        self._step_impl = make_step_fn(forward, self.optimizer, cfg.seed)
         self._train_step = jax.jit(self._step_impl, donate_argnums=(0,))
         self._epoch_fns: Dict[Any, Callable] = {}
         self.meter = ThroughputMeter(n_devices=mesh.size)
         self._resumed = False
 
-    # -- the HOT LOOP body (call-stack parity: SURVEY 3.1/3.4) --
-    def _step_impl(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
-        step_rng = jax.random.fold_in(
-            jax.random.key(self.cfg.seed), state.step
-        )
-
-        def loss_fn(p):
-            loss, new_ms, aux = self.forward(
-                p, state.model_state, batch, step_rng
-            )
-            return loss, (new_ms, aux)
-
-        (loss, (new_ms, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
-        updates, new_opt = self.optimizer.update(
-            grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
-        metrics = {"loss": loss, **aux}
-        return (
-            TrainState(
-                step=state.step + 1,
-                params=new_params,
-                opt_state=new_opt,
-                model_state=new_ms,
-            ),
-            metrics,
-        )
+    # -- the HOT LOOP body lives in make_step_fn (SURVEY 3.1/3.4);
+    # self._step_impl is bound in __init__ --
 
     def _get_epoch_fn(self, dataset, n_steps: int) -> Callable:
         """Jit (and cache) ``n_steps`` training steps as one ``lax.scan``,
@@ -178,9 +188,18 @@ class Trainer:
         index inside the scan, so the stream stays aligned across
         resume regardless of where the checkpoint landed.
         """
-        key = (id(dataset), n_steps)
+        # Datasets are frozen dataclasses, so hash by value: an
+        # id()-keyed cache could silently reuse a stale jitted epoch fn
+        # after the id is recycled by the allocator. Unhashable datasets
+        # fall back to identity keys, with the dataset pinned in the
+        # cache entry so its id cannot be recycled while the entry lives.
+        try:
+            key = (dataset, n_steps)
+            hash(key)
+        except TypeError:
+            key = ((type(dataset).__name__, id(dataset)), n_steps)
         if key in self._epoch_fns:
-            return self._epoch_fns[key]
+            return self._epoch_fns[key][0]
         gen = dataset.traced_batch
         bs = self.cfg.global_batch_size
         batch_sharding = self.batch_sharding
@@ -199,7 +218,7 @@ class Trainer:
             return jax.lax.scan(body, state, None, length=n_steps)
 
         fn = jax.jit(epoch_fn, donate_argnums=(0,))
-        self._epoch_fns[key] = fn
+        self._epoch_fns[key] = (fn, dataset)
         return fn
 
     def train_step(self, batch) -> Dict:
