@@ -32,8 +32,10 @@ def main(argv=None) -> int:
     logger.info("mesh: %s", dict(mesh.shape))
 
     ds = datasets.ERA5Synthetic()
+    param_dtype, compute_dtype = cfg.jax_dtypes()
     model_cfg = UNetConfig(
-        in_channels=ds.channels, out_channels=ds.channels
+        in_channels=ds.channels, out_channels=ds.channels,
+        dtype=compute_dtype, param_dtype=param_dtype,
     )
     params, model_state = init_unet(
         jax.random.key(cfg.seed), model_cfg, ds.sample_shape

@@ -42,7 +42,10 @@ def main(argv=None) -> int:
     logger = get_logger()
     init_distributed()
     mesh = build_mesh(MeshSpec(axes={"data": -1}))
-    model_cfg = resnet.ResNetConfig(depth=ns.depth)
+    param_dtype, compute_dtype = cfg.jax_dtypes()
+    model_cfg = resnet.ResNetConfig(
+        depth=ns.depth, dtype=compute_dtype, param_dtype=param_dtype,
+    )
     params, model_state = resnet.init_resnet(
         jax.random.key(cfg.seed), model_cfg
     )
@@ -61,17 +64,23 @@ def main(argv=None) -> int:
     trainer = Trainer(
         cfg, mesh, resnet.make_forward(model_cfg), params, model_state,
         param_pspecs=specs,
+        eval_forward=resnet.make_eval_forward(model_cfg),
     )
     t0 = time.perf_counter()
     result = trainer.fit(ds)
     wall = time.perf_counter() - t0
     summary = result["epochs"][-1]
+    # Held-out pass on a disjoint synthetic stream (parity: the test
+    # accuracy loop, resnet_fsdp_training.py:138-155).
+    test_metrics = trainer.evaluate(datasets.CIFARSynthetic(seed=1))
     logger.info(
         "run summary | final loss %.5f | %.1f images/s global | "
-        "%.1f images/s/device",
+        "%.1f images/s/device | test loss %.5f | test accuracy %.2f%%",
         result["final_loss"],
         summary["items_per_s"],
         summary["items_per_s_per_device"],
+        test_metrics["loss"],
+        100.0 * test_metrics["accuracy"],
     )
     # Append-only benchmark record (parity: scripts/main.py:381-397,
     # which keys records by backend + NCCL version; here mesh + jax).

@@ -16,7 +16,9 @@ import jax
 from tpu_hpc.config import TrainingConfig
 from tpu_hpc.logging_ import get_logger
 from tpu_hpc.models import datasets, losses
-from tpu_hpc.models.unet import UNetConfig, apply_unet, init_unet
+from tpu_hpc.models.unet import (
+    UNetConfig, apply_unet, init_unet, make_eval_forward,
+)
 from tpu_hpc.parallel import fsdp
 from tpu_hpc.parallel.plans import describe_pspecs
 from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
@@ -30,7 +32,11 @@ def main(argv=None) -> int:
     mesh = build_mesh(MeshSpec(axes={"data": cfg.data_parallel}))
 
     ds = datasets.ERA5Synthetic()
-    model_cfg = UNetConfig(in_channels=ds.channels, out_channels=ds.channels)
+    param_dtype, compute_dtype = cfg.jax_dtypes()
+    model_cfg = UNetConfig(
+        in_channels=ds.channels, out_channels=ds.channels,
+        dtype=compute_dtype, param_dtype=param_dtype,
+    )
     params, model_state = init_unet(
         jax.random.key(cfg.seed), model_cfg, ds.sample_shape
     )
@@ -56,6 +62,7 @@ def main(argv=None) -> int:
         param_pspecs=pspecs,
         batch_pspec=fsdp.batch_pspec(),
         checkpoint_manager=ckpt_mgr,
+        eval_forward=make_eval_forward(model_cfg),
     )
     result = trainer.fit(ds)
     if ckpt_mgr is not None:
@@ -64,11 +71,14 @@ def main(argv=None) -> int:
         logger.info("nothing to do: checkpoint already at %d epochs", cfg.epochs)
         return 0
     summary = result["epochs"][-1]
+    # Held-out test-loss pass (parity: the reference UNet's test loss,
+    # multinode_fsdp_unet.py).
+    test_metrics = trainer.evaluate(datasets.ERA5Synthetic(seed=1))
     logger.info(
         "run summary | final loss %.5f | %.1f samples/s global | "
-        "%.1f samples/s/device",
+        "%.1f samples/s/device | test loss %.5f",
         result["final_loss"], summary["items_per_s"],
-        summary["items_per_s_per_device"],
+        summary["items_per_s_per_device"], test_metrics["loss"],
     )
     return 0
 

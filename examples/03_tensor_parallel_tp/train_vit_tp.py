@@ -29,9 +29,11 @@ def main(argv=None) -> int:
     cfg = TrainingConfig.from_args(argv)
     logger = get_logger()
     init_distributed()
+    param_dtype, compute_dtype = cfg.jax_dtypes()
     model_cfg = vit.ViTConfig(
         in_channels=20, out_channels=20, patch_size=4, lat=64, lon=128,
         embed_dim=256, depth=6, n_heads=8,
+        dtype=compute_dtype, param_dtype=param_dtype,
     )
     if cfg.model_parallel == 1:
         cfg.model_parallel = tp.auto_tp_degree(

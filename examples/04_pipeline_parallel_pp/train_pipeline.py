@@ -52,9 +52,11 @@ def main(argv=None) -> int:
         100 * pp.bubble_fraction(n_stages, M),
     )
 
+    param_dtype, compute_dtype = cfg.jax_dtypes()
     model_cfg = ptx.PipeConfig(
         vocab_size=4096, dim=256, n_heads=8, n_stages=n_stages,
         layers_per_stage=2, max_seq_len=256,
+        dtype=compute_dtype, param_dtype=param_dtype,
     )
     params = ptx.init_pipeline_transformer(jax.random.key(cfg.seed), model_cfg)
     specs = {

@@ -60,9 +60,11 @@ def main(argv=None) -> int:
         dict(mesh.shape), ns.attn,
     )
 
+    param_dtype, compute_dtype = cfg.jax_dtypes()
     model_cfg = llama2.LlamaConfig(
         dim=256, n_layers=2, n_heads=8, vocab_size=4096,
         multiple_of=64, max_seq_len=ns.seq_len,
+        dtype=compute_dtype, param_dtype=param_dtype,
     )
     if ns.attn == "ulysses":
         validate_ulysses_degree(model_cfg.n_heads, cfg.seq_parallel)

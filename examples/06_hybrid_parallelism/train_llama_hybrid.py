@@ -25,9 +25,11 @@ def main(argv=None) -> int:
     cfg = TrainingConfig.from_args(argv)
     logger = get_logger()
     init_distributed()  # before any device query (multi-host contract)
+    param_dtype, compute_dtype = cfg.jax_dtypes()
     model_cfg = llama2.LlamaConfig(
         dim=256, n_layers=2, n_heads=8, vocab_size=4096,
         multiple_of=64, max_seq_len=512,
+        dtype=compute_dtype, param_dtype=param_dtype,
     )
     if cfg.model_parallel == 1:
         # Auto: TP up to 4-wide (the reference's node-size cap,

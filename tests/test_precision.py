@@ -1,0 +1,125 @@
+"""Mixed-precision plumbing: config -> model dtype pairs (round-1
+VERDICT missing item #4). Parity: the reference's --use-amp/amp_dtype
+switch (resnet_fsdp_training.py:198-204, utils/config.py:40-44) --
+here param_dtype/compute_dtype flow from TrainingConfig into every
+model config, and fp32-params/bf16-compute is the TPU-native default."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.models import datasets, llama2, resnet, unet, vit
+from tpu_hpc.runtime import MeshSpec, build_mesh
+from tpu_hpc.train import Trainer
+
+
+def test_jax_dtypes_defaults():
+    param, compute = TrainingConfig().jax_dtypes()
+    assert param == jnp.float32
+    assert compute == jnp.bfloat16
+
+
+def test_jax_dtypes_cli_switch():
+    cfg = TrainingConfig.from_args(
+        ["--compute-dtype", "float32", "--param-dtype", "bfloat16"]
+    )
+    param, compute = cfg.jax_dtypes()
+    assert param == jnp.bfloat16
+    assert compute == jnp.float32
+
+
+def test_jax_dtypes_rejects_unknown():
+    with pytest.raises(ValueError, match="unsupported dtype"):
+        TrainingConfig(compute_dtype="int8").jax_dtypes()
+
+
+def _param_dtypes(tree):
+    return {str(leaf.dtype) for leaf in jax.tree.leaves(tree)}
+
+
+def test_llama_param_dtype_follows_config():
+    cfg = llama2.LlamaConfig(
+        dim=64, n_layers=1, n_heads=4, vocab_size=64, multiple_of=16,
+        max_seq_len=16,
+    )
+    assert _param_dtypes(
+        llama2.init_llama(jax.random.key(0), cfg)
+    ) == {"float32"}
+    import dataclasses
+
+    bf16 = dataclasses.replace(cfg, param_dtype=jnp.bfloat16)
+    assert _param_dtypes(
+        llama2.init_llama(jax.random.key(0), bf16)
+    ) == {"bfloat16"}
+
+
+def test_resnet_param_dtype_follows_config():
+    cfg = resnet.ResNetConfig(
+        depth=18, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16
+    )
+    params, model_state = resnet.init_resnet(jax.random.key(0), cfg)
+    assert _param_dtypes(params) == {"bfloat16"}
+
+
+def test_unet_vit_param_dtype_follows_config():
+    ucfg = unet.UNetConfig(
+        in_channels=4, out_channels=4, base_features=8,
+        dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+    )
+    params, _ = unet.init_unet(jax.random.key(0), ucfg, (16, 16, 4))
+    assert _param_dtypes(params) == {"bfloat16"}
+    vcfg = vit.ViTConfig(
+        in_channels=4, out_channels=4, patch_size=4, lat=16, lon=16,
+        embed_dim=32, depth=1, n_heads=4, param_dtype=jnp.bfloat16,
+    )
+    assert _param_dtypes(vit.init_vit(jax.random.key(0), vcfg)) == {
+        "bfloat16"
+    }
+
+
+def test_pipeline_param_dtype_follows_config():
+    from tpu_hpc.models import pipeline_transformer as ptx
+
+    cfg = ptx.PipeConfig(
+        vocab_size=64, dim=32, n_heads=4, n_stages=2,
+        layers_per_stage=1, max_seq_len=16, param_dtype=jnp.bfloat16,
+    )
+    params = ptx.init_pipeline_transformer(jax.random.key(0), cfg)
+    assert _param_dtypes(params) == {"bfloat16"}
+
+
+def test_compute_dtype_changes_the_math():
+    """bf16 vs fp32 compute must produce (slightly) different logits --
+    proof the flag reaches the matmuls, not just the param store."""
+    kw = dict(
+        dim=64, n_layers=2, n_heads=4, vocab_size=128, multiple_of=16,
+        max_seq_len=32,
+    )
+    cfg32 = llama2.LlamaConfig(dtype=jnp.float32, **kw)
+    cfg16 = llama2.LlamaConfig(dtype=jnp.bfloat16, **kw)
+    params = llama2.init_llama(jax.random.key(0), cfg32)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, 128)
+    out32 = llama2.apply_llama(params, tokens, cfg32)
+    out16 = llama2.apply_llama(params, tokens, cfg16)
+    assert out32.dtype == out16.dtype == jnp.float32  # logits stay fp32
+    assert not jnp.allclose(out32, out16, atol=1e-6)
+    assert jnp.allclose(out32, out16, atol=0.5)  # same model, lower precision
+
+
+def test_trainer_preserves_param_dtype_through_updates(devices):
+    """fp32 masters must stay fp32 after optimizer updates even with
+    bf16 compute (the AMP invariant the reference gets from
+    MixedPrecision(param_dtype=...))."""
+    mesh = build_mesh(MeshSpec(axes={"data": 8}))
+    cfg = TrainingConfig(
+        epochs=1, steps_per_epoch=2, global_batch_size=8,
+    )
+    model_cfg = resnet.ResNetConfig(
+        depth=18, dtype=jnp.bfloat16, param_dtype=jnp.float32
+    )
+    params, model_state = resnet.init_resnet(jax.random.key(0), model_cfg)
+    trainer = Trainer(
+        cfg, mesh, resnet.make_forward(model_cfg), params, model_state,
+    )
+    trainer.fit(datasets.CIFARSynthetic())
+    assert _param_dtypes(trainer.state.params) == {"float32"}

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 
 @dataclasses.dataclass
@@ -93,6 +93,23 @@ class TrainingConfig:
             if v is not None:
                 setattr(base, f.name, v)
         return base
+
+    def jax_dtypes(self) -> "tuple[Any, Any]":
+        """(param_dtype, compute_dtype) as jax dtypes -- the plumbing
+        for the reference's --use-amp/amp_dtype switch
+        (resnet_fsdp_training.py:198-204): pass into a model config as
+        ``SomeConfig(dtype=compute, param_dtype=param)``. fp32 params +
+        bf16 compute is the TPU-native mixed-precision default."""
+        import jax.numpy as jnp
+
+        allowed = {"float32", "bfloat16", "float16"}
+        for name in (self.param_dtype, self.compute_dtype):
+            if name not in allowed:
+                raise ValueError(
+                    f"unsupported dtype {name!r}; expected one of "
+                    f"{sorted(allowed)}"
+                )
+        return jnp.dtype(self.param_dtype), jnp.dtype(self.compute_dtype)
 
     def mesh_axes(self) -> "dict[str, int]":
         """Ordered mesh axes, dropping degenerate (size-1) ones except

@@ -60,7 +60,8 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 32768
     depth_init: bool = True
-    dtype: Any = jnp.bfloat16  # compute dtype; params stay fp32
+    dtype: Any = jnp.bfloat16       # compute dtype (the reference's
+    param_dtype: Any = jnp.float32  # use_amp/amp_dtype pair, utils/config.py:40-44)
     remat: bool = False
     # One-hot-matmul embedding lookup instead of gather: rides the MXU
     # and its transpose is a matmul instead of a scatter-add (TPU
@@ -135,16 +136,18 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 class RMSNorm(nn.Module):
-    """RMSNorm in fp32 with a learned scale (parity: reference
-    :115-142)."""
+    """RMSNorm computed in fp32 with a learned scale (parity:
+    reference :115-142)."""
 
     eps: float = 1e-5
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         scale = self.param(
-            "scale", nn.initializers.ones, (x.shape[-1],), jnp.float32
-        )
+            "scale", nn.initializers.ones, (x.shape[-1],),
+            self.param_dtype,
+        ).astype(jnp.float32)
         xf = x.astype(jnp.float32)
         normed = xf * jax.lax.rsqrt(
             jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps
@@ -152,14 +155,16 @@ class RMSNorm(nn.Module):
         return (normed * scale).astype(x.dtype)
 
 
-def _dense(features: int, std: float, dtype, name: str) -> nn.Dense:
+def _dense(
+    features: int, std: float, cfg: "LlamaConfig", name: str
+) -> nn.Dense:
     """Bias-free projection with a given init std (the reference's
     nn.init.normal_/trunc_normal_ per-layer std scheme :275-345)."""
     return nn.Dense(
         features,
         use_bias=False,
-        dtype=dtype,
-        param_dtype=jnp.float32,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
         kernel_init=nn.initializers.normal(stddev=std),
         name=name,
     )
@@ -186,9 +191,9 @@ class Attention(nn.Module):
         groups = cfg.n_heads // n_kv
         std = 0.02
 
-        q = _dense(cfg.n_heads * hd, std, cfg.dtype, "wq")(x)
-        k = _dense(n_kv * hd, std, cfg.dtype, "wk")(x)
-        v = _dense(n_kv * hd, std, cfg.dtype, "wv")(x)
+        q = _dense(cfg.n_heads * hd, std, cfg, "wq")(x)
+        k = _dense(n_kv * hd, std, cfg, "wk")(x)
+        v = _dense(n_kv * hd, std, cfg, "wv")(x)
 
         cos, sin = rope_cos_sin(s, hd)
         q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), cos, sin)
@@ -209,7 +214,7 @@ class Attention(nn.Module):
             probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
             out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
         out = out.reshape(b, s, cfg.n_heads * hd)
-        return _dense(cfg.dim, self.out_std, cfg.dtype, "wo")(out)
+        return _dense(cfg.dim, self.out_std, cfg, "wo")(out)
 
 
 class FeedForward(nn.Module):
@@ -222,9 +227,9 @@ class FeedForward(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
         hidden = cfg.ffn_hidden
-        gate = _dense(hidden, 0.02, cfg.dtype, "w1")(x)
-        up = _dense(hidden, 0.02, cfg.dtype, "w3")(x)
-        return _dense(cfg.dim, self.out_std, cfg.dtype, "w2")(
+        gate = _dense(hidden, 0.02, cfg, "w1")(x)
+        up = _dense(hidden, 0.02, cfg, "w3")(x)
+        return _dense(cfg.dim, self.out_std, cfg, "w2")(
             nn.silu(gate) * up
         )
 
@@ -251,12 +256,12 @@ class TransformerBlock(nn.Module):
         out_std = 0.02 / (2 * depth) ** 0.5
         h = x + self.constrain(
             Attention(cfg, out_std, self.attn_fn, name="attention")(
-                RMSNorm(cfg.norm_eps, name="attention_norm")(x)
+                RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x)
             )
         )
         return h + self.constrain(
             FeedForward(cfg, out_std, name="feed_forward")(
-                RMSNorm(cfg.norm_eps, name="ffn_norm")(h)
+                RMSNorm(cfg.norm_eps, cfg.param_dtype, name="ffn_norm")(h)
             )
         )
 
@@ -276,7 +281,7 @@ class Llama(nn.Module):
             cfg.vocab_size,
             cfg.dim,
             dtype=cfg.dtype,
-            param_dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
             embedding_init=nn.initializers.normal(stddev=1.0),
             name="tok_embeddings",
         )
@@ -295,12 +300,12 @@ class Llama(nn.Module):
             x = block(
                 cfg, i, self.constrain, self.attn_fn, name=f"layers_{i}"
             )(x)
-        x = RMSNorm(cfg.norm_eps, name="norm")(x)
+        x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="norm")(x)
         logits = nn.Dense(
             cfg.vocab_size,
             use_bias=False,
             dtype=cfg.dtype,
-            param_dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.truncated_normal(stddev=0.02),
             name="output",
         )(x)

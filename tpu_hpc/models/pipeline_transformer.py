@@ -32,7 +32,8 @@ class PipeConfig:
     layers_per_stage: int = 2
     max_seq_len: int = 128
     mlp_ratio: int = 4
-    dtype: Any = jnp.float32
+    dtype: Any = jnp.float32        # compute dtype (reference AMP pair,
+    param_dtype: Any = jnp.float32  # resnet_fsdp_training.py:198-204)
 
     @property
     def n_layers(self) -> int:
@@ -50,8 +51,8 @@ class CausalLayer(nn.Module):
         cfg = self.cfg
         B, L, D = x.shape
         H = cfg.n_heads
-        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
-        qkv = nn.Dense(3 * D, dtype=cfg.dtype, name="qkv")(h)
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln1")(x)
+        qkv = nn.Dense(3 * D, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, L, H, D // H)
         k = k.reshape(B, L, H, D // H)
@@ -61,13 +62,13 @@ class CausalLayer(nn.Module):
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
         attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         out = jnp.einsum("bhlm,bmhd->blhd", attn.astype(x.dtype), v)
-        x = x + nn.Dense(D, dtype=cfg.dtype, name="proj")(
+        x = x + nn.Dense(D, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="proj")(
             out.reshape(B, L, D)
         )
-        h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
-        h = nn.Dense(cfg.mlp_ratio * D, dtype=cfg.dtype, name="fc1")(h)
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="ln2")(x)
+        h = nn.Dense(cfg.mlp_ratio * D, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="fc1")(h)
         h = nn.gelu(h)
-        return x + nn.Dense(D, dtype=cfg.dtype, name="fc2")(h)
+        return x + nn.Dense(D, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="fc2")(h)
 
 
 class StageBlock(nn.Module):
@@ -92,22 +93,23 @@ def init_pipeline_transformer(rng: jax.Array, cfg: PipeConfig) -> Dict:
     block = StageBlock(cfg)
     stage_keys = jax.random.split(k_stage, cfg.n_stages)
     stages = jax.vmap(lambda k: block.init(k, dummy)["params"])(stage_keys)
+    pd = cfg.param_dtype
     return {
         "embed": {
-            "tok": jax.random.normal(
+            "tok": (jax.random.normal(
                 k_emb, (cfg.vocab_size, cfg.dim), jnp.float32
-            ) * 0.02,
-            "pos": jax.random.normal(
+            ) * 0.02).astype(pd),
+            "pos": (jax.random.normal(
                 k_pos, (cfg.max_seq_len, cfg.dim), jnp.float32
-            ) * 0.02,
+            ) * 0.02).astype(pd),
         },
         "stages": stages,
         "head": {
-            "ln_scale": jnp.ones((cfg.dim,), jnp.float32),
-            "ln_bias": jnp.zeros((cfg.dim,), jnp.float32),
-            "kernel": jax.random.normal(
+            "ln_scale": jnp.ones((cfg.dim,), pd),
+            "ln_bias": jnp.zeros((cfg.dim,), pd),
+            "kernel": (jax.random.normal(
                 k_head, (cfg.dim, cfg.vocab_size), jnp.float32
-            ) * 0.02,
+            ) * 0.02).astype(pd),
         },
     }
 

@@ -37,7 +37,8 @@ class ResNetConfig:
     # CIFAR stem surgery: 3x3/stride-1 conv1, no maxpool (parity:
     # resnet_fsdp_training.py:188-190). False = ImageNet 7x7/stride-2.
     cifar_stem: bool = True
-    dtype: Any = jnp.float32
+    dtype: Any = jnp.float32        # compute dtype (reference AMP pair,
+    param_dtype: Any = jnp.float32  # resnet_fsdp_training.py:198-204)
 
     @property
     def stage_sizes(self) -> Sequence[int]:
@@ -48,10 +49,11 @@ class ResNetConfig:
         return self.depth >= 50
 
 
-def _conv(features, kernel, strides, dtype, name):
+def _conv(features, kernel, strides, dtype, name, param_dtype=jnp.float32):
     return nn.Conv(
         features, (kernel, kernel), strides=(strides, strides),
-        padding="SAME", use_bias=False, dtype=dtype, name=name,
+        padding="SAME", use_bias=False, dtype=dtype,
+        param_dtype=param_dtype, name=name,
     )
 
 
@@ -59,23 +61,27 @@ class BasicBlock(nn.Module):
     features: int
     strides: int
     dtype: Any
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         use_avg = not train
-        h = _conv(self.features, 3, self.strides, self.dtype, "conv1")(x)
+        h = _conv(self.features, 3, self.strides, self.dtype, "conv1", self.param_dtype)(x)
         h = nn.BatchNorm(
-            use_running_average=use_avg, dtype=self.dtype, name="bn1"
+            use_running_average=use_avg, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="bn1"
         )(h)
         h = nn.relu(h)
-        h = _conv(self.features, 3, 1, self.dtype, "conv2")(h)
+        h = _conv(self.features, 3, 1, self.dtype, "conv2", self.param_dtype)(h)
         h = nn.BatchNorm(
-            use_running_average=use_avg, dtype=self.dtype, name="bn2"
+            use_running_average=use_avg, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="bn2"
         )(h)
         if x.shape != h.shape:
-            x = _conv(self.features, 1, self.strides, self.dtype, "down")(x)
+            x = _conv(self.features, 1, self.strides, self.dtype, "down", self.param_dtype)(x)
             x = nn.BatchNorm(
-                use_running_average=use_avg, dtype=self.dtype, name="down_bn"
+                use_running_average=use_avg, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="down_bn"
             )(x)
         return nn.relu(x + h)
 
@@ -84,29 +90,34 @@ class Bottleneck(nn.Module):
     features: int
     strides: int
     dtype: Any
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         use_avg = not train
         out_f = self.features * 4
-        h = _conv(self.features, 1, 1, self.dtype, "conv1")(x)
+        h = _conv(self.features, 1, 1, self.dtype, "conv1", self.param_dtype)(x)
         h = nn.BatchNorm(
-            use_running_average=use_avg, dtype=self.dtype, name="bn1"
+            use_running_average=use_avg, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="bn1"
         )(h)
         h = nn.relu(h)
-        h = _conv(self.features, 3, self.strides, self.dtype, "conv2")(h)
+        h = _conv(self.features, 3, self.strides, self.dtype, "conv2", self.param_dtype)(h)
         h = nn.BatchNorm(
-            use_running_average=use_avg, dtype=self.dtype, name="bn2"
+            use_running_average=use_avg, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="bn2"
         )(h)
         h = nn.relu(h)
-        h = _conv(out_f, 1, 1, self.dtype, "conv3")(h)
+        h = _conv(out_f, 1, 1, self.dtype, "conv3", self.param_dtype)(h)
         h = nn.BatchNorm(
-            use_running_average=use_avg, dtype=self.dtype, name="bn3"
+            use_running_average=use_avg, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="bn3"
         )(h)
         if x.shape != h.shape:
-            x = _conv(out_f, 1, self.strides, self.dtype, "down")(x)
+            x = _conv(out_f, 1, self.strides, self.dtype, "down", self.param_dtype)(x)
             x = nn.BatchNorm(
-                use_running_average=use_avg, dtype=self.dtype, name="down_bn"
+                use_running_average=use_avg, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="down_bn"
             )(x)
         return nn.relu(x + h)
 
@@ -120,11 +131,12 @@ class ResNet(nn.Module):
         use_avg = not train
         x = x.astype(cfg.dtype)
         if cfg.cifar_stem:
-            x = _conv(64, 3, 1, cfg.dtype, "conv1")(x)
+            x = _conv(64, 3, 1, cfg.dtype, "conv1", cfg.param_dtype)(x)
         else:
-            x = _conv(64, 7, 2, cfg.dtype, "conv1")(x)
+            x = _conv(64, 7, 2, cfg.dtype, "conv1", cfg.param_dtype)(x)
         x = nn.BatchNorm(
-            use_running_average=use_avg, dtype=cfg.dtype, name="bn1"
+            use_running_average=use_avg, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="bn1"
         )(x)
         x = nn.relu(x)
         if not cfg.cifar_stem:
@@ -135,12 +147,12 @@ class ResNet(nn.Module):
             for b in range(n_blocks):
                 strides = 2 if (b == 0 and stage > 0) else 1
                 x = block(
-                    features, strides, cfg.dtype,
+                    features, strides, cfg.dtype, cfg.param_dtype,
                     name=f"stage{stage + 1}_block{b}",
                 )(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(
-            cfg.num_classes, dtype=cfg.dtype, param_dtype=jnp.float32,
+            cfg.num_classes, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="fc",
         )(x)
         return x.astype(jnp.float32)
@@ -189,3 +201,20 @@ def make_forward(cfg: ResNetConfig):
         return cross_entropy(logits, labels), new_ms, {"accuracy": acc}
 
     return forward
+
+
+def make_eval_forward(cfg: ResNetConfig):
+    """Trainer-contract eval forward: inference mode (BatchNorm on
+    stored stats), test CE + accuracy -- the reference's Trainer.test
+    metric (resnet_fsdp_training.py:138-155)."""
+    from tpu_hpc.models.losses import cross_entropy
+
+    def eval_forward(params, model_state, batch):
+        x, labels = batch
+        logits, _ = apply_resnet(params, model_state, x, cfg, train=False)
+        acc = jnp.mean(
+            (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        )
+        return cross_entropy(logits, labels), {"accuracy": acc}
+
+    return eval_forward

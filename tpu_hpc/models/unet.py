@@ -25,7 +25,8 @@ class UNetConfig:
     in_channels: int = 20
     out_channels: int = 20
     base_features: int = 64
-    dtype: Any = jnp.float32
+    dtype: Any = jnp.float32        # compute dtype (reference AMP pair,
+    param_dtype: Any = jnp.float32  # resnet_fsdp_training.py:198-204)
 
 
 class ConvBlock(nn.Module):
@@ -33,14 +34,17 @@ class ConvBlock(nn.Module):
 
     features: int
     dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         for _ in range(2):
             x = nn.Conv(self.features, (3, 3), padding="SAME",
-                        dtype=self.dtype)(x)
+                        dtype=self.dtype,
+                        param_dtype=self.param_dtype)(x)
             x = nn.BatchNorm(use_running_average=not train,
-                             dtype=self.dtype)(x)
+                             dtype=self.dtype,
+                             param_dtype=self.param_dtype)(x)
             x = nn.relu(x)
         return x
 
@@ -61,22 +65,23 @@ class SimpleUNet(nn.Module):
         f = cfg.base_features
         x = x.astype(cfg.dtype)
 
-        e1 = ConvBlock(f, cfg.dtype, name="enc1")(x, train)
+        e1 = ConvBlock(f, cfg.dtype, cfg.param_dtype, name="enc1")(x, train)
         p1 = nn.max_pool(e1, (2, 2), strides=(2, 2))
-        e2 = ConvBlock(2 * f, cfg.dtype, name="enc2")(p1, train)
+        e2 = ConvBlock(2 * f, cfg.dtype, cfg.param_dtype, name="enc2")(p1, train)
         p2 = nn.max_pool(e2, (2, 2), strides=(2, 2))
 
-        b = ConvBlock(4 * f, cfg.dtype, name="bottleneck")(p2, train)
+        b = ConvBlock(4 * f, cfg.dtype, cfg.param_dtype, name="bottleneck")(p2, train)
 
         u2 = _bilinear_resize(b, e2.shape[1:3])
-        d2 = ConvBlock(2 * f, cfg.dtype, name="dec2")(
+        d2 = ConvBlock(2 * f, cfg.dtype, cfg.param_dtype, name="dec2")(
             jnp.concatenate([u2, e2], axis=-1), train
         )
         u1 = _bilinear_resize(d2, e1.shape[1:3])
-        d1 = ConvBlock(f, cfg.dtype, name="dec1")(
+        d1 = ConvBlock(f, cfg.dtype, cfg.param_dtype, name="dec1")(
             jnp.concatenate([u1, e1], axis=-1), train
         )
         out = nn.Conv(cfg.out_channels, (1, 1), dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype,
                       name="head")(d1)
         return out.astype(jnp.float32)
 
@@ -107,3 +112,17 @@ def apply_unet(params, model_state, x, cfg: UNetConfig, train: bool = True):
         return out, {**model_state, **updates}
     out = model.apply({"params": params, **model_state}, x, train=False)
     return out, model_state
+
+
+def make_eval_forward(cfg: UNetConfig):
+    """Trainer-contract eval forward: inference mode (BatchNorm on
+    stored stats), latitude-weighted test MSE -- the reference's UNet
+    test pass (multinode_fsdp_unet.py test loss)."""
+    from tpu_hpc.models.losses import lat_weighted_mse
+
+    def eval_forward(params, model_state, batch):
+        x, y = batch
+        pred, _ = apply_unet(params, model_state, x, cfg, train=False)
+        return lat_weighted_mse(pred, y), {}
+
+    return eval_forward

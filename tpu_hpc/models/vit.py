@@ -42,7 +42,8 @@ class ViTConfig:
     depth: int = 6
     n_heads: int = 8
     mlp_ratio: int = 4
-    dtype: Any = jnp.bfloat16
+    dtype: Any = jnp.bfloat16       # compute dtype (reference AMP pair,
+    param_dtype: Any = jnp.float32  # resnet_fsdp_training.py:198-204)
 
     @property
     def h_patches(self) -> int:
@@ -61,9 +62,11 @@ class ViTConfig:
         return self.embed_dim // self.n_heads
 
 
-def _dense(features: int, dtype, name: str) -> nn.Dense:
+def _dense(
+    features: int, dtype, name: str, param_dtype=jnp.float32
+) -> nn.Dense:
     return nn.Dense(
-        features, dtype=dtype, param_dtype=jnp.float32,
+        features, dtype=dtype, param_dtype=param_dtype,
         kernel_init=nn.initializers.normal(stddev=0.02), name=name,
     )
 
@@ -79,16 +82,16 @@ class ViTAttention(nn.Module):
         cfg = self.cfg
         b, n, _ = x.shape
         hd = cfg.head_dim
-        q = _dense(cfg.embed_dim, cfg.dtype, "q_proj")(x)
-        k = _dense(cfg.embed_dim, cfg.dtype, "k_proj")(x)
-        v = _dense(cfg.embed_dim, cfg.dtype, "v_proj")(x)
+        q = _dense(cfg.embed_dim, cfg.dtype, "q_proj", cfg.param_dtype)(x)
+        k = _dense(cfg.embed_dim, cfg.dtype, "k_proj", cfg.param_dtype)(x)
+        v = _dense(cfg.embed_dim, cfg.dtype, "v_proj", cfg.param_dtype)(x)
         q = q.reshape(b, n, cfg.n_heads, hd)
         k = k.reshape(b, n, cfg.n_heads, hd)
         v = v.reshape(b, n, cfg.n_heads, hd)
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd ** -0.5
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
         out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cfg.dtype), v)
-        return _dense(cfg.embed_dim, cfg.dtype, "out_proj")(
+        return _dense(cfg.embed_dim, cfg.dtype, "out_proj", cfg.param_dtype)(
             out.reshape(b, n, cfg.embed_dim)
         )
 
@@ -100,15 +103,15 @@ class ViTBlock(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
         ln = lambda nm: nn.LayerNorm(  # noqa: E731
-            dtype=jnp.float32, param_dtype=jnp.float32, name=nm
+            dtype=jnp.float32, param_dtype=cfg.param_dtype, name=nm
         )
         x = x + ViTAttention(cfg, name="attn")(
             ln("norm1")(x).astype(cfg.dtype)
         )
         h = ln("norm2")(x).astype(cfg.dtype)
-        h = _dense(cfg.embed_dim * cfg.mlp_ratio, cfg.dtype, "fc1")(h)
+        h = _dense(cfg.embed_dim * cfg.mlp_ratio, cfg.dtype, "fc1", cfg.param_dtype)(h)
         h = nn.gelu(h)
-        return x + _dense(cfg.embed_dim, cfg.dtype, "fc2")(h)
+        return x + _dense(cfg.embed_dim, cfg.dtype, "fc2", cfg.param_dtype)(h)
 
 
 class SimpleViT(nn.Module):
@@ -123,23 +126,23 @@ class SimpleViT(nn.Module):
         # Patch embed: stride-p conv == per-patch linear (:82-90).
         tok = nn.Conv(
             cfg.embed_dim, (p, p), strides=(p, p), padding="VALID",
-            dtype=cfg.dtype, param_dtype=jnp.float32, name="patch_embed",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="patch_embed",
         )(x.astype(cfg.dtype))
         tok = tok.reshape(b, cfg.n_patches, cfg.embed_dim)
         pos = self.param(
             "pos_embed",
             nn.initializers.normal(stddev=0.02),
             (1, cfg.n_patches, cfg.embed_dim),
-            jnp.float32,
+            cfg.param_dtype,
         )
         tok = tok + pos.astype(cfg.dtype)
         for i in range(cfg.depth):
             tok = ViTBlock(cfg, name=f"blocks_{i}")(tok)
         tok = nn.LayerNorm(
-            dtype=jnp.float32, param_dtype=jnp.float32, name="norm"
+            dtype=jnp.float32, param_dtype=cfg.param_dtype, name="norm"
         )(tok)
         # Pixel reconstruction head + unpatchify (:180-202), NHWC.
-        px = _dense(cfg.out_channels * p * p, cfg.dtype, "head")(
+        px = _dense(cfg.out_channels * p * p, cfg.dtype, "head", cfg.param_dtype)(
             tok.astype(cfg.dtype)
         )
         px = px.reshape(

@@ -41,6 +41,9 @@ class TrainState(struct.PyTreeNode):
 
 # forward(params, model_state, batch, step_rng) -> (loss, new_model_state, aux)
 ForwardFn = Callable[[Any, Any, Any, jax.Array], Tuple[jax.Array, Any, Dict]]
+# eval_forward(params, model_state, batch) -> (loss, aux) -- inference
+# mode, no RNG, no state updates (BatchNorm runs on stored stats).
+EvalForwardFn = Callable[[Any, Any, Any], Tuple[jax.Array, Dict]]
 
 
 def make_optimizer(cfg: TrainingConfig) -> optax.GradientTransformation:
@@ -101,11 +104,18 @@ class Trainer:
         optimizer: Optional[optax.GradientTransformation] = None,
         checkpoint_manager: Any = None,
         opt_param_pspecs: Any = None,
+        eval_forward: Optional[EvalForwardFn] = None,
     ):
         """``opt_param_pspecs``: optional separate plan for deriving
         optimizer-state shardings (defaults to ``param_pspecs``). This
         is how SHARD_GRAD_OP works: params replicated for compute,
-        moments sharded (see fsdp.grad_op_pspecs)."""
+        moments sharded (see fsdp.grad_op_pspecs).
+
+        ``eval_forward``: inference-mode forward for ``evaluate``
+        (models with train/eval behavior differences -- BatchNorm,
+        dropout -- must supply one, e.g. resnet.make_eval_forward).
+        Defaults to the training forward with state updates discarded,
+        which is exact for stateless models (llama, vit)."""
         self.cfg = cfg
         self.mesh = mesh
         self.forward = forward
@@ -164,9 +174,31 @@ class Trainer:
             model_state=model_state,
         )
 
+        if eval_forward is None:
+            if jax.tree.leaves(
+                model_state if model_state is not None else {}
+            ):
+                # Stateful model (BatchNorm etc.): the train-mode
+                # forward normalizes by batch statistics, so defaulting
+                # to it would report a wrong "inference" metric.
+                self.logger.warning(
+                    "no eval_forward given for a stateful model; "
+                    "evaluate() will run the TRAIN-mode forward "
+                    "(batch statistics, not stored stats) -- pass "
+                    "eval_forward (e.g. resnet.make_eval_forward) for "
+                    "true inference-mode metrics"
+                )
+
+            def eval_forward(p, ms, batch):
+                loss, _, aux = forward(
+                    p, ms, batch, jax.random.key(cfg.seed)
+                )
+                return loss, aux
+        self.eval_forward = eval_forward
         self._step_impl = make_step_fn(forward, self.optimizer, cfg.seed)
         self._train_step = jax.jit(self._step_impl, donate_argnums=(0,))
         self._epoch_fns: Dict[Any, Callable] = {}
+        self._eval_fns: Dict[Any, Callable] = {}
         self.meter = ThroughputMeter(n_devices=mesh.size)
         self._resumed = False
 
@@ -193,11 +225,7 @@ class Trainer:
         # after the id is recycled by the allocator. Unhashable datasets
         # fall back to identity keys, with the dataset pinned in the
         # cache entry so its id cannot be recycled while the entry lives.
-        try:
-            key = (dataset, n_steps)
-            hash(key)
-        except TypeError:
-            key = ((type(dataset).__name__, id(dataset)), n_steps)
+        key = self._dataset_key(dataset, n_steps)
         if key in self._epoch_fns:
             return self._epoch_fns[key][0]
         gen = dataset.traced_batch
@@ -227,6 +255,94 @@ class Trainer:
         )
         self.state, metrics = self._train_step(self.state, batch)
         return metrics
+
+    def _dataset_key(self, dataset, *extra):
+        try:
+            key = (dataset, *extra)
+            hash(key)
+            return key
+        except TypeError:
+            return ((type(dataset).__name__, id(dataset)), *extra)
+
+    def eval_step(self, batch) -> Dict:
+        """One jitted inference-mode step (no grads, no state updates)."""
+        batch = jax.tree.map(
+            lambda a: jax.device_put(a, self.batch_sharding), batch
+        )
+        if "step" not in self._eval_fns:
+            def one(state, b):
+                loss, aux = self.eval_forward(
+                    state.params, state.model_state, b
+                )
+                return {"loss": loss, **aux}
+
+            self._eval_fns["step"] = (jax.jit(one), None)
+        return self._eval_fns["step"][0](self.state, batch)
+
+    def evaluate(self, dataset, n_steps: Optional[int] = None) -> Dict:
+        """Jitted evaluation pass: mean loss (and any aux metrics, e.g.
+        accuracy) over ``n_steps`` batches, sharded exactly like
+        training.
+
+        Parity: the reference's ``Trainer.test()`` accuracy loop
+        (resnet_fsdp_training.py:138-155) and the UNet test-loss pass
+        (multinode_fsdp_unet.py) -- under torch each rank loops and
+        all-reduces correct-counts; here the whole pass is one scanned
+        jit dispatch and the mesh handles the reduction.
+        """
+        n_steps = n_steps or self.cfg.steps_per_epoch
+        bs = self.cfg.global_batch_size
+        if hasattr(dataset, "traced_batch"):
+            key = self._dataset_key(dataset, n_steps, "eval")
+            if key not in self._eval_fns:
+                gen = dataset.traced_batch
+                batch_sharding = self.batch_sharding
+                eval_forward = self.eval_forward
+
+                def eval_fn(state: TrainState):
+                    def body(_, i):
+                        batch = gen(i, bs)
+                        batch = jax.tree.map(
+                            lambda a: jax.lax.with_sharding_constraint(
+                                a, batch_sharding
+                            ),
+                            batch,
+                        )
+                        loss, aux = eval_forward(
+                            state.params, state.model_state, batch
+                        )
+                        return None, {"loss": loss, **aux}
+
+                    _, per_step = jax.lax.scan(
+                        body, None, jnp.arange(n_steps)
+                    )
+                    return jax.tree.map(
+                        lambda a: jnp.mean(a, axis=0), per_step
+                    )
+
+                self._eval_fns[key] = (jax.jit(eval_fn), dataset)
+            metrics = self._eval_fns[key][0](self.state)
+        else:
+            # Accumulate on-device; one host sync at the end (the
+            # module's minimise-host<->device-transfers rule).
+            sums: Dict[str, jax.Array] = {}
+            for i in range(n_steps):
+                m = self.eval_step(dataset.batch_at(i, bs))
+                for k, v in m.items():
+                    sums[k] = sums[k] + v if k in sums else v
+            metrics = {
+                k: v / n_steps
+                for k, v in jax.device_get(sums).items()
+            }
+        out = {
+            k: float(jax.device_get(v)) for k, v in metrics.items()
+        }
+        if jax.process_index() == 0:
+            self.logger.info(
+                "eval | %s",
+                " | ".join(f"{k} {v:.5f}" for k, v in sorted(out.items())),
+            )
+        return out
 
     def maybe_resume(self) -> int:
         """Snapshot auto-resume: continue from the stored step if a
