@@ -152,6 +152,74 @@ def bench_llama(
     }
 
 
+def bench_llama_sp(
+    steps: int = 20, batch_per_dp: int = 4, sp_mode: str = "zigzag",
+) -> dict:
+    """Sequence-parallel Llama throughput: the ring / zigzag / Ulysses
+    code paths under the real training loop (VERDICT r1: these paths
+    had no recorded BENCH artifact). Context axis = all visible chips
+    (1 chip: degenerate ring, still the kernel-under-shard_map path
+    that otherwise only runs in tests)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.models import datasets, llama2
+    from tpu_hpc.parallel import ring_attention as ra
+    from tpu_hpc.parallel import sp_ulysses
+    from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+    from tpu_hpc.train import Trainer
+
+    init_distributed(verbose=False)
+    n_dev = jax.device_count()
+    model_cfg = llama2.LlamaConfig(
+        dim=1024, n_layers=8, n_heads=8, vocab_size=32000,
+        multiple_of=256, max_seq_len=2048,
+    )
+    mesh = build_mesh(MeshSpec(axes={"data": 1, "context": n_dev}))
+    make = {
+        "ring": ra.make_ring_attn_fn,
+        "zigzag": ra.make_zigzag_ring_attn_fn,
+        "ulysses": sp_ulysses.make_ulysses_attn_fn,
+    }[sp_mode]
+    attn_fn = make(mesh, "data", "context")
+    constrain = ra.cp_constrain(mesh, "data", "context")
+
+    cfg = TrainingConfig(
+        epochs=2,  # epoch 0 absorbs compilation; epoch 1 is measured
+        steps_per_epoch=steps,
+        global_batch_size=batch_per_dp,
+        learning_rate=3e-4,
+        weight_decay=0.1,
+    )
+    ds = datasets.TokenStream(
+        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
+    )
+    params = llama2.init_llama(jax.random.key(0), model_cfg)
+    trainer = Trainer(
+        cfg, mesh,
+        llama2.make_forward(model_cfg, constrain, attn_fn),
+        params,
+    )
+    result = trainer.fit(ds)
+    summary = result["epochs"][-1]
+    tokens_per_s = summary["items_per_s"] * model_cfg.max_seq_len
+    flops_per_token = model_cfg.flops_per_token(model_cfg.max_seq_len)
+    peak = peak_flops_per_chip(jax.devices()[0])
+    mfu = tokens_per_s * flops_per_token / (peak * n_dev)
+    print(
+        f"llama-sp[{sp_mode}] | context={n_dev} | "
+        f"{tokens_per_s:.0f} tokens/s | MFU {mfu:.1%}",
+        file=sys.stderr,
+    )
+    return {
+        "metric": f"llama2_sp_{sp_mode}_tokens_per_s_per_chip",
+        "value": round(tokens_per_s / n_dev, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 3),
+    }
+
+
 def bench_unet(steps: int = 20) -> dict:
     import jax
     import jax.numpy as jnp
@@ -202,18 +270,24 @@ def bench_unet(steps: int = 20) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--workload", choices=("llama", "unet"), default="llama"
+        "--workload", choices=("llama", "llama-sp", "unet"),
+        default="llama",
     )
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--attn", choices=("flash", "xla"), default="flash")
-    args = ap.parse_args()
-    rec = (
-        bench_llama(args.steps, args.remat, args.batch, args.attn)
-        if args.workload == "llama"
-        else bench_unet(args.steps)
+    ap.add_argument(
+        "--sp-mode", choices=("ring", "zigzag", "ulysses"),
+        default="zigzag",
     )
+    args = ap.parse_args()
+    if args.workload == "llama":
+        rec = bench_llama(args.steps, args.remat, args.batch, args.attn)
+    elif args.workload == "llama-sp":
+        rec = bench_llama_sp(args.steps, args.batch, args.sp_mode)
+    else:
+        rec = bench_unet(args.steps)
     print(json.dumps(rec))
     return 0
 

@@ -9,6 +9,10 @@ Both documented designs are runnable here:
     ``seq`` mesh axis via ppermute (the ICI torus IS the ring), partial
     results merged with the exact online-softmax/LSE identity
     (doc pseudocode :84-142).
+  * ``--attn zigzag``  -- Ring Attention with the zigzag chunk
+    interleave: device i holds chunks (i, 2n-1-i), so causal work is
+    perfectly balanced across the ring (the contiguous layout leaves
+    the last device doing ~2x the mean).
   * ``--attn ulysses`` -- DeepSpeed-Ulysses: all-to-all scatter-heads /
     gather-sequence around plain flash attention (doc pseudocode
     :43-77; needs n_heads % seq_parallel == 0).
@@ -22,6 +26,17 @@ Run (8 simulated devices):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python train_llama_sp.py --seq-parallel 4 --attn ring
 """
+import os as _os
+import sys as _sys
+
+# Run directly from a source checkout without installing: put the repo
+# root on sys.path (the reference uses the same pattern, e.g.
+# resnet_fsdp_training.py:27).
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
 import argparse
 import sys
 
@@ -42,7 +57,9 @@ from tpu_hpc.train import Trainer
 def main(argv=None) -> int:
     cfg = TrainingConfig.from_args(argv)
     extra = argparse.ArgumentParser(add_help=False)
-    extra.add_argument("--attn", choices=("ring", "ulysses"), default="ring")
+    extra.add_argument(
+        "--attn", choices=("ring", "zigzag", "ulysses"), default="ring"
+    )
     extra.add_argument("--seq-len", type=int, default=512)
     ns, _ = extra.parse_known_args(argv)
 
@@ -69,6 +86,12 @@ def main(argv=None) -> int:
     if ns.attn == "ulysses":
         validate_ulysses_degree(model_cfg.n_heads, cfg.seq_parallel)
         attn_fn = make_ulysses_attn_fn(mesh, "data", "seq")
+    elif ns.attn == "zigzag":
+        from tpu_hpc.parallel.ring_attention import (
+            make_zigzag_ring_attn_fn,
+        )
+
+        attn_fn = make_zigzag_ring_attn_fn(mesh, "data", "seq")
     else:
         attn_fn = make_ring_attn_fn(mesh, "data", "seq")
     constrain = cp_constrain(mesh, "data", "seq")

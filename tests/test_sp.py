@@ -136,6 +136,55 @@ class TestFlashKernel:
         for a, b in zip(gp, gr):
             np.testing.assert_allclose(a, b, atol=1e-5)
 
+    @pytest.mark.parametrize("s", [25, 100])
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_odd_lengths(self, s, causal):
+        """No divisibility cliff: lengths that divide neither block_q
+        nor block_k (pad-and-mask path) match the reference exactly."""
+        q, k, v = rand_qkv(jax.random.key(20), s=s)
+        out, lse = flash_attention(
+            q, k, v, jnp.int32(0), jnp.int32(0),
+            causal, None, 8, 8, True,
+        )
+        want, want_lse = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, want, atol=1e-5)
+        np.testing.assert_allclose(lse, want_lse, atol=1e-5)
+
+    def test_odd_cross_lengths(self):
+        """Sq != Sk, both non-divisible (the ViT / uneven-ring shape)."""
+        kq, kk, kv2 = jax.random.split(jax.random.key(21), 3)
+        q = jax.random.normal(kq, (2, 13, 4, 8), jnp.float32)
+        k = jax.random.normal(kk, (2, 41, 4, 8), jnp.float32)
+        v = jax.random.normal(kv2, (2, 41, 4, 8), jnp.float32)
+        out, lse = flash_attention(
+            q, k, v, jnp.int32(0), jnp.int32(0),
+            False, None, 8, 8, True,
+        )
+        want, want_lse = attention_reference(q, k, v, causal=False)
+        np.testing.assert_allclose(out, want, atol=1e-5)
+        np.testing.assert_allclose(lse, want_lse, atol=1e-5)
+
+    def test_odd_lengths_grad(self):
+        """Backward through the padded path: padded rows/cols must
+        contribute exactly zero gradient."""
+        q, k, v = rand_qkv(jax.random.key(22), s=25)
+
+        def f_pallas(q, k, v):
+            out, lse = blockwise_attention(
+                q, k, v, causal=True, impl="pallas_interpret",
+                block_q=8, block_k=8,
+            )
+            return jnp.sum(out * out) + jnp.sum(jnp.sin(lse))
+
+        def f_ref(q, k, v):
+            out, lse = attention_reference(q, k, v, causal=True)
+            return jnp.sum(out * out) + jnp.sum(jnp.sin(lse))
+
+        gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
     def test_lse_grad(self):
         """Ring merging differentiates through lse -- the flash bwd's
         dlse term must match the reference path's lse gradient."""
@@ -184,6 +233,18 @@ class TestRingAttention:
         want = full_attention_oracle(q, kr, vr, causal=True)
         np.testing.assert_allclose(out, want, atol=1e-4)
 
+    def test_odd_local_shard_kernel(self, sp_mesh):
+        """Ring over 4 context shards with S_local=7 (odd) through the
+        Pallas kernel's pad-and-mask path."""
+        q, k, v = rand_qkv(jax.random.key(23), b=2, s=28)
+        attn = make_ring_attn_fn(
+            sp_mesh, "data", "context", impl="pallas_interpret",
+            block_q=8, block_k=8,
+        )
+        out = jax.jit(attn)(q, k, v)
+        want = full_attention_oracle(q, k, v, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
     def test_grad_matches_oracle(self, sp_mesh):
         q, k, v = rand_qkv(jax.random.key(10), b=2, s=32)
         attn = make_ring_attn_fn(sp_mesh, "data", "context", impl="xla")
@@ -198,6 +259,95 @@ class TestRingAttention:
         gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gr, gf):
             np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+class TestZigzagRing:
+    """Zigzag chunk interleave: causal work balanced across the ring
+    (the standard fix for the late-device straggler; the reference's
+    ring design in 08_sequence_parallel.md has the same imbalance)."""
+
+    def test_matches_oracle(self, sp_mesh):
+        from tpu_hpc.parallel.ring_attention import make_zigzag_ring_attn_fn
+
+        q, k, v = rand_qkv(jax.random.key(30), b=2, s=32)
+        attn = make_zigzag_ring_attn_fn(
+            sp_mesh, "data", "context", impl="xla"
+        )
+        out = jax.jit(attn)(q, k, v)
+        want = full_attention_oracle(q, k, v, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_non_causal(self, sp_mesh):
+        from tpu_hpc.parallel.ring_attention import make_zigzag_ring_attn_fn
+
+        q, k, v = rand_qkv(jax.random.key(31), b=2, s=32)
+        attn = make_zigzag_ring_attn_fn(
+            sp_mesh, "data", "context", causal=False, impl="xla"
+        )
+        out = jax.jit(attn)(q, k, v)
+        want = full_attention_oracle(q, k, v, causal=False)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_gqa(self, sp_mesh):
+        from tpu_hpc.parallel.ring_attention import make_zigzag_ring_attn_fn
+
+        q, k, v = rand_qkv(jax.random.key(32), b=2, s=32, hq=4, hkv=2)
+        attn = make_zigzag_ring_attn_fn(
+            sp_mesh, "data", "context", impl="xla"
+        )
+        out = jax.jit(attn)(q, k, v)
+        kr = jnp.repeat(k, 2, axis=2)
+        vr = jnp.repeat(v, 2, axis=2)
+        want = full_attention_oracle(q, kr, vr, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_grad_matches_oracle(self, sp_mesh):
+        from tpu_hpc.parallel.ring_attention import make_zigzag_ring_attn_fn
+
+        q, k, v = rand_qkv(jax.random.key(33), b=2, s=32)
+        attn = make_zigzag_ring_attn_fn(
+            sp_mesh, "data", "context", impl="xla"
+        )
+
+        def loss_z(q, k, v):
+            return jnp.sum(attn(q, k, v) ** 2)
+
+        def loss_full(q, k, v):
+            return jnp.sum(full_attention_oracle(q, k, v) ** 2)
+
+        gz = jax.jit(jax.grad(loss_z, argnums=(0, 1, 2)))(q, k, v)
+        gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gz, gf):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_pallas_kernel_path(self, sp_mesh):
+        from tpu_hpc.parallel.ring_attention import make_zigzag_ring_attn_fn
+
+        q, k, v = rand_qkv(jax.random.key(34), b=2, s=32)
+        attn = make_zigzag_ring_attn_fn(
+            sp_mesh, "data", "context", impl="pallas_interpret",
+            block_q=4, block_k=4,
+        )
+        out = jax.jit(attn)(q, k, v)
+        want = full_attention_oracle(q, k, v, causal=True)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_causal_balance(self, n):
+        """The analytic claim: contiguous ring's worst device does
+        ~2x the mean causal work; zigzag is exactly uniform."""
+        from tpu_hpc.parallel.ring_attention import causal_live_pairs
+
+        plain = causal_live_pairs(n, zigzag=False)
+        zz = causal_live_pairs(n, zigzag=True)
+        assert max(plain) / (sum(plain) / n) == pytest.approx(
+            2 * n / (n + 1)
+        )
+        assert len(set(zz)) == 1, f"zigzag must be uniform, got {zz}"
+        assert zz[0] == 2 * n + 1
+        # Same total work, just distributed evenly (x4 chunk split:
+        # each contiguous chunk is two zigzag chunks).
+        assert sum(zz) == 2 * n * (2 * n + 1) // 2
 
 
 class TestUlysses:

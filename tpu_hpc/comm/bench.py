@@ -30,7 +30,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_hpc.comm import primitives
 
 DEFAULT_SIZES = tuple(10**k for k in range(3, 9))  # torch_comm_bench.py:174
-OPS = ("broadcast", "all_reduce", "all_gather", "reduce_scatter", "ring_shift")
+OPS = (
+    "broadcast", "all_reduce", "all_gather", "reduce_scatter",
+    "ring_shift", "all_to_all",
+)
 
 
 def bus_bandwidth_gb_s(op: str, bytes_per_shard: int, n: int, t: float) -> float:
@@ -79,6 +82,13 @@ class CommBenchmark:
             # replicated [n*size] input; output sharded.
             x = jnp.arange(n * n_elements, dtype=dt)
             return jax.device_put(x, NamedSharding(self.mesh, P()))
+        elif op == "all_to_all":
+            # The Ulysses building block: [n, inner] sharded on dim 0
+            # in, dim 1 out; each device still holds ~``size`` elements
+            # (inner rounded up so the n-way column split is exact).
+            inner = -(-n_elements // n) * n
+            x = jnp.arange(n * inner, dtype=dt).reshape(n, inner)
+            return jax.device_put(x, NamedSharding(self.mesh, P(self.axis)))
         raise ValueError(op)
 
     def run(self) -> List[Dict]:
@@ -99,7 +109,9 @@ class CommBenchmark:
                     out.block_until_ready()  # synchronize (ref :52-56)
                     times.append(time.perf_counter() - t0)
                 times = np.asarray(times)
-                nbytes = size * jnp.dtype(self.dtype).itemsize
+                # Per-shard payload from the actual array (all_to_all
+                # rounds the element count up to an n-divisible size).
+                nbytes = x.nbytes // n
                 rec = {
                     "op": op,
                     "size_elements": size,

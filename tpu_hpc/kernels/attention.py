@@ -21,6 +21,11 @@ Two interchangeable implementations:
 Layout convention: [B, S, H, D] (model order, models/llama2.py);
 LSE is [B, S, H] fp32. Masking uses a large finite negative instead of
 -inf so both forward and backward stay NaN-free on fully-masked rows.
+
+Arbitrary sequence lengths are supported: inputs are zero-padded to a
+block multiple, padded KV columns are masked in-kernel, and outputs
+are sliced back. (The reference's SDPA has no length constraint; a
+181x360 weather grid or an odd ring shard must work here too.)
 """
 from __future__ import annotations
 
@@ -33,6 +38,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 MASK_VALUE = -1e30
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pad_seq(x: jax.Array, n: int) -> jax.Array:
+    """Zero-pad the sequence axis (axis 1) by ``n``."""
+    cfg = [(0, 0)] * x.ndim
+    cfg[1] = (0, n)
+    return jnp.pad(x, cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -119,6 +135,7 @@ def _flash_kernel(
     causal: bool,
     block_q: int,
     block_k: int,
+    kv_len: int,
 ):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -153,6 +170,12 @@ def _flash_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(rows >= cols, s, MASK_VALUE)
+        if kv_len % block_k:
+            # Zero-padded KV tail (local coords, offset-independent).
+            local = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(local < kv_len, s, MASK_VALUE)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         m_safe = jnp.where(m_new <= MASK_VALUE * 0.5, 0.0, m_new)
@@ -188,30 +211,39 @@ def _flash_forward(
     block_k: int,
     interpret: bool,
 ) -> Tuple[jax.Array, jax.Array]:
-    """[B, Sq, H, D] x [B, Sk, H, D] -> (out, lse [B, Sq, H])."""
+    """[B, Sq, H, D] x [B, Sk, H, D] -> (out, lse [B, Sq, H]).
+
+    Arbitrary seq lens: pad to a block multiple (blocks clamp to the
+    128-aligned length for short sequences, keeping TPU lane tiling),
+    mask the padded KV tail in-kernel, slice the padded Q tail off the
+    outputs.
+    """
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError(
-            f"seq lens ({sq}, {sk}) must divide blocks "
-            f"({block_q}, {block_k})"
-        )
+    block_q = min(block_q, _round_up(sq, 128))
+    block_k = min(block_k, _round_up(sk, 128))
+    sq_p = _round_up(sq, block_q)
+    sk_p = _round_up(sk, block_k)
+    if sq_p != sq:
+        q = _pad_seq(q, sq_p - sq)
+    if sk_p != sk:
+        k = _pad_seq(k, sk_p - sk)
+        v = _pad_seq(v, sk_p - sk)
     # [B, S, H, D] -> [B*H, S, D]: heads become the parallel grid dim.
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
 
-    grid = (b * h, sq // block_q, sk // block_k)
+    grid = (b * h, sq_p // block_q, sk_p // block_k)
     kernel = functools.partial(
         _flash_kernel,
         sm_scale=sm_scale,
         causal=causal,
         block_q=block_q,
         block_k=block_k,
+        kv_len=sk,
     )
     smem = pl.BlockSpec(
         (1, 1), lambda bh, i, j: (0, 0), memory_space=pltpu.SMEM
@@ -246,8 +278,8 @@ def _flash_forward(
             ),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq_p, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -256,8 +288,8 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(qo, ko, qt, kt, vt)
-    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
+    out = out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)[:, :sq]
+    lse = lse.reshape(b, h, sq_p).transpose(0, 2, 1)[:, :sq]
     return out, lse  # lse [B, Sq, H]
 
 
@@ -323,7 +355,7 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 def _flash_dq_kernel(
     qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dm_ref,
-    dq_ref, acc_ref, *, sm_scale, causal, block_q, block_k,
+    dq_ref, acc_ref, *, sm_scale, causal, block_q, block_k, kv_len,
 ):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -351,6 +383,11 @@ def _flash_dq_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(rows >= cols, s, MASK_VALUE)
+        if kv_len % block_k:
+            local = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(local < kv_len, s, MASK_VALUE)
         p = jnp.where(
             s > MASK_VALUE * 0.5, jnp.exp(s - lse_ref[0]), 0.0
         )
@@ -372,6 +409,7 @@ def _flash_dq_kernel(
 def _flash_dkv_kernel(
     qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dm_ref,
     dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal, block_q, block_k,
+    kv_len,
 ):
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -401,6 +439,11 @@ def _flash_dkv_kernel(
                 jnp.int32, (block_k, block_q), 1
             )
             st = jnp.where(rows >= cols, st, MASK_VALUE)
+        if kv_len % block_k:
+            local = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0
+            )
+            st = jnp.where(local < kv_len, st, MASK_VALUE)
         # lse/dm are per-q-row: broadcast along the k dim (axis 0).
         pt = jnp.where(
             st > MASK_VALUE * 0.5,
@@ -434,20 +477,36 @@ def _flash_backward(
     """[B, S, H, D] layouts in, (dq, dk, dv) out."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    dot = dout.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    lse_t = lse.transpose(0, 2, 1).reshape(b * h, sq, 1)
+    block_q = min(block_q, _round_up(sq, 128))
+    block_k = min(block_k, _round_up(sk, 128))
+    sq_p = _round_up(sq, block_q)
+    sk_p = _round_up(sk, block_k)
+    # Zero-pad to block multiples. Padded q rows contribute exactly
+    # zero to dk/dv (dout rows are zero), and padded kv rows to dq
+    # (k rows are zero); padded dk/dv/dq rows are sliced off below.
+    # The in-kernel kv_len mask keeps p itself correct.
+    if sq_p != sq:
+        q = _pad_seq(q, sq_p - sq)
+        out = _pad_seq(out, sq_p - sq)
+        dout = _pad_seq(dout, sq_p - sq)
+        lse = _pad_seq(lse, sq_p - sq)
+        if dlse is not None:
+            dlse = _pad_seq(dlse, sq_p - sq)
+    if sk_p != sk:
+        k = _pad_seq(k, sk_p - sk)
+        v = _pad_seq(v, sk_p - sk)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    dot = dout.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
+    lse_t = lse.transpose(0, 2, 1).reshape(b * h, sq_p, 1)
     # D - dlse folded into one per-row vector: ds = P*(dP - D + dlse).
     d_row = jnp.sum(
         dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
     if dlse is not None:
         d_row = d_row - dlse
-    dm_t = d_row.transpose(0, 2, 1).reshape(b * h, sq, 1)
+    dm_t = d_row.transpose(0, 2, 1).reshape(b * h, sq_p, 1)
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
 
@@ -474,16 +533,16 @@ def _flash_backward(
     dq = pl.pallas_call(
         functools.partial(
             _flash_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, kv_len=sk,
         ),
-        grid=(b * h, sq // block_q, sk // block_k),
+        grid=(b * h, sq_p // block_q, sk_p // block_k),
         in_specs=[
             smem, smem,
             vspec(block_q, "i"), vspec(block_k, "j"), vspec(block_k, "j"),
             vspec(block_q, "i"), rspec(block_q, "i"), rspec(block_q, "i"),
         ],
         out_specs=vspec(block_q, "i"),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qo, ko, qt, kt, vt, dot, lse_t, dm_t)
@@ -491,9 +550,9 @@ def _flash_backward(
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, kv_len=sk,
         ),
-        grid=(b * h, sk // block_k, sq // block_q),
+        grid=(b * h, sk_p // block_k, sq_p // block_q),
         in_specs=[
             smem, smem,
             vspec(block_q, "j"), vspec(block_k, "i"), vspec(block_k, "i"),
@@ -501,8 +560,8 @@ def _flash_backward(
         ],
         out_specs=[vspec(block_k, "i"), vspec(block_k, "i")],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -511,8 +570,12 @@ def _flash_backward(
         interpret=interpret,
     )(qo, ko, qt, kt, vt, dot, lse_t, dm_t)
 
-    unflat = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)  # noqa: E731
-    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+    unflat = lambda x, sp, s: (
+        x.reshape(b, h, sp, d).transpose(0, 2, 1, 3)[:, :s]
+    )  # noqa: E731
+    return (
+        unflat(dq, sq_p, sq), unflat(dk, sk_p, sk), unflat(dv, sk_p, sk)
+    )
 
 
 # ---------------------------------------------------------------------------

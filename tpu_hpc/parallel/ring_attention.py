@@ -19,8 +19,14 @@ Unlike Ulysses (sp_ulysses.py) there is no head-count constraint and
 the memory/comm pattern scales across hosts (DCN) -- the tradeoff table
 the reference gives in 08_sequence_parallel.md:144-154.
 
-Known further optimisation (later round): zigzag chunk ordering to
-balance causal work across the ring.
+Causal load balance: with contiguous sharding, device i only has
+causal work for the i+1 earliest KV chunks, so the last device does
+~2x the mean work and the ring runs at the straggler's pace. The
+standard fix is the **zigzag** layout (``zigzag_ring_attention``):
+split the sequence into 2n chunks and give device i the pair
+(i, 2n-1-i). Every device then has exactly 2n+1 live (q-chunk,
+kv-chunk) causal pairs -- perfectly balanced (asserted in
+tests/test_sp.py::TestZigzagRing::test_causal_balance).
 """
 from __future__ import annotations
 
@@ -119,6 +125,181 @@ def make_ring_attn_fn(
         )(q, k, v)
 
     return attn_fn
+
+
+def zigzag_indices(n: int, s_global: int):
+    """Permutation laying a sequence out in zigzag ring order.
+
+    The sequence is cut into ``2n`` chunks; ``x[:, idx]`` gives device
+    i of an n-way ring the chunk pair (i, 2n-1-i). Apply once at the
+    data loader (cheap host-side gather) or via ``x[:, idx]`` under
+    jit (XLA turns the resharding gather into a collective). Undo with
+    ``out[:, inverse]``.
+    """
+    import numpy as np
+
+    if s_global % (2 * n):
+        raise ValueError(
+            f"zigzag needs seq {s_global} divisible by 2*ring={2 * n}"
+        )
+    c = s_global // (2 * n)
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    idx = np.concatenate(
+        [np.arange(o * c, (o + 1) * c) for o in order]
+    )
+    return jnp.asarray(idx), jnp.asarray(np.argsort(idx))
+
+
+def zigzag_ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Ring attention over a zigzag-laid-out sequence (in-shard_map).
+
+    The local shard holds the chunk pair (me, 2n-1-me) of 2n global
+    chunks, concatenated. Each ring step attends the two local Q
+    chunks against the two KV chunks that originated on device
+    (me - step) mod n, merging the four partials with the exact LSE
+    identity; causal masking stays in *original* coordinates via the
+    per-chunk offsets. The Pallas kernel's runtime causal-skip
+    (`pl.when(live)`) drops fully-future KV blocks, so the balanced
+    live-pair count translates directly into balanced compute.
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    c = q.shape[1] // 2
+    groups = q.shape[2] // k.shape[2]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    # Global chunk offsets of the local Q pair (original coordinates).
+    q_offs = (me * c, (2 * n - 1 - me) * c)
+
+    def attend(qc, q_off, kc, vc, k_off):
+        if groups > 1:
+            kc = jnp.repeat(kc, groups, axis=2)
+            vc = jnp.repeat(vc, groups, axis=2)
+        return blockwise_attention(
+            qc, kc, vc, causal=causal,
+            q_offset=q_off, kv_offset=k_off,
+            impl=impl, block_q=block_q, block_k=block_k,
+        )
+
+    def step_merge(carry_out, carry_lse, k_cur, v_cur, step):
+        src = jax.lax.rem(me - step + n, n)
+        k_offs = (src * c, (2 * n - 1 - src) * c)
+        new_out, new_lse = [], []
+        for qi in range(2):
+            qc = jax.lax.dynamic_slice_in_dim(q, qi * c, c, axis=1)
+            o_acc = jax.lax.dynamic_slice_in_dim(
+                carry_out, qi * c, c, axis=1
+            )
+            l_acc = jax.lax.dynamic_slice_in_dim(
+                carry_lse, qi * c, c, axis=1
+            )
+            for ki in range(2):
+                kc = jax.lax.dynamic_slice_in_dim(
+                    k_cur, ki * c, c, axis=1
+                )
+                vc = jax.lax.dynamic_slice_in_dim(
+                    v_cur, ki * c, c, axis=1
+                )
+                o_i, l_i = attend(qc, q_offs[qi], kc, vc, k_offs[ki])
+                o_acc, l_acc = lse_merge(
+                    o_acc, l_acc, o_i.astype(jnp.float32), l_i
+                )
+            new_out.append(o_acc)
+            new_lse.append(l_acc)
+        return (
+            jnp.concatenate(new_out, axis=1),
+            jnp.concatenate(new_lse, axis=1),
+        )
+
+    def body(carry, step):
+        k_cur, v_cur, out, lse = carry
+        out, lse = step_merge(out, lse, k_cur, v_cur, step)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, out, lse), None
+
+    out0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full(q.shape[:3], MASK_VALUE, jnp.float32)
+    (k_last, v_last, out, lse), _ = jax.lax.scan(
+        body, (k, v, out0, lse0), jnp.arange(n - 1)
+    )
+    out, lse = step_merge(out, lse, k_last, v_last, n - 1)
+    return out.astype(q.dtype)
+
+
+def make_zigzag_ring_attn_fn(
+    mesh: Mesh,
+    dp_axis: Optional[str] = "data",
+    sp_axis: str = "context",
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+    """Drop-in zigzag variant of ``make_ring_attn_fn``: permutes the
+    (contiguously sequence-sharded) inputs into zigzag layout, runs the
+    balanced ring, and permutes back.
+
+    The two permutations reshard across the sp axis, so for production
+    long-context training prefer laying the tokens out in zigzag order
+    at the data loader (``zigzag_indices``) and calling
+    ``zigzag_ring_attention`` directly -- then the permutation cost is
+    paid once per batch on the host instead of twice per layer.
+    """
+    spec = P(dp_axis, sp_axis, None, None)
+    n = mesh.shape[sp_axis]
+
+    def inner(q, k, v):
+        return zigzag_ring_attention(
+            q, k, v, sp_axis,
+            causal=causal, impl=impl, block_q=block_q, block_k=block_k,
+        )
+
+    def attn_fn(q, k, v):
+        idx, inv = zigzag_indices(n, q.shape[1])
+        qz, kz, vz = (x[:, idx] for x in (q, k, v))
+        out = jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(qz, kz, vz)
+        return out[:, inv]
+
+    return attn_fn
+
+
+def causal_live_pairs(n: int, zigzag: bool):
+    """Per-device count of causally-live (q-chunk, kv-chunk) pairs over
+    a full ring pass -- the analytic compute-balance model.
+
+    Contiguous: device i sees every kv chunk j and works iff j <= i ->
+    counts 1..n (device n-1 does ~2x the mean; the ring runs at its
+    pace). Zigzag: device i holds chunks (i, 2n-1-i) and the count is
+    2n+1 for every device. Used by the balance test and the bench note.
+    """
+    if not zigzag:
+        return [i + 1 for i in range(n)]
+    counts = []
+    for i in range(n):
+        qs = (i, 2 * n - 1 - i)
+        total = 0
+        for src in range(n):
+            for kc in (src, 2 * n - 1 - src):
+                total += sum(1 for qc in qs if kc <= qc)
+        counts.append(total)
+    return counts
 
 
 def cp_constrain(
