@@ -1,0 +1,185 @@
+"""FSDP sharding-strategy matrix: SHARD_GRAD_OP and HYBRID_SHARD.
+
+The reference documents four FSDP modes
+(docs/guide/05_fully_sharded_fsdp.md:114-156; HYBRID_SHARD recipe in
+scripts/02_fully_sharded_fsdp/README.md:133-138):
+  FULL_SHARD    -> fsdp.param_pspecs        (tests/test_train_dp.py)
+  NO_SHARD      -> dp.param_pspecs          (tests/test_train_dp.py)
+  SHARD_GRAD_OP -> fsdp.grad_op_pspecs      (this file)
+  HYBRID_SHARD  -> fsdp.hybrid_shard_pspecs (this file)
+
+The layout assertions here are the mode's *defining invariants* -- not
+just "it runs": SHARD_GRAD_OP means params stay replicated across
+optimizer steps while moments stay sharded; HYBRID_SHARD means params
+shard only over the inner (intra-island) axis and every chip still
+sees distinct data.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.models import datasets, losses
+from tpu_hpc.models.unet import UNetConfig, apply_unet, init_unet
+from tpu_hpc.parallel import dp, fsdp
+from tpu_hpc.runtime import MeshSpec, build_mesh
+from tpu_hpc.train import Trainer
+
+
+def _unet_forward(cfg_model):
+    def forward(params, model_state, batch, step_rng):
+        x, y = batch
+        pred, new_ms = apply_unet(params, model_state, x, cfg_model, train=True)
+        return losses.lat_weighted_mse(pred, y), new_ms, {}
+
+    return forward
+
+
+@pytest.fixture(scope="module")
+def small_unet():
+    cfg_model = UNetConfig(in_channels=4, out_channels=4, base_features=4)
+    params, ms = init_unet(jax.random.key(0), cfg_model, (21, 24, 4))
+    ds = datasets.ERA5Synthetic(n_vars=2, n_levels=2, lat=21, lon=24)
+    return cfg_model, params, ms, ds
+
+
+@pytest.fixture(scope="module")
+def mesh_replica_fsdp(devices):
+    """2D data mesh: 2 islands x 4 chips (the HYBRID_SHARD shape)."""
+    return build_mesh(MeshSpec(axes={"replica": 2, "fsdp": 4}))
+
+
+class TestShardGradOp:
+    def test_layout_invariant_across_steps(self, mesh8, small_unet):
+        """Params replicated, moments sharded -- and they STAY that way
+        after optimizer.step (the updated params must not silently
+        inherit the moments' sharded layout through apply_updates)."""
+        cfg_model, params, ms, ds = small_unet
+        p_specs, opt_specs = fsdp.grad_op_pspecs(
+            params, axis_size=8, min_size=200
+        )
+        cfg = TrainingConfig(
+            steps_per_epoch=2, global_batch_size=16, learning_rate=1e-2,
+        )
+        tr = Trainer(
+            cfg, mesh8, _unet_forward(cfg_model), params, ms,
+            param_pspecs=p_specs, opt_param_pspecs=opt_specs,
+        )
+        for step in range(2):
+            tr.train_step(ds.batch_at(step, 16))
+        for leaf in jax.tree.leaves(tr.state.params):
+            assert leaf.sharding.is_fully_replicated, (
+                "SHARD_GRAD_OP params must remain replicated after step"
+            )
+        moments = [
+            leaf
+            for leaf in jax.tree.leaves(tr.state.opt_state)
+            if hasattr(leaf, "sharding") and leaf.size >= 200
+        ]
+        assert any(
+            not m.sharding.is_fully_replicated for m in moments
+        ), "SHARD_GRAD_OP optimizer moments must be sharded"
+
+    def test_matches_full_shard_numerics(self, mesh8, small_unet):
+        """Layout-only change: SHARD_GRAD_OP and FULL_SHARD are the
+        same computation."""
+        cfg_model, params, ms, ds = small_unet
+        cfg = TrainingConfig(
+            epochs=1, steps_per_epoch=3, global_batch_size=16,
+            learning_rate=1e-2,
+        )
+        p_specs, opt_specs = fsdp.grad_op_pspecs(
+            params, axis_size=8, min_size=200
+        )
+        tr_go = Trainer(
+            cfg, mesh8, _unet_forward(cfg_model), params, ms,
+            param_pspecs=p_specs, opt_param_pspecs=opt_specs,
+        )
+        tr_fs = Trainer(
+            cfg, mesh8, _unet_forward(cfg_model), params, ms,
+            param_pspecs=fsdp.param_pspecs(params, axis_size=8, min_size=200),
+        )
+        r1 = tr_go.fit(ds)
+        r2 = tr_fs.fit(ds)
+        np.testing.assert_allclose(
+            r1["final_loss"], r2["final_loss"], rtol=1e-4
+        )
+
+
+class TestHybridShard:
+    def test_size_must_be_explicit_or_from_mesh(
+        self, mesh_replica_fsdp, small_unet
+    ):
+        """No whole-device-count default: on a 2-axis data mesh that
+        would check divisibility against replica*fsdp and silently
+        under-shard. mesh= derives the inner-axis size instead."""
+        _, params, _, _ = small_unet
+        with pytest.raises(ValueError, match="fsdp_size or mesh"):
+            fsdp.hybrid_shard_pspecs(params, min_size=200)
+        via_mesh = fsdp.hybrid_shard_pspecs(
+            params, min_size=200, mesh=mesh_replica_fsdp
+        )
+        explicit = fsdp.hybrid_shard_pspecs(
+            params, fsdp_size=4, min_size=200
+        )
+        assert jax.tree.map(
+            lambda a, b: a == b, via_mesh, explicit,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def test_param_layout(self, mesh_replica_fsdp, small_unet):
+        """Params shard on the inner fsdp axis only -- replicated
+        across islands (param all-gathers never cross the slow link)."""
+        cfg_model, params, ms, ds = small_unet
+        specs = fsdp.hybrid_shard_pspecs(params, fsdp_size=4, min_size=200)
+        for spec in jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        ):
+            assert "replica" not in [a for a in spec if a is not None]
+        cfg = TrainingConfig(
+            steps_per_epoch=1, global_batch_size=16, learning_rate=1e-2,
+        )
+        tr = Trainer(
+            cfg, mesh_replica_fsdp, _unet_forward(cfg_model), params, ms,
+            param_pspecs=specs,
+            batch_pspec=fsdp.hybrid_shard_batch_pspec(),
+        )
+        tr.train_step(ds.batch_at(0, 16))
+        big = [
+            leaf for leaf in jax.tree.leaves(tr.state.params)
+            if leaf.size >= 200
+        ]
+        assert any(not b.sharding.is_fully_replicated for b in big)
+        for leaf in big:
+            spec = leaf.sharding.spec
+            used = [a for a in spec if a is not None]
+            assert "replica" not in used, (
+                "HYBRID_SHARD params must not shard over the replica axis"
+            )
+
+    def test_matches_dp_numerics(self, mesh_replica_fsdp, mesh8, small_unet):
+        """HYBRID_SHARD over (2 islands x 4 chips) is numerically plain
+        8-way DP: same global batch -> same loss trajectory."""
+        cfg_model, params, ms, ds = small_unet
+        cfg = TrainingConfig(
+            epochs=1, steps_per_epoch=3, global_batch_size=16,
+            learning_rate=1e-2,
+        )
+        tr_hs = Trainer(
+            cfg, mesh_replica_fsdp, _unet_forward(cfg_model), params, ms,
+            param_pspecs=fsdp.hybrid_shard_pspecs(
+                params, fsdp_size=4, min_size=200
+            ),
+            batch_pspec=fsdp.hybrid_shard_batch_pspec(),
+        )
+        tr_dp = Trainer(
+            cfg, mesh8, _unet_forward(cfg_model), params, ms,
+            param_pspecs=dp.param_pspecs(params),
+        )
+        r1 = tr_hs.fit(ds)
+        r2 = tr_dp.fit(ds)
+        np.testing.assert_allclose(
+            r1["final_loss"], r2["final_loss"], rtol=1e-4
+        )

@@ -16,8 +16,8 @@ Sharding-strategy matrix parity (docs/guide/05_fully_sharded_fsdp.md:114-156):
   SHARD_GRAD_OP -> GSPMD equivalent: keep params replicated, shard
                    optimizer state; see ``grad_op_pspecs``
   NO_SHARD      -> dp.param_pspecs (plain DDP)
-  HYBRID_SHARD  -> shard over an inner axis of a 2D data mesh; pass
-                   axis=("replica","fsdp") meshes and shard on "fsdp".
+  HYBRID_SHARD  -> shard over the inner axis of a 2D data mesh and
+                   replicate over the outer: ``hybrid_shard_pspecs``.
 """
 from __future__ import annotations
 
@@ -72,6 +72,54 @@ def grad_op_pspecs(params, axis: str = "data", axis_size: int | None = None,
     replicated = jax.tree.map(lambda _: P(), params)
     sharded = param_pspecs(params, axis, axis_size, min_size)
     return replicated, sharded
+
+
+def hybrid_shard_pspecs(
+    params,
+    fsdp_axis: str = "fsdp",
+    fsdp_size: int | None = None,
+    min_size: int = 100_000,
+    *,
+    mesh=None,
+):
+    """HYBRID_SHARD analogue (docs/guide/05_fully_sharded_fsdp.md:114-156,
+    scripts/02_fully_sharded_fsdp/README.md:133-138): FSDP-shard within
+    a fast island, replicate across islands.
+
+    On GPU clusters the island is a node (shard over NVLink, replicate
+    over the slower fabric); on TPU it is the ICI slice (shard over
+    ICI, replicate across DCN-connected slices). Build a 2D data mesh
+    ``{replica: n_slices, fsdp: chips_per_slice}``; params shard on the
+    inner ``fsdp`` axis only, so the param all-gathers ride the fast
+    links, while gradients are additionally psum-ed over ``replica``
+    (that reduction is the only cross-island traffic -- exactly the
+    DDP-between-nodes / FSDP-within-node tradeoff the reference
+    documents). The batch shards over BOTH axes
+    (``hybrid_shard_batch_pspec``) -- both are data parallelism.
+
+    Pass ``fsdp_size`` (the INNER axis size) or ``mesh`` to derive it.
+    Unlike the 1D recipes there is no whole-device-count default: on a
+    2-axis data mesh that default would check divisibility against
+    replica*fsdp and silently leave params replicated.
+    """
+    if fsdp_size is None:
+        if mesh is None:
+            raise ValueError(
+                "hybrid_shard_pspecs needs fsdp_size or mesh= (the "
+                "inner-axis size; device_count() would be the "
+                "replica*fsdp product and under-shard)"
+            )
+        fsdp_size = mesh.shape[fsdp_axis]
+    return param_pspecs(params, fsdp_axis, fsdp_size, min_size)
+
+
+def hybrid_shard_batch_pspec(
+    replica_axis: str = "replica", fsdp_axis: str = "fsdp"
+) -> P:
+    """Batch spec for HYBRID_SHARD: the leading batch dim shards over
+    the flattened (replica, fsdp) product -- every chip sees distinct
+    data, as in plain DP."""
+    return P((replica_axis, fsdp_axis))
 
 
 def batch_pspec(axis: str = "data") -> P:

@@ -13,6 +13,7 @@ FSDP's all-gather+reduce-scatter into it according to the plan.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -155,10 +156,10 @@ class Trainer:
             params
         )
         model_state = model_state if model_state is not None else {}
+        ms_shardings = jax.tree.map(
+            lambda _: NamedSharding(mesh, P()), model_state
+        )
         if jax.tree.leaves(model_state):
-            ms_shardings = jax.tree.map(
-                lambda _: NamedSharding(mesh, P()), model_state
-            )
             model_state = jax.jit(lambda t: t, out_shardings=ms_shardings)(
                 model_state
             )
@@ -196,7 +197,22 @@ class Trainer:
                 return loss, aux
         self.eval_forward = eval_forward
         self._step_impl = make_step_fn(forward, self.optimizer, cfg.seed)
-        self._train_step = jax.jit(self._step_impl, donate_argnums=(0,))
+        # Pin the output state to the planned layout. Without this the
+        # compiler may propagate a *different* layout through the update
+        # -- concretely, under SHARD_GRAD_OP the new params inherit the
+        # sharded moments' layout from optax.apply_updates, silently
+        # turning replicated-params into FULL_SHARD after one step.
+        self._state_shardings = TrainState(
+            step=NamedSharding(mesh, P()),
+            params=param_shardings,
+            opt_state=opt_shardings,
+            model_state=ms_shardings,
+        )
+        self._train_step = jax.jit(
+            self._step_impl,
+            donate_argnums=(0,),
+            out_shardings=(self._state_shardings, None),
+        )
         self._epoch_fns: Dict[Any, Callable] = {}
         self._eval_fns: Dict[Any, Callable] = {}
         self.meter = ThroughputMeter(n_devices=mesh.size)
@@ -245,7 +261,16 @@ class Trainer:
 
             return jax.lax.scan(body, state, None, length=n_steps)
 
-        fn = jax.jit(epoch_fn, donate_argnums=(0,))
+        fn = jax.jit(
+            epoch_fn,
+            donate_argnums=(0,),
+            out_shardings=(self._state_shardings, None),
+        )
+        # AOT-compile now, outside the caller's timing window: epoch-0
+        # throughput previously included XLA compilation (VERDICT r1
+        # metering note), forcing benches to discard the whole first
+        # epoch. The compiled executable is what gets cached.
+        fn = fn.lower(self.state).compile()
         self._epoch_fns[key] = (fn, dataset)
         return fn
 
@@ -394,8 +419,13 @@ class Trainer:
             # completion of everything dispatched before it). Per-batch
             # block_until_ready bracketing -- the reference's
             # cuda.synchronize pattern -- both breaks pipelining and
-            # under-reports on asynchronous transports. Note: the chunk
-            # containing the first step also pays XLA compilation.
+            # under-reports on asynchronous transports. Per-batch
+            # variance is invisible by design on this path (one
+            # dispatch per chunk); the host-fed fallback below still
+            # meters per batch. Compilation happens inside
+            # _get_epoch_fn (AOT), before the clock starts.
+            if scanned:
+                epoch_fn = self._get_epoch_fn(dataset, chunk)
             jax.device_get(self.state.step)  # drain pending work
             if prof is not None:
                 # Chunked loops advance a whole epoch per dispatch, so
@@ -403,15 +433,22 @@ class Trainer:
                 prof.step(done)
             self.meter.reset()
             self.meter.start_batch()
-            if scanned:
-                self.state, stacked = self._get_epoch_fn(dataset, chunk)(
-                    self.state
-                )
-                last_metrics = jax.tree.map(lambda a: a[-1], stacked)
-            else:
-                for i in range(chunk):
-                    batch = dataset.batch_at(done + i, cfg.global_batch_size)
-                    last_metrics = self.train_step(batch)
+            # Step-boundary marker for XProf per-step breakdowns; the
+            # whole chunk is one dispatch, so one annotation per chunk.
+            ann = (
+                prof.annotate(done) if prof is not None
+                else contextlib.nullcontext()
+            )
+            with ann:
+                if scanned:
+                    self.state, stacked = epoch_fn(self.state)
+                    last_metrics = jax.tree.map(lambda a: a[-1], stacked)
+                else:
+                    for i in range(chunk):
+                        batch = dataset.batch_at(
+                            done + i, cfg.global_batch_size
+                        )
+                        last_metrics = self.train_step(batch)
             float(jax.device_get(last_metrics["loss"]))  # chunk barrier
             self.meter.end_batch(chunk * cfg.global_batch_size)
             done += chunk
