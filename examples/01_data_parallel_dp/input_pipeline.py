@@ -1,0 +1,211 @@
+"""Teaching example: distributed input pipelines on TPU.
+
+Parity with /root/reference/scripts/01_data_parallel_ddp/
+distributed_dataloader.py (302 LoC): that script teaches the GPU input
+stack -- DistributedSampler restricting each rank to an exclusive
+subset, DataLoader(num_workers=4), sampler.set_epoch(epoch) for
+per-epoch reshuffling, and the "do NOT pass shuffle=True with a
+sampler" footgun. This example teaches the same concerns the TPU way,
+where *there is no sampler object*: data placement is a sharding, and
+shard exclusivity is arithmetic on (step, host) indices.
+
+The three lessons:
+
+1. **DistributedSampler -> NamedSharding.** A "global batch" is one
+   jax.Array sharded over the ``data`` mesh axis. Each device holds
+   batch_size/n_devices rows; handing the model a globally-sharded
+   array IS the exclusive-subset guarantee the sampler provided.
+
+2. **set_epoch(epoch) -> fold_in(seed, step).** The reference reshuffles
+   by reseeding a stateful sampler each epoch. Here batches are pure
+   functions of (seed, step): ``batch_at(step)`` folds the step into
+   the RNG key, so every epoch sees fresh data, every host computes the
+   same global batch definition with no coordination, and resume from a
+   checkpoint replays the exact stream from the stored step.
+
+3. **DataLoader(num_workers=4) -> three feeding modes.**
+   a. *On-device traced generation* (synthetic/benchmark data): the
+      generator is jit-traceable, so the whole epoch fuses into one
+      lax.scan dispatch -- zero host involvement (models/datasets.py).
+   b. *Host feed*: each process builds only its LOCAL shard as numpy
+      and assembles the global array with
+      ``jax.make_array_from_process_local_data`` -- the multi-host
+      equivalent of "each rank loads its subset".
+   c. *Native prefetch* (tpu_hpc/native): C++ worker threads keep
+      batches ahead of the loop, the DataLoader(num_workers=N) role.
+
+Run (any chip count, or CPU-sim):
+    python input_pipeline.py --epochs 2
+"""
+import os as _os
+import sys as _sys
+
+# Run directly from a source checkout without installing: put the repo
+# root on sys.path (the reference uses the same pattern, e.g.
+# resnet_fsdp_training.py:27).
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+
+
+# ---------------------------------------------------------------------------
+# Mode (b): the host-feed dataset. Each process materializes ONLY its
+# local rows -- the DistributedSampler exclusive-subset contract.
+# ---------------------------------------------------------------------------
+
+class HostFedToyDataset:
+    """Toy classification pairs (parity: SimpleDataset,
+    distributed_dataloader.py:143-156), fed from host numpy.
+
+    Deterministic in (seed, step): the permutation that the reference
+    derives from ``sampler.set_epoch`` is here a hash of the step --
+    no state, no epoch bookkeeping, no cross-host coordination.
+    """
+
+    def __init__(self, mesh, input_dim=10, n_classes=2, seed=0):
+        self.mesh = mesh
+        self.input_dim = input_dim
+        self.n_classes = n_classes
+        self.seed = seed
+        self.sharding = NamedSharding(mesh, P("data"))
+
+    def _local_rows(self, step: int, global_batch: int):
+        """Rows [lo, hi) of global batch ``step`` owned by this host."""
+        n_proc = jax.process_count()
+        per_host = global_batch // n_proc
+        lo = jax.process_index() * per_host
+        # Row r of step s is generated from an independent stream --
+        # any host could build any row; each builds only its own.
+        rng = np.random.default_rng(
+            [self.seed, step, jax.process_index()]
+        )
+        x = rng.standard_normal((per_host, self.input_dim), np.float32)
+        w_true = np.linspace(-1, 1, self.input_dim, dtype=np.float32)
+        y = (x @ w_true > 0).astype(np.int32)
+        return x, y
+
+    def batch_at(self, step: int, global_batch: int):
+        x_loc, y_loc = self._local_rows(step, global_batch)
+        # Assemble the global sharded array from per-process shards:
+        # the TPU equivalent of "each rank's DataLoader yields its
+        # subset". On one process this is just a sharded device_put.
+        x = jax.make_array_from_process_local_data(self.sharding, x_loc)
+        y = jax.make_array_from_process_local_data(self.sharding, y_loc)
+        return x, y
+
+
+# ---------------------------------------------------------------------------
+# Mode (a): the same data as an on-device traced generator -- the fast
+# path for synthetic data (the Trainer scans the whole epoch on-device).
+# ---------------------------------------------------------------------------
+
+class TracedToyDataset:
+    def __init__(self, input_dim=10, seed=0):
+        self.input_dim = input_dim
+        self.seed = seed
+
+    def traced_batch(self, step, global_batch: int):
+        key = jax.random.fold_in(jax.random.key(self.seed), step)
+        x = jax.random.normal(key, (global_batch, self.input_dim))
+        w_true = jnp.linspace(-1, 1, self.input_dim)
+        y = (x @ w_true > 0).astype(jnp.int32)
+        return x, y
+
+    def batch_at(self, step, global_batch: int):
+        return self.traced_batch(jnp.asarray(step), global_batch)
+
+
+def main(argv=None) -> int:
+    cfg = TrainingConfig.from_args(argv)
+    logger = get_logger()
+    init_distributed()
+    mesh = build_mesh(MeshSpec(axes={"data": -1}))
+    n_dev = mesh.size
+    gb = cfg.global_batch_size
+
+    if jax.process_index() == 0:
+        logger.info("mesh: %s over %d process(es)", dict(mesh.shape),
+                    jax.process_count())
+        logger.info("global batch %d -> %d rows/device", gb, gb // n_dev)
+
+    ds = HostFedToyDataset(mesh, seed=cfg.seed)
+
+    # A global batch is ONE array; its sharding is the "sampler".
+    x0, y0 = ds.batch_at(0, gb)
+    assert x0.shape == (gb, ds.input_dim)  # global view
+    local = x0.addressable_shards
+    if jax.process_index() == 0:
+        logger.info(
+            "lesson 1: x is globally [%d, %d]; this host holds %d "
+            "shard(s) of %s rows each (exclusive subsets, no sampler)",
+            *x0.shape, len(local), local[0].data.shape[0],
+        )
+
+    # Reshuffling: different step -> different rows, deterministically.
+    x1, _ = ds.batch_at(1, gb)
+    assert not np.allclose(np.asarray(x0), np.asarray(x1))
+    xr, _ = ds.batch_at(0, gb)
+    np.testing.assert_array_equal(np.asarray(x0), np.asarray(xr))
+    if jax.process_index() == 0:
+        logger.info(
+            "lesson 2: batch_at(step) is pure -- step 0 replayed "
+            "byte-identically (resume), step 1 fresh (reshuffle)"
+        )
+
+    # Train a toy MLP both ways and compare the loops.
+    from tpu_hpc.parallel import dp
+    from tpu_hpc.train import Trainer
+
+    k0, k1 = jax.random.split(jax.random.key(cfg.seed))
+    params = {
+        "w1": jax.random.normal(k0, (ds.input_dim, 64)) * 0.1,
+        "w2": jax.random.normal(k1, (64, ds.n_classes)) * 0.1,
+    }
+
+    def forward(p, ms, batch, rng):
+        x, y = batch
+        logits = jax.nn.relu(x @ p["w1"]) @ p["w2"]
+        loss = jnp.mean(
+            -jax.nn.log_softmax(logits)[jnp.arange(x.shape[0]), y]
+        )
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, ms, {"accuracy": acc}
+
+    # Host-fed loop: one device_put + one step dispatch per batch.
+    tr = Trainer(cfg, mesh, forward, params,
+                 param_pspecs=dp.param_pspecs(params))
+    host_fed = tr.fit(ds)
+
+    # Traced loop: whole epoch is one dispatch (mode (a)).
+    tr2 = Trainer(cfg, mesh, forward, params,
+                  param_pspecs=dp.param_pspecs(params))
+    traced = tr2.fit(TracedToyDataset(seed=cfg.seed))
+
+    if jax.process_index() == 0:
+        logger.info(
+            "lesson 3: host-fed %.0f items/s vs on-device traced "
+            "%.0f items/s (same model, same arithmetic -- the input "
+            "path is the difference; use mode (b/c) only when the "
+            "host must produce the data)",
+            host_fed["epochs"][-1]["items_per_s"],
+            traced["epochs"][-1]["items_per_s"],
+        )
+        logger.info("done: final losses %.4f / %.4f",
+                    host_fed["final_loss"], traced["final_loss"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

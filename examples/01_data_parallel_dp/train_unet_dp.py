@@ -11,6 +11,17 @@ replicated; XLA emits the fused gradient all-reduce.
 Run (single host, all chips):   python train_unet_dp.py --epochs 3
 Multi-host TPU pod:             see launch/ for the pod launcher.
 """
+import os as _os
+import sys as _sys
+
+# Run directly from a source checkout without installing: put the repo
+# root on sys.path (the reference uses the same pattern, e.g.
+# resnet_fsdp_training.py:27).
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
 import sys
 
 from tpu_hpc.config import TrainingConfig

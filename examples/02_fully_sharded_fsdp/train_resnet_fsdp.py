@@ -14,6 +14,17 @@ TPU-native: ``--strategy ddp`` replicates params (NO_SHARD),
 
 Run: TPU_HPC_SIM_DEVICES=8 python train_resnet_fsdp.py --depth 18 --strategy fsdp
 """
+import os as _os
+import sys as _sys
+
+# Run directly from a source checkout without installing: put the repo
+# root on sys.path (the reference uses the same pattern, e.g.
+# resnet_fsdp_training.py:27).
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
 import argparse
 import json
 import sys

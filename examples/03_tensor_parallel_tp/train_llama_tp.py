@@ -9,6 +9,17 @@ XLA inserts the collectives; on hardware they ride ICI.
 Run (single host, all chips as TP): python train_llama_tp.py \
     --model-parallel 4 --data-parallel 1
 """
+import os as _os
+import sys as _sys
+
+# Run directly from a source checkout without installing: put the repo
+# root on sys.path (the reference uses the same pattern, e.g.
+# resnet_fsdp_training.py:27).
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
 import sys
 
 import jax

@@ -13,6 +13,17 @@ quirk (:372-378); GSPMD inserts one all-reduce per attention/MLP pair.
 Run (8 simulated devices):
   TPU_HPC_SIM_DEVICES=8 python train_vit_tp.py --model-parallel 4
 """
+import os as _os
+import sys as _sys
+
+# Run directly from a source checkout without installing: put the repo
+# root on sys.path (the reference uses the same pattern, e.g.
+# resnet_fsdp_training.py:27).
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
 import sys
 
 import jax

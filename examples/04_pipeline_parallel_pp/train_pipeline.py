@@ -13,6 +13,17 @@ whole schedule is one jitted SPMD program (tpu_hpc/parallel/pp.py).
 
 Run: python train_pipeline.py --pipe-parallel 4 --schedule 1f1b
 """
+import os as _os
+import sys as _sys
+
+# Run directly from a source checkout without installing: put the repo
+# root on sys.path (the reference uses the same pattern, e.g.
+# resnet_fsdp_training.py:27).
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
 import argparse
 import sys
 

@@ -9,6 +9,17 @@ ICI axis, FSDP all-gather/reduce-scatter the outer.
 
 Run: python train_llama_hybrid.py --data-parallel 2 --model-parallel 4
 """
+import os as _os
+import sys as _sys
+
+# Run directly from a source checkout without installing: put the repo
+# root on sys.path (the reference uses the same pattern, e.g.
+# resnet_fsdp_training.py:27).
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
 import sys
 
 import jax

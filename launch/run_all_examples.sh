@@ -19,6 +19,7 @@ FAST="--epochs 1 --steps-per-epoch 3 --global-batch-size 8"
 run() { echo; echo "=== $* ==="; python "$@"; }
 
 run examples/01_data_parallel_dp/train_unet_dp.py       ${FAST}
+run examples/01_data_parallel_dp/input_pipeline.py       ${FAST} --global-batch-size 16
 run examples/02_fully_sharded_fsdp/train_unet_fsdp.py   ${FAST}
 run examples/03_tensor_parallel_tp/train_llama_tp.py    ${FAST}
 run examples/03_tensor_parallel_tp/train_vit_tp.py      ${FAST} --global-batch-size 4
@@ -26,6 +27,7 @@ run examples/04_pipeline_parallel_pp/train_pipeline.py  ${FAST} --global-batch-s
 run examples/04_pipeline_parallel_pp/train_pipeline.py  ${FAST} --global-batch-size 16 --schedule 1f1b
 run examples/05_sequence_parallel/train_llama_sp.py     ${FAST} --global-batch-size 4 --attn ring --seq-len 128
 run examples/05_sequence_parallel/train_llama_sp.py     ${FAST} --global-batch-size 4 --attn ulysses --seq-len 128
+run examples/05_sequence_parallel/train_llama_sp.py     ${FAST} --global-batch-size 4 --attn zigzag --seq-len 128
 run examples/06_hybrid_parallelism/train_llama_hybrid.py ${FAST}
 run examples/07_domain_parallel/train_domain_parallel.py --demo
 run examples/07_domain_parallel/train_domain_parallel.py ${FAST} --global-batch-size 4 --lat 32 --lon 64 --hidden 16

@@ -187,3 +187,19 @@ class TestHaloExchange:
                 lambda ax, p, t: domain.halo_exchange(t, ax, 9),
                 spatial_mesh,
             )(None, x)
+
+
+def test_halo_conv2d_rejects_stride():
+    """stride>1 would need asymmetric SAME padding (k=3, s=2 pads
+    (0,1)); the symmetric halo path would shift window centers, so it
+    must refuse rather than silently diverge from the oracle."""
+    import jax
+
+    x = jnp.zeros((1, 8, 8, 1))
+    kern = jnp.zeros((3, 3, 1, 1))
+    with pytest.raises(NotImplementedError):
+        jax.eval_shape(
+            lambda: domain.halo_conv2d(
+                x, kern, axis_name="spatial", stride=2
+            )
+        )

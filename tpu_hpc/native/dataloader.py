@@ -66,10 +66,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
             ctypes.c_int,
         ]
+        lib.era5_prefetcher_next.restype = ctypes.c_int
         lib.era5_prefetcher_next.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.era5_prefetcher_seek.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.era5_prefetcher_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -132,28 +136,30 @@ class NativeERA5Stream:
         """Next sequential batch from the prefetch ring."""
         x, y = self._alloc()
         step = ctypes.c_int64()
-        self._lib.era5_prefetcher_next(
+        rc = self._lib.era5_prefetcher_next(
             self._handle, _fptr(x), _fptr(y), ctypes.byref(step)
         )
+        if rc != 0:
+            # Shutdown raced the wait: outputs are uninitialized
+            # memory, never hand them to the caller.
+            raise RuntimeError("native prefetcher shut down mid-read")
         self._next_seq = step.value + 1
         return x, y
 
     def batch_at(self, step: int, batch_size: int):
-        """Random-access batch (Trainer contract). Sequential calls are
-        served by the prefetch ring; out-of-order steps generate
-        synchronously -- identical bytes either way."""
+        """Random-access batch (Trainer contract). Any jump -- a
+        checkpoint resume at step N, or true random access -- reseeks
+        the prefetch ring to ``step``, so sequential consumption from
+        there stays prefetched (identical bytes regardless of path:
+        batches are pure functions of (seed, step))."""
         if batch_size != self.batch_size:
             raise ValueError(
                 f"batch {batch_size} != stream batch {self.batch_size}"
             )
-        if step == self._next_seq:
-            return self.next()
-        x, y = self._alloc()
-        self._lib.era5_gen(
-            self.batch_size, self.lat, self.lon, self.channels,
-            self.seed, step, _fptr(x), _fptr(y),
-        )
-        return x, y
+        if step != self._next_seq:
+            self._lib.era5_prefetcher_seek(self._handle, step)
+            self._next_seq = step
+        return self.next()
 
     def close(self) -> None:
         if getattr(self, "_handle", None):

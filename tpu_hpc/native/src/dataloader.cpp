@@ -114,11 +114,13 @@ class Prefetcher {
     for (auto& w : workers_) w.join();
   }
 
-  void Next(float* x, float* y, int64_t* step_out) {
+  // Returns 0 on success, 1 if the prefetcher is shutting down (the
+  // output buffers are untouched in that case -- callers must check).
+  int Next(float* x, float* y, int64_t* step_out) {
     std::unique_lock<std::mutex> lk(mu_);
     const int64_t want = next_out_++;
     cv_ready_.wait(lk, [&] { return ready_.count(want) || stop_; });
-    if (stop_) return;
+    if (!ready_.count(want)) return 1;  // stopped before it was built
     Slot slot = std::move(ready_[want]);
     ready_.erase(want);
     lk.unlock();
@@ -126,12 +128,27 @@ class Prefetcher {
     std::memcpy(x, slot.x.data(), slot.x.size() * sizeof(float));
     std::memcpy(y, slot.y.data(), slot.y.size() * sizeof(float));
     *step_out = slot.step;
+    return 0;
+  }
+
+  // Resync the ring to an arbitrary step (checkpoint resume: the
+  // consumer restarts at step N, the ring must follow, not keep
+  // filling 0..depth-1 forever). In-flight generations from before
+  // the seek are discarded on publish via the epoch tag.
+  void Seek(int64_t step) {
+    std::lock_guard<std::mutex> lk(mu_);
+    ++epoch_;
+    next_gen_ = step;
+    next_out_ = step;
+    ready_.clear();
+    cv_free_.notify_all();
   }
 
  private:
   void Work() {
     for (;;) {
       int64_t step;
+      uint64_t epoch;
       {
         std::unique_lock<std::mutex> lk(mu_);
         cv_free_.wait(lk, [&] {
@@ -140,6 +157,7 @@ class Prefetcher {
         });
         if (stop_) return;
         step = next_gen_++;
+        epoch = epoch_;
       }
       Slot slot;
       slot.step = step;
@@ -148,7 +166,7 @@ class Prefetcher {
       gen_batch(cfg_, step, slot.x.data(), slot.y.data());
       {
         std::lock_guard<std::mutex> lk(mu_);
-        ready_[step] = std::move(slot);
+        if (epoch == epoch_) ready_[step] = std::move(slot);
       }
       cv_ready_.notify_all();
     }
@@ -157,6 +175,7 @@ class Prefetcher {
   GenConfig cfg_;
   int depth_;
   int64_t next_gen_, next_out_;
+  uint64_t epoch_ = 0;
   bool stop_;
   std::mutex mu_;
   std::condition_variable cv_free_, cv_ready_;
@@ -182,8 +201,13 @@ void* era5_prefetcher_create(int64_t batch, int64_t lat, int64_t lon,
   return new Prefetcher(cfg, depth, n_threads);
 }
 
-void era5_prefetcher_next(void* p, float* x, float* y, int64_t* step_out) {
-  static_cast<Prefetcher*>(p)->Next(x, y, step_out);
+// Returns 0 on success, 1 on shutdown (outputs untouched).
+int era5_prefetcher_next(void* p, float* x, float* y, int64_t* step_out) {
+  return static_cast<Prefetcher*>(p)->Next(x, y, step_out);
+}
+
+void era5_prefetcher_seek(void* p, int64_t step) {
+  static_cast<Prefetcher*>(p)->Seek(step);
 }
 
 void era5_prefetcher_destroy(void* p) { delete static_cast<Prefetcher*>(p); }

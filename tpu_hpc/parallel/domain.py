@@ -74,8 +74,19 @@ def halo_conv2d(
     Exchanges kh//2 halo rows, then runs a VALID conv on the padded
     tile (W still zero-padded locally), reproducing the single-device
     SAME conv exactly (the fix for the boundary corruption demo,
-    10_domain_parallel.md:69-103). ``stride`` > 1 requires H_loc and W
-    divisible by it."""
+    10_domain_parallel.md:69-103).
+
+    Only ``stride=1`` is supported: XLA SAME padding is asymmetric
+    when the total pad is odd (k=3, s=2 pads (0, 1)), while the halo
+    path pads kh//2 rows on both sides, so a strided halo conv would
+    silently shift output window centers relative to the single-device
+    oracle. Strided downsampling in a domain-parallel model should
+    pool/stride in the unsharded W dim or re-tile instead."""
+    if stride != 1:
+        raise NotImplementedError(
+            "halo_conv2d supports stride=1 only (asymmetric SAME "
+            "padding under stride>1 breaks oracle equivalence)"
+        )
     kh, kw = kernel.shape[0], kernel.shape[1]
     pad_h, pad_w = kh // 2, kw // 2
     xp = halo_exchange(x, axis_name, pad_h, axis=1, wrap=wrap)
