@@ -41,12 +41,16 @@ def peak_flops_per_chip(device) -> float:
 
 def bench_llama(
     steps: int = 20, remat: bool = False, batch_per_dp: int = 4,
-    attn: str = "flash",
+    attn: str = "flash", block_q: int = 512, block_k: int = 512,
 ) -> dict:
     """Best measured single-chip config (v5e): no remat (model fits
-    HBM comfortably; remat costs ~14% -- 40.8% vs 47.2% MFU), Pallas
-    flash attention (+8 MFU points over the XLA einsum path), batch 4
-    (batch 8 loses ~3.6 points to memory pressure)."""
+    HBM comfortably; remat costs ~14%), Pallas flash attention with
+    512/512 blocks (+8 MFU points over the XLA einsum path; 1024 or
+    256 blocks each cost ~0.6-2.5 points), batch 4 (batch 8 loses ~6
+    points to memory pressure, batch 2 ~3 to underfill). Round-2
+    additions: gather-forward/matmul-backward embedding (+1.9 points
+    over forward one-hot) and contiguous-pair RoPE (+1.2) -> 50.9%
+    MFU / ~110k tokens/s/chip at 30 steps."""
     import jax
     import jax.numpy as jnp
 
@@ -70,7 +74,9 @@ def bench_llama(
             g = q.shape[2] // k.shape[2]
             k = jnp.repeat(k, g, axis=2)
             v = jnp.repeat(v, g, axis=2)
-        out, _ = blockwise_attention(q, k, v, causal=True)
+        out, _ = blockwise_attention(
+            q, k, v, causal=True, block_q=block_q, block_k=block_k
+        )
         return out
 
     def make_attn_fn(mesh, tp_size):
@@ -277,13 +283,18 @@ def main() -> int:
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--attn", choices=("flash", "xla"), default="flash")
+    ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--block-k", type=int, default=512)
     ap.add_argument(
         "--sp-mode", choices=("ring", "zigzag", "ulysses"),
         default="zigzag",
     )
     args = ap.parse_args()
     if args.workload == "llama":
-        rec = bench_llama(args.steps, args.remat, args.batch, args.attn)
+        rec = bench_llama(
+            args.steps, args.remat, args.batch, args.attn,
+            args.block_q, args.block_k,
+        )
     elif args.workload == "llama-sp":
         rec = bench_llama_sp(args.steps, args.batch, args.sp_mode)
     else:

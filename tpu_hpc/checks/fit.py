@@ -164,10 +164,13 @@ def activation_model(
     lse = bl * h_loc * seq_len * f32
     mlp = 2 * bl * seq_len * ffn_loc * bf16
     block_live = 2 * (bl * seq_len * d * bf16 + qkv + attn_out + lse + mlp)
-    # LM head: logits are vocab-sharded (output Colwise); fp32 logits +
-    # fp32 grad + the one-hot targets/embedding operand in bf16.
+    # LM head: logits are vocab-sharded (output Colwise) and stay in
+    # bf16 -- the loss upcasts inside its fused reductions, so no
+    # [B, S, V] fp32 buffer exists (models/llama2.py Llama.__call__).
+    # bf16 logits + bf16 logit-grad + one fp32 reduction pass that XLA
+    # may materialise while fusing logsumexp.
     vocab_loc = cfg.vocab_size // tp_size
-    head = bl * seq_len * vocab_loc * (2 * f32 + bf16)
+    head = bl * seq_len * vocab_loc * (2 * bf16 + f32)
     return {
         "residual_checkpoints": checkpoints,
         "block_recompute_live": block_live,

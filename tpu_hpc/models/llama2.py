@@ -27,6 +27,7 @@ TPU-first design (not a translation):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.linen as nn
@@ -43,6 +44,35 @@ AttnFn = Optional[Callable[[jax.Array, jax.Array, jax.Array], jax.Array]]
 
 def _identity(x: jax.Array) -> jax.Array:
     return x
+
+
+@functools.lru_cache(maxsize=None)
+def _make_embed_lookup(vocab: int, table_dtype: str):
+    """table[tokens] with a scatter-free backward (see
+    LlamaConfig.iota_embed). Factory keyed on the static (vocab,
+    dtype) so the custom_vjp residual is just the token array."""
+
+    @jax.custom_vjp
+    def lookup(table: jax.Array, tokens: jax.Array) -> jax.Array:
+        return jnp.take(table, tokens, axis=0)
+
+    def fwd(table, tokens):
+        return lookup(table, tokens), tokens
+
+    def bwd(tokens, g):
+        # dtable[v] = sum over positions with token v of g --
+        # expressed as one MXU matmul (one-hot rows are exact
+        # selectors) instead of the gather-transpose scatter-add.
+        onehot = jax.nn.one_hot(tokens, vocab, dtype=g.dtype)
+        dtable = jax.lax.dot_general(
+            onehot.reshape(-1, vocab), g.reshape(-1, g.shape[-1]),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dtable.astype(table_dtype), None
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,9 +93,12 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16       # compute dtype (the reference's
     param_dtype: Any = jnp.float32  # use_amp/amp_dtype pair, utils/config.py:40-44)
     remat: bool = False
-    # One-hot-matmul embedding lookup instead of gather: rides the MXU
-    # and its transpose is a matmul instead of a scatter-add (TPU
-    # scatters serialize -- this is the standard iota-embed trick).
+    # Matmul-backward embedding lookup: forward is a plain gather
+    # (cheap on TPU), but the gradient is computed as one_hot^T @ g on
+    # the MXU instead of the gather's transpose scatter-add (TPU
+    # scatters serialize; ~5x step slowdown measured). Forward-side
+    # one-hot (the naive iota-embed trick) would burn an extra
+    # 2*d*vocab FLOPs/token and a [B, S, V] buffer for no benefit.
     iota_embed: bool = True
 
     @property
@@ -124,9 +157,12 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     """Rotate [B, S, H, D] by position. Adjacent-pair convention, fp32
     rotation, result cast back (parity: apply_rotary_emb :58-100)."""
     orig_dtype = x.dtype
-    xf = x.astype(jnp.float32)
-    x1 = xf[..., 0::2]
-    x2 = xf[..., 1::2]
+    # Adjacent pairs via a trailing [D//2, 2] reshape -- identical
+    # values to the x[..., 0::2]/[..., 1::2] formulation but with
+    # contiguous (not lane-strided) access on the minor dim.
+    xf = x.astype(jnp.float32).reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
+    x1 = xf[..., 0]
+    x2 = xf[..., 1]
     c = cos[None, :, None, :]
     s = sin[None, :, None, :]
     r1 = x1 * c - x2 * s
@@ -286,10 +322,13 @@ class Llama(nn.Module):
             name="tok_embeddings",
         )
         if cfg.iota_embed:
-            # lookup == one_hot @ table (exact: one-hot rows select the
-            # same bf16-cast values the gather would).
-            onehot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
-            x = jnp.dot(onehot, emb.embedding.astype(cfg.dtype))
+            # Gather forward, matmul backward (no scatter, no forward
+            # one-hot); values identical to emb(tokens) up to the
+            # compute-dtype cast.
+            lookup = _make_embed_lookup(
+                cfg.vocab_size, jnp.dtype(cfg.dtype).name
+            )
+            x = lookup(emb.embedding.astype(cfg.dtype), tokens)
         else:
             x = emb(tokens)
         x = self.constrain(x)
@@ -309,7 +348,13 @@ class Llama(nn.Module):
             kernel_init=nn.initializers.truncated_normal(stddev=0.02),
             name="output",
         )(x)
-        return logits.astype(jnp.float32)
+        # Logits stay in compute dtype: the loss upcasts INSIDE its
+        # reductions (losses.cross_entropy), so XLA fuses the fp32
+        # cast instead of materialising a [B, S, V] fp32 array in HBM
+        # (~1 GiB/step at bench shapes). Value-exact either way -- the
+        # matmul output is already rounded to cfg.dtype before any
+        # cast.
+        return logits
 
 
 def init_llama(
@@ -330,7 +375,8 @@ def apply_llama(
     constrain: Constrain = _identity,
     attn_fn: AttnFn = None,
 ) -> jax.Array:
-    """[B, S] int tokens -> [B, S, vocab] fp32 logits."""
+    """[B, S] int tokens -> [B, S, vocab] logits in cfg.dtype (the
+    loss upcasts to fp32 inside its reductions; see Llama.__call__)."""
     return Llama(cfg, constrain, attn_fn).apply({"params": params}, tokens)
 
 

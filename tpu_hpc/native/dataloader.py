@@ -121,6 +121,7 @@ class NativeERA5Stream:
             self.seed, self.prefetch_depth, self.n_threads,
         )
         self._next_seq = 0
+        self._resync_at: Optional[int] = None
 
     @property
     def sample_shape(self) -> Tuple[int, int, int]:
@@ -147,19 +148,36 @@ class NativeERA5Stream:
         return x, y
 
     def batch_at(self, step: int, batch_size: int):
-        """Random-access batch (Trainer contract). Any jump -- a
-        checkpoint resume at step N, or true random access -- reseeks
-        the prefetch ring to ``step``, so sequential consumption from
-        there stays prefetched (identical bytes regardless of path:
-        batches are pure functions of (seed, step))."""
+        """Random-access batch (Trainer contract). Identical bytes on
+        every path (batches are pure functions of (seed, step)).
+
+        A one-off jump generates synchronously and leaves the ring
+        untouched (a mid-training eval re-read must not discard the
+        training stream's prefetched window). When the NEXT read
+        continues sequentially from the jump -- the checkpoint-resume
+        pattern -- the ring is reseeked there and prefetching resumes.
+        """
         if batch_size != self.batch_size:
             raise ValueError(
                 f"batch {batch_size} != stream batch {self.batch_size}"
             )
-        if step != self._next_seq:
+        if step == self._next_seq:
+            self._resync_at = None
+            return self.next()
+        if step == self._resync_at:
+            # Second sequential read after a jump: this is a new
+            # stream, not random access -- move the ring to it.
             self._lib.era5_prefetcher_seek(self._handle, step)
             self._next_seq = step
-        return self.next()
+            self._resync_at = None
+            return self.next()
+        self._resync_at = step + 1
+        x, y = self._alloc()
+        self._lib.era5_gen(
+            self.batch_size, self.lat, self.lon, self.channels,
+            self.seed, step, _fptr(x), _fptr(y),
+        )
+        return x, y
 
     def close(self) -> None:
         if getattr(self, "_handle", None):
