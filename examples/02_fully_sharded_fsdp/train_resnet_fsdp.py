@@ -8,9 +8,13 @@ Parity with two reference workloads in one script:
     with min_num_params=1e5 + FULL_SHARD and the CIFAR conv1 surgery
     (:186-212).
 
-TPU-native: ``--strategy ddp`` replicates params (NO_SHARD),
-``--strategy fsdp`` shards every >=1e5-param tensor over the data axis
-(FULL_SHARD); both are PartitionSpec plans over the same jitted step.
+TPU-native: the full FSDP sharding-strategy matrix
+(docs/guide/05_fully_sharded_fsdp.md:114-156) as one flag -- every mode
+is a PartitionSpec plan over the same jitted step:
+  --strategy ddp          NO_SHARD       params replicated
+  --strategy fsdp         FULL_SHARD     params/grads/moments sharded
+  --strategy grad-op      SHARD_GRAD_OP  params replicated, moments sharded
+  --strategy hybrid       HYBRID_SHARD   shard within an island, replicate across
 
 Run: TPU_HPC_SIM_DEVICES=8 python train_resnet_fsdp.py --depth 18 --strategy fsdp
 """
@@ -45,14 +49,33 @@ def main(argv=None) -> int:
     extra = argparse.ArgumentParser(add_help=False)
     extra.add_argument("--depth", type=int, default=18,
                        choices=sorted(resnet.STAGE_SIZES))
-    extra.add_argument("--strategy", choices=("ddp", "fsdp"),
-                       default="fsdp")
+    extra.add_argument(
+        "--strategy", choices=("ddp", "fsdp", "grad-op", "hybrid"),
+        default="fsdp",
+    )
+    extra.add_argument(
+        "--replica-groups", type=int, default=None,
+        help="HYBRID_SHARD only: number of replica islands "
+             "(default: 2 when the device count allows, else 1)",
+    )
     extra.add_argument("--log-file", default="resnet_benchmark.log")
     ns, _ = extra.parse_known_args(argv)
 
     logger = get_logger()
     init_distributed()
-    mesh = build_mesh(MeshSpec(axes={"data": -1}))
+    if ns.strategy == "hybrid":
+        r = ns.replica_groups
+        if r is None:
+            r = 2 if jax.device_count() % 2 == 0 else 1
+        if jax.device_count() % r:
+            raise SystemExit(
+                f"--replica-groups {r} must divide {jax.device_count()}"
+            )
+        mesh = build_mesh(
+            MeshSpec(axes={"replica": r, "fsdp": jax.device_count() // r})
+        )
+    else:
+        mesh = build_mesh(MeshSpec(axes={"data": -1}))
     param_dtype, compute_dtype = cfg.jax_dtypes()
     model_cfg = resnet.ResNetConfig(
         depth=ns.depth, dtype=compute_dtype, param_dtype=param_dtype,
@@ -66,15 +89,25 @@ def main(argv=None) -> int:
         ns.depth, n_params / 1e6, ns.strategy, mesh.size,
     )
 
-    specs = (
-        fsdp.param_pspecs(params, axis_size=mesh.shape["data"])
-        if ns.strategy == "fsdp"
-        else dp.param_pspecs(params)
-    )
+    opt_specs = None
+    batch_spec = dp.batch_pspec()
+    if ns.strategy == "fsdp":
+        specs = fsdp.param_pspecs(params, axis_size=mesh.shape["data"])
+    elif ns.strategy == "grad-op":
+        specs, opt_specs = fsdp.grad_op_pspecs(
+            params, axis_size=mesh.shape["data"]
+        )
+    elif ns.strategy == "hybrid":
+        specs = fsdp.hybrid_shard_pspecs(params, mesh=mesh)
+        batch_spec = fsdp.hybrid_shard_batch_pspec()
+    else:
+        specs = dp.param_pspecs(params)
     ds = datasets.CIFARSynthetic()
     trainer = Trainer(
         cfg, mesh, resnet.make_forward(model_cfg), params, model_state,
         param_pspecs=specs,
+        opt_param_pspecs=opt_specs,
+        batch_pspec=batch_spec,
         eval_forward=resnet.make_eval_forward(model_cfg),
     )
     t0 = time.perf_counter()
