@@ -67,15 +67,21 @@ def attention_reference(
 ) -> Tuple[jax.Array, jax.Array]:
     """Softmax attention of a Q chunk against a KV chunk.
 
-    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]. Returns (out [B, Sq, H, D]
-    in q.dtype, lse [B, Sq, H] fp32). ``causal`` masks using global
-    positions ``q_offset + i >= kv_offset + j``; a fully-masked row
-    yields out=0, lse=MASK_VALUE (so it merges as a no-op).
+    q: [B, Sq, Hq, D]; k, v: [B, Sk, Hkv, D] with Hq a multiple of
+    Hkv (GQA handled by a grouped query view -- K/V are broadcast
+    over the group dim, never materialised repeated). Returns
+    (out [B, Sq, Hq, D] in q.dtype, lse [B, Sq, Hq] fp32). ``causal``
+    masks using global positions ``q_offset + i >= kv_offset + j``; a
+    fully-masked row yields out=0, lse=MASK_VALUE (so it merges as a
+    no-op).
     """
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
-    qf = q.astype(jnp.float32)
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, sq, hkv, g, d)
     kf = k.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf) * scale
     if causal:
         rows = q_offset + jnp.arange(q.shape[1])[:, None]
         cols = kv_offset + jnp.arange(k.shape[1])[None, :]
@@ -87,10 +93,12 @@ def attention_reference(
     )
     l = jnp.sum(p, axis=-1)
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
-    out = out / l_safe.transpose(0, 2, 1)[..., None].astype(out.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v)
+    out = out.reshape(b, sq, hq, d)
+    l_t = l_safe.transpose(0, 3, 1, 2).reshape(b, sq, hq)
+    out = out / l_t[..., None].astype(out.dtype)
     lse = m + jnp.log(l_safe)  # fully masked: MASK_VALUE + 0
-    return out.astype(q.dtype), lse.transpose(0, 2, 1)
+    return out.astype(q.dtype), lse.transpose(0, 3, 1, 2).reshape(b, sq, hq)
 
 
 def lse_merge(
@@ -211,7 +219,12 @@ def _flash_forward(
     block_k: int,
     interpret: bool,
 ) -> Tuple[jax.Array, jax.Array]:
-    """[B, Sq, H, D] x [B, Sk, H, D] -> (out, lse [B, Sq, H]).
+    """[B, Sq, Hq, D] x [B, Sk, Hkv, D] -> (out, lse [B, Sq, Hq]).
+
+    GQA (Hkv < Hq): the grid runs over B*Hq query heads and the K/V
+    BlockSpec index maps fold the group factor, so each group shares
+    one K/V head straight out of HBM -- no repeated K/V is ever
+    materialised.
 
     Arbitrary seq lens: pad to a block multiple (blocks clamp to the
     128-aligned length for short sequences, keeping TPU lane tiling),
@@ -219,6 +232,10 @@ def _flash_forward(
     outputs.
     """
     b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"GQA needs Hq % Hkv == 0, got {h} % {hkv}")
+    g = h // hkv
     sk = k.shape[1]
     block_q = min(block_q, _round_up(sq, 128))
     block_k = min(block_k, _round_up(sk, 128))
@@ -231,10 +248,15 @@ def _flash_forward(
         v = _pad_seq(v, sk_p - sk)
     # [B, S, H, D] -> [B*H, S, D]: heads become the parallel grid dim.
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk_p, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk_p, d)
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1, 1)
+
+    # Query-head grid index -> shared KV head (head-major grouping:
+    # q head hq maps to kv head hq // g).
+    def kv_head(bh):
+        return (bh // h) * hkv + (bh % h) // g
 
     grid = (b * h, sq_p // block_q, sk_p // block_k)
     kernel = functools.partial(
@@ -259,11 +281,11 @@ def _flash_forward(
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                (1, block_k, d), lambda bh, i, j: (kv_head(bh), j, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                (1, block_k, d), lambda bh, i, j: (kv_head(bh), j, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
@@ -474,8 +496,13 @@ def _flash_backward(
     q, k, v, out, lse, dout, dlse, q_offset, kv_offset,
     *, causal, sm_scale, block_q, block_k, interpret,
 ):
-    """[B, S, H, D] layouts in, (dq, dk, dv) out."""
+    """[B, S, H, D] layouts in, (dq, dk, dv) out. GQA: k/v carry Hkv
+    heads; dk/dv are computed per *query* head on the grid and
+    group-summed at the end (matching d(repeat)/dk = sum-over-group),
+    while K/V themselves are read via the shared-head index map."""
     b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
     sk = k.shape[1]
     block_q = min(block_q, _round_up(sq, 128))
     block_k = min(block_k, _round_up(sk, 128))
@@ -496,8 +523,11 @@ def _flash_backward(
         k = _pad_seq(k, sk_p - sk)
         v = _pad_seq(v, sk_p - sk)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk_p, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk_p, d)
+
+    def kv_head(bh):
+        return (bh // h) * hkv + (bh % h) // g
     dot = dout.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
     lse_t = lse.transpose(0, 2, 1).reshape(b * h, sq_p, 1)
     # D - dlse folded into one per-row vector: ds = P*(dP - D + dlse).
@@ -522,6 +552,14 @@ def _flash_backward(
             memory_space=pltpu.VMEM,
         )
 
+    def kvspec(blk, which):
+        return pl.BlockSpec(
+            (1, blk, d),
+            (lambda bh, i, j: (kv_head(bh), i, 0)) if which == "i"
+            else (lambda bh, i, j: (kv_head(bh), j, 0)),
+            memory_space=pltpu.VMEM,
+        )
+
     def rspec(blk, which):
         return pl.BlockSpec(
             (1, blk, 1),
@@ -538,7 +576,7 @@ def _flash_backward(
         grid=(b * h, sq_p // block_q, sk_p // block_k),
         in_specs=[
             smem, smem,
-            vspec(block_q, "i"), vspec(block_k, "j"), vspec(block_k, "j"),
+            vspec(block_q, "i"), kvspec(block_k, "j"), kvspec(block_k, "j"),
             vspec(block_q, "i"), rspec(block_q, "i"), rspec(block_q, "i"),
         ],
         out_specs=vspec(block_q, "i"),
@@ -555,7 +593,7 @@ def _flash_backward(
         grid=(b * h, sk_p // block_k, sq_p // block_q),
         in_specs=[
             smem, smem,
-            vspec(block_q, "j"), vspec(block_k, "i"), vspec(block_k, "i"),
+            vspec(block_q, "j"), kvspec(block_k, "i"), kvspec(block_k, "i"),
             vspec(block_q, "j"), rspec(block_q, "j"), rspec(block_q, "j"),
         ],
         out_specs=[vspec(block_k, "i"), vspec(block_k, "i")],
@@ -573,9 +611,15 @@ def _flash_backward(
     unflat = lambda x, sp, s: (
         x.reshape(b, h, sp, d).transpose(0, 2, 1, 3)[:, :s]
     )  # noqa: E731
-    return (
-        unflat(dq, sq_p, sq), unflat(dk, sk_p, sk), unflat(dv, sk_p, sk)
-    )
+    dq = unflat(dq, sq_p, sq)
+    dk = unflat(dk, sk_p, sk)
+    dv = unflat(dv, sk_p, sk)
+    if g > 1:
+        # Per-query-head dk/dv -> shared-head gradients (the
+        # sum-over-group that d(repeat_kv) would have produced).
+        dk = dk.reshape(b, sk, hkv, g, d).sum(axis=3)
+        dv = dv.reshape(b, sk, hkv, g, d).sum(axis=3)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -598,6 +642,12 @@ def blockwise_attention(
     """Chunk attention with LSE; ``impl`` in {auto, xla, pallas,
     pallas_interpret}. ``auto`` picks the Pallas kernel on TPU and the
     XLA path elsewhere (CPU-simulated meshes in tests)."""
+    if q.shape[2] % k.shape[2]:
+        # Checked here for BOTH impls: the Pallas index maps would
+        # otherwise silently read cross-batch / clamped KV heads.
+        raise ValueError(
+            f"GQA needs Hq % Hkv == 0, got {q.shape[2]} % {k.shape[2]}"
+        )
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":

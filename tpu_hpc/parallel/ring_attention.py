@@ -53,19 +53,17 @@ def ring_attention(
     """In-shard_map form. q: [B, S_local, Hq, D]; k, v: [B, S_local,
     Hkv, D] -- the local sequence shards. Returns [B, S_local, Hq, D].
 
-    GQA (Hkv < Hq) is handled by repeating KV chunk-locally -- the
-    ring only ever moves the small Hkv chunks.
+    GQA (Hkv < Hq): the ring only ever moves the small Hkv chunks,
+    and the attention kernel reads the shared heads directly (grouped
+    query view / per-group index maps) -- repeated K/V is never
+    materialised anywhere.
     """
     n = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     s_local = q.shape[1]
-    groups = q.shape[2] // k.shape[2]
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def chunk(k_cur, v_cur, step):
-        if groups > 1:
-            k_cur = jnp.repeat(k_cur, groups, axis=2)
-            v_cur = jnp.repeat(v_cur, groups, axis=2)
         # After `step` rotations device `me` holds the chunk that
         # originated on device (me - step) mod n.
         src = jax.lax.rem(me - step + n, n)
@@ -177,15 +175,11 @@ def zigzag_ring_attention(
     n = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     c = q.shape[1] // 2
-    groups = q.shape[2] // k.shape[2]
     perm = [(j, (j + 1) % n) for j in range(n)]
     # Global chunk offsets of the local Q pair (original coordinates).
     q_offs = (me * c, (2 * n - 1 - me) * c)
 
     def attend(qc, q_off, kc, vc, k_off):
-        if groups > 1:
-            kc = jnp.repeat(kc, groups, axis=2)
-            vc = jnp.repeat(vc, groups, axis=2)
         return blockwise_attention(
             qc, kc, vc, causal=causal,
             q_offset=q_off, kv_offset=k_off,

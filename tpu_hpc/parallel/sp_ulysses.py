@@ -46,8 +46,16 @@ def ulysses_attention(
 ) -> jax.Array:
     """In-shard_map form. q: [B, S_local, Hq, D]; k, v: [B, S_local,
     Hkv, D]. All-to-all to [B, S, H/n, D], full attention locally,
-    all-to-all back. KV heads are repeated up to Hq first when GQA
-    grouping does not divide by the degree."""
+    all-to-all back.
+
+    GQA: when Hkv divides the degree, K/V are exchanged at their own
+    (smaller) head count -- after the all-to-all, local q head j maps
+    to local kv head j // g exactly ((r*g*hkv/n + j) // g ==
+    r*hkv/n + j//g), so the kernel's grouped view applies directly
+    and no repeated K/V is materialised. Only when Hkv % n != 0 must
+    K/V be repeated up to Hq before the exchange (heads are the
+    all-to-all's split axis).
+    """
     n = jax.lax.axis_size(axis_name)
     validate_ulysses_degree(q.shape[2], n)
     if k.shape[2] % n != 0:
@@ -61,10 +69,6 @@ def ulysses_attention(
         )
 
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    groups = qg.shape[2] // kg.shape[2]
-    if groups > 1:
-        kg = jnp.repeat(kg, groups, axis=2)
-        vg = jnp.repeat(vg, groups, axis=2)
     out, _ = blockwise_attention(
         qg, kg, vg, causal=causal,
         impl=impl, block_q=block_q, block_k=block_k,
