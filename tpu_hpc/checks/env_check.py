@@ -92,6 +92,75 @@ def _smoke_all_reduce() -> Tuple[bool, str]:
         return False, f"all-reduce smoke test raised: {e!r}"
 
 
+def chip_microbench(
+    dim: int = 4096, iters: int = 10
+) -> Dict[str, float]:
+    """Per-chip burn-in numbers: dense bf16 matmul TFLOP/s and HBM
+    copy GB/s, measured on device 0.
+
+    The role of the reference's per-GPU props dump + single-device
+    NCCL smoke (test_env.py:54-79), upgraded to *measured* rates: a
+    chip delivering far below its spec sheet (thermal throttle, wrong
+    binding, sharing) shows up here before any training run does.
+    """
+    import time
+
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    key = jax.random.key(0)
+    a = jax.device_put(
+        jax.random.normal(key, (dim, dim), jnp.bfloat16), d
+    )
+
+    # Two rules for honest numbers on remote/async transports: loops
+    # live INSIDE one jit (per-dispatch latency otherwise dominates),
+    # and completion is forced with a VALUE fetch -- on tunneled
+    # backends block_until_ready can return before execution, and a
+    # device_get carries a fixed round-trip latency (~65 ms observed),
+    # so the rate is the MARGINAL cost between two iteration counts.
+    def run(n, fn, x):
+        f = jax.jit(
+            lambda x: jnp.sum(
+                jax.lax.fori_loop(0, n, fn, x).astype(jnp.float32)
+            )
+        )
+        float(jax.device_get(f(x)))  # compile + warm
+        t0 = time.perf_counter()
+        float(jax.device_get(f(x)))
+        return time.perf_counter() - t0
+
+    def marginal(t_long, t_short, what):
+        dt = t_long - t_short
+        if dt <= 1e-4:
+            # Timing noise swamped the marginal cost: report failure
+            # instead of a clamped (absurdly large) rate that would
+            # mask the throttled-chip condition this check exists for.
+            raise RuntimeError(
+                f"{what} timing indeterminate (dt={dt * 1e3:.2f} ms); "
+                "host too noisy for a marginal-rate measurement"
+            )
+        return dt
+
+    # *1e-3 keeps the iterated matmul finite (cost unchanged).
+    mmstep = lambda i, y: (y @ y) * jnp.bfloat16(1e-3)  # noqa: E731
+    dt = marginal(
+        run(10 + iters * 10, mmstep, a), run(10, mmstep, a), "matmul"
+    )
+    tflops = 2 * dim**3 * iters * 10 / dt / 1e12
+
+    big = jax.device_put(
+        jnp.zeros((256, 1024, 1024), jnp.float32), d
+    )  # 1 GiB
+    cpstep = lambda i, y: y + 1.0  # noqa: E731
+    dt = marginal(
+        run(5 + iters * 5, cpstep, big), run(5, cpstep, big), "hbm copy"
+    )
+    # read + write per pass.
+    gbs = 2 * big.nbytes * iters * 5 / dt / 1e9
+    return {"matmul_tflops": tflops, "hbm_gb_s": gbs}
+
+
 def check_environment(verbose: bool = True) -> Dict:
     """Run all checks; return a report dict with a pass/fail summary
     (parity: check_environment.py:349-373)."""
@@ -120,6 +189,16 @@ def check_environment(verbose: bool = True) -> Dict:
             ("ici_coords", all(c is not None for c in coords),
              f"chip coords: {coords}")
         )
+        try:
+            rates = chip_microbench()
+            report["microbench"] = rates
+            checks.append((
+                "chip_microbench", rates["matmul_tflops"] > 10,
+                f"{rates['matmul_tflops']:.0f} bf16 TFLOP/s, "
+                f"{rates['hbm_gb_s']:.0f} GB/s HBM",
+            ))
+        except Exception as e:  # pragma: no cover
+            checks.append(("chip_microbench", False, f"raised: {e!r}"))
 
     report["checks"] = [
         {"name": n, "passed": p, "detail": d} for n, p, d in checks

@@ -1,4 +1,6 @@
 from tpu_hpc.native.dataloader import (  # noqa: F401
     NativeERA5Stream,
+    NativeFileDataset,
     native_available,
+    write_dataset,
 )

@@ -76,6 +76,27 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.era5_prefetcher_destroy.argtypes = [ctypes.c_void_p]
+        lib.file_dataset_open.restype = ctypes.c_void_p
+        lib.file_dataset_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.file_dataset_info.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.file_dataset_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.file_dataset_next.restype = ctypes.c_int
+        lib.file_dataset_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.file_dataset_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.file_dataset_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -90,15 +111,76 @@ def _fptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
+class _PrefetchedStream:
+    """Shared ring-resync protocol over a native prefetcher.
+
+    Subclasses provide ``batch_size`` plus the four raw hooks
+    (``_alloc``, ``_ring_next``, ``_ring_seek``, ``_sync_batch``);
+    this class owns the access-pattern policy so it exists in exactly
+    one place:
+
+    * sequential reads ride the C++ prefetch ring;
+    * a one-off jump is served synchronously, ring untouched (a
+      mid-training eval re-read must not discard the training
+      stream's prefetched window);
+    * a jump followed by a sequential read -- the checkpoint-resume
+      pattern -- reseeks the ring there and prefetching resumes.
+
+    Identical bytes on every path: batches are pure functions of
+    (seed, step).
+    """
+
+    def _init_stream(self):
+        self._next_seq = 0
+        self._resync_at: Optional[int] = None
+
+    def next(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Next sequential batch from the prefetch ring."""
+        x, y = self._alloc()
+        step = ctypes.c_int64()
+        rc = self._ring_next(x, y, step)
+        if rc != 0:
+            # Shutdown raced the wait: outputs are uninitialized
+            # memory, never hand them to the caller.
+            raise RuntimeError("native prefetcher shut down mid-read")
+        self._next_seq = step.value + 1
+        return x, y
+
+    def batch_at(self, step: int, batch_size: int):
+        """Random-access batch (Trainer contract)."""
+        if batch_size != self.batch_size:
+            raise ValueError(
+                f"batch {batch_size} != stream batch {self.batch_size}"
+            )
+        if step == self._next_seq:
+            self._resync_at = None
+            return self.next()
+        if step == self._resync_at:
+            # Second sequential read after a jump: this is a new
+            # stream, not random access -- move the ring to it.
+            self._ring_seek(step)
+            self._next_seq = step
+            self._resync_at = None
+            return self.next()
+        self._resync_at = step + 1
+        x, y = self._alloc()
+        self._sync_batch(step, x, y)
+        return x, y
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 @dataclasses.dataclass
-class NativeERA5Stream:
+class NativeERA5Stream(_PrefetchedStream):
     """Host-side ERA5-like stream with native prefetching.
 
     Same dataset contract as models/datasets.py (``batch_at(step,
     batch_size)``; deterministic in (seed, step)) so the Trainer's
-    host-fed path accepts it directly. Sequential consumption rides the
-    C++ prefetch ring; random access falls back to synchronous
-    generation (still deterministic, same bytes).
+    host-fed path accepts it directly.
     """
 
     batch_size: int
@@ -120,8 +202,7 @@ class NativeERA5Stream:
             self.batch_size, self.lat, self.lon, self.channels,
             self.seed, self.prefetch_depth, self.n_threads,
         )
-        self._next_seq = 0
-        self._resync_at: Optional[int] = None
+        self._init_stream()
 
     @property
     def sample_shape(self) -> Tuple[int, int, int]:
@@ -133,59 +214,123 @@ class NativeERA5Stream:
             np.empty(shape, np.float32), np.empty(shape, np.float32)
         )
 
-    def next(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Next sequential batch from the prefetch ring."""
-        x, y = self._alloc()
-        step = ctypes.c_int64()
-        rc = self._lib.era5_prefetcher_next(
+    def _ring_next(self, x, y, step) -> int:
+        return self._lib.era5_prefetcher_next(
             self._handle, _fptr(x), _fptr(y), ctypes.byref(step)
         )
-        if rc != 0:
-            # Shutdown raced the wait: outputs are uninitialized
-            # memory, never hand them to the caller.
-            raise RuntimeError("native prefetcher shut down mid-read")
-        self._next_seq = step.value + 1
-        return x, y
 
-    def batch_at(self, step: int, batch_size: int):
-        """Random-access batch (Trainer contract). Identical bytes on
-        every path (batches are pure functions of (seed, step)).
+    def _ring_seek(self, step: int) -> None:
+        self._lib.era5_prefetcher_seek(self._handle, step)
 
-        A one-off jump generates synchronously and leaves the ring
-        untouched (a mid-training eval re-read must not discard the
-        training stream's prefetched window). When the NEXT read
-        continues sequentially from the jump -- the checkpoint-resume
-        pattern -- the ring is reseeked there and prefetching resumes.
-        """
-        if batch_size != self.batch_size:
-            raise ValueError(
-                f"batch {batch_size} != stream batch {self.batch_size}"
-            )
-        if step == self._next_seq:
-            self._resync_at = None
-            return self.next()
-        if step == self._resync_at:
-            # Second sequential read after a jump: this is a new
-            # stream, not random access -- move the ring to it.
-            self._lib.era5_prefetcher_seek(self._handle, step)
-            self._next_seq = step
-            self._resync_at = None
-            return self.next()
-        self._resync_at = step + 1
-        x, y = self._alloc()
+    def _sync_batch(self, step: int, x, y) -> None:
         self._lib.era5_gen(
             self.batch_size, self.lat, self.lon, self.channels,
             self.seed, step, _fptr(x), _fptr(y),
         )
-        return x, y
 
     def close(self) -> None:
         if getattr(self, "_handle", None):
             self._lib.era5_prefetcher_destroy(self._handle)
             self._handle = None
 
-    def __del__(self):  # pragma: no cover
-        try:
-            self.close()
-        except Exception:
-            pass
+
+_FILE_MAGIC = 0x3144435048555054  # "TPUHPCD1" little-endian
+
+
+def write_dataset(path: str, x: np.ndarray, y: np.ndarray) -> str:
+    """Write (x, y) sample arrays as a tpu_hpc binary dataset.
+
+    x: [N, ...], y: [N, ...], converted to float32. Records are stored
+    contiguously (x then y per sample) so the mmap'd reader gathers a
+    batch with two memcpys per sample. The real-data counterpart of
+    the reference's downloaded-dataset path (resnet_fsdp_training.py:
+    45-87) -- convert once, then train from the file on every host.
+    """
+    x = np.ascontiguousarray(x, np.float32)
+    y = np.ascontiguousarray(y, np.float32)
+    if x.shape[0] != y.shape[0]:
+        raise ValueError(f"sample counts differ: {x.shape[0]} vs {y.shape[0]}")
+    n = x.shape[0]
+    xe = int(np.prod(x.shape[1:], dtype=np.int64))
+    ye = int(np.prod(y.shape[1:], dtype=np.int64))
+    rec = np.empty((n, xe + ye), np.float32)
+    rec[:, :xe] = x.reshape(n, xe)
+    rec[:, xe:] = y.reshape(n, ye)
+    with open(path, "wb") as f:
+        np.asarray([_FILE_MAGIC, n, xe, ye], np.uint64).tofile(f)
+        rec.tofile(f)
+    return path
+
+
+@dataclasses.dataclass
+class NativeFileDataset(_PrefetchedStream):
+    """Train from a tpu_hpc binary file via the mmap'd C++ reader.
+
+    Same Trainer contract and ring semantics as NativeERA5Stream
+    (the shared ``_PrefetchedStream`` protocol). Epoch shuffling is a
+    per-epoch Feistel permutation -- every epoch visits every sample
+    exactly once in a different deterministic order
+    (DistributedSampler.set_epoch semantics with no sampler state).
+    ``x_shape``/``y_shape`` restore the per-sample shapes the flat
+    records lost.
+    """
+
+    path: str
+    batch_size: int
+    x_shape: Tuple[int, ...]
+    y_shape: Tuple[int, ...]
+    seed: int = 0
+    prefetch_depth: int = 4
+    n_threads: int = 2
+
+    def __post_init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                f"native dataloader unavailable: {_build_error}"
+            )
+        self._lib = lib
+        self._handle = lib.file_dataset_open(
+            self.path.encode(), self.batch_size, self.seed,
+            self.prefetch_depth, self.n_threads,
+        )
+        if not self._handle:
+            raise ValueError(f"not a tpu_hpc dataset file: {self.path}")
+        n, xe, ye = ctypes.c_int64(), ctypes.c_int64(), ctypes.c_int64()
+        lib.file_dataset_info(
+            self._handle, ctypes.byref(n), ctypes.byref(xe), ctypes.byref(ye)
+        )
+        self.n_samples = n.value
+        if xe.value != int(np.prod(self.x_shape, dtype=np.int64)):
+            raise ValueError(
+                f"x_shape {self.x_shape} != {xe.value} elems in file"
+            )
+        if ye.value != int(np.prod(self.y_shape, dtype=np.int64)):
+            raise ValueError(
+                f"y_shape {self.y_shape} != {ye.value} elems in file"
+            )
+        self._init_stream()
+
+    def _alloc(self):
+        return (
+            np.empty((self.batch_size, *self.x_shape), np.float32),
+            np.empty((self.batch_size, *self.y_shape), np.float32),
+        )
+
+    def _ring_next(self, x, y, step) -> int:
+        return self._lib.file_dataset_next(
+            self._handle, _fptr(x), _fptr(y), ctypes.byref(step)
+        )
+
+    def _ring_seek(self, step: int) -> None:
+        self._lib.file_dataset_seek(self._handle, step)
+
+    def _sync_batch(self, step: int, x, y) -> None:
+        self._lib.file_dataset_batch(
+            self._handle, step, _fptr(x), _fptr(y)
+        )
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.file_dataset_close(self._handle)
+            self._handle = None

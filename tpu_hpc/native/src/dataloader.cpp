@@ -22,10 +22,18 @@
 #include <cstdint>
 #include <cstring>
 #include <cmath>
+#include <cstdio>
+#include <functional>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
 namespace {
 
@@ -96,10 +104,24 @@ struct Slot {
 
 // Bounded prefetch ring: workers claim the next step atomically,
 // generate into a free slot, publish; next() pops in step order.
+// The batch producer is a std::function so the same ring serves the
+// synthetic generator and the file-backed reader below.
+using BatchFn = std::function<void(int64_t step, float* x, float* y)>;
+
 class Prefetcher {
  public:
   Prefetcher(GenConfig cfg, int depth, int n_threads)
-      : cfg_(cfg), depth_(depth), next_gen_(0), next_out_(0), stop_(false) {
+      : Prefetcher(
+            cfg.elems(), cfg.elems(),
+            [cfg](int64_t step, float* x, float* y) {
+              gen_batch(cfg, step, x, y);
+            },
+            depth, n_threads) {}
+
+  Prefetcher(int64_t x_elems, int64_t y_elems, BatchFn fn, int depth,
+             int n_threads)
+      : x_elems_(x_elems), y_elems_(y_elems), fn_(std::move(fn)),
+        depth_(depth), next_gen_(0), next_out_(0), stop_(false) {
     for (int t = 0; t < n_threads; ++t)
       workers_.emplace_back([this] { Work(); });
   }
@@ -161,9 +183,9 @@ class Prefetcher {
       }
       Slot slot;
       slot.step = step;
-      slot.x.resize(cfg_.elems());
-      slot.y.resize(cfg_.elems());
-      gen_batch(cfg_, step, slot.x.data(), slot.y.data());
+      slot.x.resize(x_elems_);
+      slot.y.resize(y_elems_);
+      fn_(step, slot.x.data(), slot.y.data());
       {
         std::lock_guard<std::mutex> lk(mu_);
         if (epoch == epoch_) ready_[step] = std::move(slot);
@@ -172,7 +194,8 @@ class Prefetcher {
     }
   }
 
-  GenConfig cfg_;
+  int64_t x_elems_, y_elems_;
+  BatchFn fn_;
   int depth_;
   int64_t next_gen_, next_out_;
   uint64_t epoch_ = 0;
@@ -181,6 +204,140 @@ class Prefetcher {
   std::condition_variable cv_free_, cv_ready_;
   std::map<int64_t, Slot> ready_;
   std::vector<std::thread> workers_;
+};
+
+// ---------------------------------------------------------------------------
+// File-backed dataset: mmap'd binary of fp32 (x, y) records with a
+// deterministic per-epoch shuffle. This is the real-data path the
+// reference gets from DataLoader(num_workers=4) over a downloaded
+// dataset (resnet_fsdp_training.py:45-87) -- here the OS page cache
+// plays pin_memory and the Prefetcher plays the worker pool.
+//
+// Format (tpu_hpc/native/dataloader.py:write_dataset):
+//   int64 magic  'TPUHPCD1'
+//   int64 n_samples, int64 x_elems, int64 y_elems    (per sample, fp32)
+//   n_samples x (x_elems + y_elems) float32 records, x then y.
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t kFileMagic = 0x3144435048555054ULL;  // "TPUHPCD1" LE
+
+// Deterministic epoch shuffle without materialising a permutation:
+// a 4-round Feistel network over [0, 2^(2w)) with cycle-walking back
+// into [0, n). Bijective for every (seed, epoch), so each epoch visits
+// every sample exactly once -- DistributedSampler.set_epoch semantics,
+// index-stateless.
+struct EpochShuffle {
+  uint64_t keys[4];
+  uint64_t n;
+  int half_bits;
+  uint64_t half_mask;
+
+  EpochShuffle(uint64_t seed, uint64_t epoch, uint64_t n_) : n(n_) {
+    uint64_t k = splitmix64(seed ^ splitmix64(epoch + 0x5eedULL));
+    for (auto& key : keys) key = k = splitmix64(k);
+    half_bits = 1;
+    while ((1ULL << (2 * half_bits)) < n) ++half_bits;
+    half_mask = (1ULL << half_bits) - 1;
+  }
+
+  uint64_t permute_once(uint64_t x) const {
+    uint64_t l = x >> half_bits, r = x & half_mask;
+    for (const auto& key : keys) {
+      uint64_t f = splitmix64(r ^ key) & half_mask;
+      uint64_t nl = r;
+      r = l ^ f;
+      l = nl;
+    }
+    return (l << half_bits) | r;
+  }
+
+  uint64_t operator()(uint64_t i) const {
+    uint64_t x = permute_once(i);
+    while (x >= n) x = permute_once(x);  // cycle-walk into range
+    return x;
+  }
+};
+
+class FileDataset {
+ public:
+  FileDataset(const char* path, int64_t batch, uint64_t seed, int depth,
+              int n_threads)
+      : batch_(batch), seed_(seed) {
+    fd_ = open(path, O_RDONLY);
+    if (fd_ < 0) return;
+    struct stat st;
+    if (fstat(fd_, &st) != 0) return;
+    size_ = static_cast<size_t>(st.st_size);
+    base_ = static_cast<const uint8_t*>(
+        mmap(nullptr, size_, PROT_READ, MAP_PRIVATE, fd_, 0));
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      return;
+    }
+    const uint64_t* hdr = reinterpret_cast<const uint64_t*>(base_);
+    if (size_ < 4 * sizeof(uint64_t) || hdr[0] != kFileMagic) return;
+    n_samples_ = static_cast<int64_t>(hdr[1]);
+    x_elems_ = static_cast<int64_t>(hdr[2]);
+    y_elems_ = static_cast<int64_t>(hdr[3]);
+    const size_t need = 4 * sizeof(uint64_t) +
+        static_cast<size_t>(n_samples_) * (x_elems_ + y_elems_) * 4;
+    if (size_ < need || n_samples_ <= 0) return;
+    records_ = reinterpret_cast<const float*>(base_ + 4 * sizeof(uint64_t));
+    ok_ = true;
+    prefetcher_.reset(new Prefetcher(
+        batch * x_elems_, batch * y_elems_,
+        [this](int64_t step, float* x, float* y) { Fill(step, x, y); },
+        depth, n_threads));
+  }
+
+  ~FileDataset() {
+    prefetcher_.reset();  // joins workers before the map goes away
+    if (base_ != nullptr && base_ != MAP_FAILED) munmap(
+        const_cast<uint8_t*>(base_), size_);
+    if (fd_ >= 0) close(fd_);
+  }
+
+  bool ok() const { return ok_; }
+  int64_t n_samples() const { return n_samples_; }
+  int64_t x_elems() const { return x_elems_; }
+  int64_t y_elems() const { return y_elems_; }
+
+  // Batch `step` = samples [step*batch, (step+1)*batch) of the
+  // epoch-shuffled stream; epoch = position / n_samples. Wraps
+  // forever, reshuffling each epoch.
+  void Fill(int64_t step, float* x, float* y) {
+    const int64_t rec = x_elems_ + y_elems_;
+    // One key schedule per epoch, not per sample (a batch crosses an
+    // epoch boundary at most every n_samples_/batch_ steps).
+    uint64_t cur_epoch =
+        static_cast<uint64_t>(step) * batch_ / n_samples_;
+    EpochShuffle shuffle(seed_, cur_epoch, n_samples_);
+    for (int64_t b = 0; b < batch_; ++b) {
+      const uint64_t pos = static_cast<uint64_t>(step) * batch_ + b;
+      const uint64_t epoch = pos / n_samples_;
+      if (epoch != cur_epoch) {
+        cur_epoch = epoch;
+        shuffle = EpochShuffle(seed_, cur_epoch, n_samples_);
+      }
+      const uint64_t idx = shuffle(pos % n_samples_);
+      const float* r = records_ + idx * rec;
+      std::memcpy(x + b * x_elems_, r, x_elems_ * 4);
+      std::memcpy(y + b * y_elems_, r + x_elems_, y_elems_ * 4);
+    }
+  }
+
+  Prefetcher* prefetcher() { return prefetcher_.get(); }
+
+ private:
+  int64_t batch_;
+  uint64_t seed_;
+  int fd_ = -1;
+  size_t size_ = 0;
+  const uint8_t* base_ = nullptr;
+  const float* records_ = nullptr;
+  int64_t n_samples_ = 0, x_elems_ = 0, y_elems_ = 0;
+  bool ok_ = false;
+  std::unique_ptr<Prefetcher> prefetcher_;
 };
 
 }  // namespace
@@ -211,5 +368,40 @@ void era5_prefetcher_seek(void* p, int64_t step) {
 }
 
 void era5_prefetcher_destroy(void* p) { delete static_cast<Prefetcher*>(p); }
+
+// -- file-backed dataset --
+
+void* file_dataset_open(const char* path, int64_t batch, uint64_t seed,
+                        int depth, int n_threads) {
+  auto* ds = new FileDataset(path, batch, seed, depth, n_threads);
+  if (!ds->ok()) {
+    delete ds;
+    return nullptr;
+  }
+  return ds;
+}
+
+void file_dataset_info(void* p, int64_t* n_samples, int64_t* x_elems,
+                       int64_t* y_elems) {
+  auto* ds = static_cast<FileDataset*>(p);
+  *n_samples = ds->n_samples();
+  *x_elems = ds->x_elems();
+  *y_elems = ds->y_elems();
+}
+
+// Synchronous random access (bypasses the ring, deterministic).
+void file_dataset_batch(void* p, int64_t step, float* x, float* y) {
+  static_cast<FileDataset*>(p)->Fill(step, x, y);
+}
+
+int file_dataset_next(void* p, float* x, float* y, int64_t* step_out) {
+  return static_cast<FileDataset*>(p)->prefetcher()->Next(x, y, step_out);
+}
+
+void file_dataset_seek(void* p, int64_t step) {
+  static_cast<FileDataset*>(p)->prefetcher()->Seek(step);
+}
+
+void file_dataset_close(void* p) { delete static_cast<FileDataset*>(p); }
 
 }  // extern "C"

@@ -392,6 +392,24 @@ class Trainer:
         cfg = self.cfg
         epochs = epochs or cfg.epochs
         start_step = self.maybe_resume()
+        # Preemption safety: TPU-VM spot/maintenance events deliver
+        # SIGTERM with a short grace window. Snapshot-then-exit is the
+        # recovery model (the reference's PBS-resubmission + snapshot
+        # pattern, SURVEY 5.3): the relaunched job auto-resumes from
+        # the saved step. Installed only around fit() and only when a
+        # checkpoint manager exists; chunk boundaries check the flag.
+        preempted = {"flag": False}
+        old_handler = None
+        if self.checkpoint_manager is not None:
+            import signal
+
+            def _on_sigterm(signum, frame):
+                preempted["flag"] = True
+
+            try:
+                old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            except ValueError:  # non-main thread: skip, keep training
+                old_handler = None
         steps_per_epoch = cfg.steps_per_epoch
         total_steps = epochs * steps_per_epoch
         run_summaries = []
@@ -410,6 +428,35 @@ class Trainer:
                 cfg.profile_num_steps,
             )
         done = start_step
+        try:
+            last_metrics = self._fit_loop(
+                dataset, done, total_steps, steps_per_epoch, scanned,
+                prof, preempted, run_summaries,
+            )
+        finally:
+            # Always restore the SIGTERM disposition -- a dataset/OOM
+            # exception mid-loop must not leave the no-op flag handler
+            # installed for the life of the process (a later real
+            # SIGTERM would then neither snapshot nor exit).
+            if old_handler is not None:
+                import signal
+
+                signal.signal(signal.SIGTERM, old_handler)
+            if prof is not None:
+                prof.stop()
+        return {
+            "epochs": run_summaries,
+            "final_loss": float(jax.device_get(last_metrics["loss"]))
+            if last_metrics
+            else None,
+        }
+
+    def _fit_loop(
+        self, dataset, done, total_steps, steps_per_epoch, scanned,
+        prof, preempted, run_summaries,
+    ):
+        cfg = self.cfg
+        last_metrics: Dict = {}
         while done < total_steps:
             epoch = done // steps_per_epoch
             chunk = min(steps_per_epoch - done % steps_per_epoch,
@@ -470,11 +517,14 @@ class Trainer:
                 and done % (cfg.save_every * steps_per_epoch) == 0
             ):
                 self.checkpoint_manager.save(self.state)
-        if prof is not None:
-            prof.stop()
-        return {
-            "epochs": run_summaries,
-            "final_loss": float(jax.device_get(last_metrics["loss"]))
-            if last_metrics
-            else None,
-        }
+            if preempted["flag"]:
+                self.logger.warning(
+                    "SIGTERM received: snapshotting at step %d and "
+                    "stopping (relaunch auto-resumes with --resume)",
+                    done,
+                )
+                if done not in (self.checkpoint_manager.all_steps() or []):
+                    self.checkpoint_manager.save(self.state, force=True)
+                self.checkpoint_manager.wait()
+                break
+        return last_metrics
