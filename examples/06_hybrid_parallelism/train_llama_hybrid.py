@@ -28,7 +28,7 @@ from tpu_hpc.config import TrainingConfig
 from tpu_hpc.logging_ import get_logger
 from tpu_hpc.models import datasets, llama2
 from tpu_hpc.parallel import hybrid, tp
-from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+from tpu_hpc.runtime import build_mesh, init_distributed
 from tpu_hpc.train import Trainer
 
 
@@ -48,9 +48,18 @@ def main(argv=None) -> int:
         cfg.model_parallel = tp.auto_tp_degree(
             jax.device_count(), model_cfg.n_heads, model_cfg.kv_heads, cap=4
         )
-    mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
+    # mesh_spec() includes the multi-slice extent: --dcn-data-parallel N
+    # spans the data/FSDP axis across N slices over DCN while TP stays
+    # inside each slice (the reference's TP-on-NVLink / FSDP-on-
+    # Slingshot split, fsdp_tp/fsdp_tp_example.py:12-26).
+    mesh = build_mesh(cfg.mesh_spec())
     dp_size = mesh.shape["data"]
-    logger.info("mesh: %s (TP inner/ICI-minor, FSDP outer)", dict(mesh.shape))
+    logger.info(
+        "mesh: %s (TP inner/ICI-minor, FSDP outer%s)",
+        dict(mesh.shape),
+        f", data across {cfg.dcn_data_parallel} slices via DCN"
+        if cfg.dcn_data_parallel > 1 else "",
+    )
 
     tp.validate_tp_degree(
         model_cfg.n_heads, model_cfg.kv_heads, cfg.model_parallel

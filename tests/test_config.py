@@ -52,3 +52,22 @@ def test_mesh_axes():
     assert c.mesh_axes() == {"data": 2, "model": 4}
     c2 = TrainingConfig(pipe_parallel=4, data_parallel=2)
     assert list(c2.mesh_axes()) == ["pipe", "data"]
+
+
+def test_mesh_spec_dcn():
+    c = TrainingConfig(
+        data_parallel=2, model_parallel=2, dcn_data_parallel=2
+    )
+    spec = c.mesh_spec()
+    assert spec.dcn_axes == {"data": 2}
+    assert spec.resolved_sizes(8) == {"data": 4, "model": 2}
+    # Default: single slice, no dcn axes.
+    assert TrainingConfig().mesh_spec().dcn_axes == {}
+    # CLI plumbing.
+    c2 = TrainingConfig.from_args(["--dcn-data-parallel", "2"])
+    assert c2.dcn_data_parallel == 2
+
+
+def test_mesh_spec_rejects_bad_dcn():
+    with pytest.raises(ValueError, match="dcn_data_parallel"):
+        TrainingConfig(dcn_data_parallel=0).mesh_spec()

@@ -96,7 +96,7 @@ def chip_microbench(
     dim: int = 4096, iters: int = 10
 ) -> Dict[str, float]:
     """Per-chip burn-in numbers: dense bf16 matmul TFLOP/s and HBM
-    copy GB/s, measured on device 0.
+    copy GB/s, measured on this host's first local chip.
 
     The role of the reference's per-GPU props dump + single-device
     NCCL smoke (test_env.py:54-79), upgraded to *measured* rates: a
@@ -107,7 +107,10 @@ def chip_microbench(
 
     import jax.numpy as jnp
 
-    d = jax.devices()[0]
+    # local_devices, not devices: on a multi-host pod global device 0
+    # is addressable only from host 0, and device_put to a
+    # non-addressable device raises on every other host.
+    d = jax.local_devices()[0]
     key = jax.random.key(0)
     a = jax.device_put(
         jax.random.normal(key, (dim, dim), jnp.bfloat16), d

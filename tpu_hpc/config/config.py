@@ -42,6 +42,12 @@ class TrainingConfig:
     model_parallel: int = 1
     seq_parallel: int = 1
     pipe_parallel: int = 1
+    # Multi-slice: how many TPU slices the data axis spans over DCN
+    # (1 = single slice). The reference's FSDP-across-nodes-on-Slingshot
+    # doctrine (fsdp_tp/fsdp_tp_example.py:12-26); data_parallel then
+    # gives the PER-SLICE extent (or -1 for all remaining per-slice
+    # chips).
+    dcn_data_parallel: int = 1
 
     # Checkpointing (reference: utils/config.py:45-47).
     save_every: int = 0  # epochs; 0 = off
@@ -123,3 +129,21 @@ class TrainingConfig:
         if self.model_parallel > 1:
             axes["model"] = self.model_parallel
         return axes
+
+    def mesh_spec(self) -> Any:
+        """Full ``MeshSpec`` including the multi-slice (DCN) extent of
+        the data axis. Use ``build_mesh(cfg.mesh_spec())`` in recipes
+        that may run across slices."""
+        from tpu_hpc.runtime.mesh import MeshSpec
+
+        if self.dcn_data_parallel < 1:
+            raise ValueError(
+                f"dcn_data_parallel must be >= 1, got "
+                f"{self.dcn_data_parallel}"
+            )
+        dcn = (
+            {"data": self.dcn_data_parallel}
+            if self.dcn_data_parallel > 1
+            else {}
+        )
+        return MeshSpec(axes=self.mesh_axes(), dcn_axes=dcn)

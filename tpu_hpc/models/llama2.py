@@ -63,10 +63,19 @@ def _make_embed_lookup(vocab: int, table_dtype: str):
         # dtable[v] = sum over positions with token v of g --
         # expressed as one MXU matmul (one-hot rows are exact
         # selectors) instead of the gather-transpose scatter-add.
+        # The (B, S) dims are contracted in place rather than
+        # flattened first: under Megatron-SP the cotangent arrives
+        # sharded (data, model, None), and a flattening reshape merges
+        # two differently-sharded dims -- SPMD can only resolve that by
+        # replicating the whole tensor (involuntary full
+        # rematerialization). Contracting dims never merge, so each
+        # device keeps its (batch, seq) tile and the partial dtables
+        # meet in one psum.
         onehot = jax.nn.one_hot(tokens, vocab, dtype=g.dtype)
+        batch_dims = tuple(range(g.ndim - 1))
         dtable = jax.lax.dot_general(
-            onehot.reshape(-1, vocab), g.reshape(-1, g.shape[-1]),
-            (((0,), (0,)), ((), ())),
+            onehot, g,
+            ((batch_dims, batch_dims), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return dtable.astype(table_dtype), None

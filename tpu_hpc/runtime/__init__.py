@@ -8,8 +8,10 @@ from tpu_hpc.runtime.distributed import (  # noqa: F401
 )
 from tpu_hpc.runtime.mesh import (  # noqa: F401
     MeshSpec,
+    build_hybrid_mesh,
     build_mesh,
     local_batch_size,
     named_sharding,
+    slice_groups,
 )
 from tpu_hpc.runtime.topology import device_summary, topology_report  # noqa: F401

@@ -400,6 +400,7 @@ class Trainer:
         # checkpoint manager exists; chunk boundaries check the flag.
         preempted = {"flag": False}
         old_handler = None
+        handler_installed = False
         if self.checkpoint_manager is not None:
             import signal
 
@@ -408,8 +409,9 @@ class Trainer:
 
             try:
                 old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+                handler_installed = True
             except ValueError:  # non-main thread: skip, keep training
-                old_handler = None
+                pass
         steps_per_epoch = cfg.steps_per_epoch
         total_steps = epochs * steps_per_epoch
         run_summaries = []
@@ -437,11 +439,18 @@ class Trainer:
             # Always restore the SIGTERM disposition -- a dataset/OOM
             # exception mid-loop must not leave the no-op flag handler
             # installed for the life of the process (a later real
-            # SIGTERM would then neither snapshot nor exit).
-            if old_handler is not None:
+            # SIGTERM would then neither snapshot nor exit). Tracked by
+            # a flag, not old_handler's truthiness: signal.signal
+            # returns None when the previous handler was installed
+            # from C, and SIG_DFL is the honest restoration then.
+            if handler_installed:
                 import signal
 
-                signal.signal(signal.SIGTERM, old_handler)
+                signal.signal(
+                    signal.SIGTERM,
+                    old_handler if old_handler is not None
+                    else signal.SIG_DFL,
+                )
             if prof is not None:
                 prof.stop()
         return {
