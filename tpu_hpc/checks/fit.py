@@ -95,6 +95,7 @@ class FitResult:
     grad_bytes: int           # per chip, fp32, live during the step
     opt_bytes: int            # per chip, AdamW mu+nu fp32
     act_bytes: Dict[str, int]  # per chip, analytic model
+    grad_accum: int = 1
     compiled: bool = False
     compile_seconds: float = 0.0
     collectives: Dict[str, int] = dataclasses.field(default_factory=dict)
@@ -133,18 +134,24 @@ class FitResult:
 
 def activation_model(
     cfg: llama2.LlamaConfig, dp: int, tp_size: int,
-    global_batch: int, seq_len: int,
+    global_batch: int, seq_len: int, grad_accum: int = 1,
 ) -> Dict[str, int]:
     """Per-chip activation bytes under the bench configuration:
     remat-per-block (only block inputs saved), Megatron-SP (residual
     stream sequence-sharded over the model axis between blocks), flash
     attention (O(S) saved state, no S x S scores), bf16 compute.
 
+    ``grad_accum > 1``: each microbatch's activations live only for its
+    own forward/backward inside the accumulation scan, so every term
+    scales by 1/grad_accum (the gradient-sum carry is accounted
+    separately in analyze()).
+
     An analytic model, not a measurement: XLA's actual peak adds fusion
     temporaries, but the dominant terms (checkpointed residuals, one
     block's recompute live-set, the logits/CE head) are all here.
     """
-    bl = global_batch // dp          # per-chip batch (DP shards batch)
+    # Per-chip, per-microbatch rows (DP shards the batch dim).
+    bl = global_batch // dp // grad_accum
     s_sp = seq_len // tp_size        # SP-sharded sequence slice
     d, hd = cfg.dim, cfg.head_dim
     h_loc = cfg.n_heads // tp_size   # TP shards heads
@@ -186,15 +193,25 @@ def analyze(
     seq_len: int = 4096,
     hbm_gib: float = 32.0,
     do_compile: bool = True,
+    grad_accum: int = 1,
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
 
     Defaults = the north star: 7B LlamaConfig defaults on a v4-32-shaped
-    (data=4, model=8) mesh, 32 GiB HBM per chip.
+    (data=4, model=8) mesh, 32 GiB HBM per chip. ``grad_accum`` analyzes
+    (and compiles) the accumulated step -- the configuration large
+    global batches actually run.
     """
     if cfg is None:
         cfg = llama2.LlamaConfig(max_seq_len=seq_len, remat=True)
     tp.validate_tp_degree(cfg.n_heads, cfg.kv_heads, tp_size)
+    if grad_accum < 1 or global_batch % grad_accum or (
+        (global_batch // grad_accum) % dp
+    ):
+        raise ValueError(
+            f"grad_accum {grad_accum} must divide global_batch "
+            f"{global_batch} into microbatches divisible by dp {dp}"
+        )
 
     abstract_params = jax.eval_shape(
         lambda: llama2.init_llama(jax.random.key(0), cfg)
@@ -210,13 +227,22 @@ def analyze(
     opt_abstract = jax.eval_shape(optimizer.init, abstract_params)
     opt_specs = derived_pspecs(opt_abstract, abstract_params, specs)
 
+    act = activation_model(
+        cfg, dp, tp_size, global_batch, seq_len, grad_accum
+    )
+    grad_bytes = tree_bytes_per_chip(abstract_params, specs, mesh_axes)
+    if grad_accum > 1:
+        # The fp32 gradient-sum carry coexists with each microbatch's
+        # freshly computed gradient inside the accumulation scan.
+        act["grad_accum_sum_carry"] = grad_bytes
     result = FitResult(
         cfg=cfg, dp=dp, tp_size=tp_size, global_batch=global_batch,
         seq_len=seq_len, hbm_gib=hbm_gib, n_params=n_params,
         param_bytes=tree_bytes_per_chip(abstract_params, specs, mesh_axes),
-        grad_bytes=tree_bytes_per_chip(abstract_params, specs, mesh_axes),
+        grad_bytes=grad_bytes,
         opt_bytes=tree_bytes_per_chip(opt_abstract, opt_specs, mesh_axes),
-        act_bytes=activation_model(cfg, dp, tp_size, global_batch, seq_len),
+        act_bytes=act,
+        grad_accum=grad_accum,
     )
     if not do_compile:
         return result
@@ -239,7 +265,22 @@ def analyze(
     )
     constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
     forward = llama2.make_forward(cfg, constrain)
-    step = make_step_fn(forward, optimizer, seed=0)
+    micro_constrain = None
+    if grad_accum > 1:
+        micro_sharding = NamedSharding(mesh, P(None, "data", None))
+
+        def micro_constrain(tree):
+            return jax.tree.map(
+                lambda a: jax.lax.with_sharding_constraint(
+                    a, micro_sharding
+                ),
+                tree,
+            )
+
+    step = make_step_fn(
+        forward, optimizer, seed=0,
+        grad_accum=grad_accum, microbatch_constrain=micro_constrain,
+    )
 
     state_abstract = TrainState(
         step=jax.ShapeDtypeStruct((), jnp.int32),
@@ -303,8 +344,13 @@ def to_markdown(r: FitResult) -> str:
         f"- mesh: (data={r.dp}, model={r.tp_size}) = {r.dp*r.tp_size} "
         "chips (FSDP over `data`, Megatron TP+SP over `model`)",
         f"- batch: global {r.global_batch} sequences x {r.seq_len} "
-        f"tokens (per-chip batch {r.global_batch//r.dp}); "
-        f"remat={cfg.remat}, bf16 compute / fp32 params",
+        f"tokens (per-chip batch {r.global_batch//r.dp}"
+        + (
+            f", {r.grad_accum}-way gradient accumulation -> per-chip "
+            f"microbatch {r.global_batch//r.dp//r.grad_accum}"
+            if r.grad_accum > 1 else ""
+        )
+        + f"); remat={cfg.remat}, bf16 compute / fp32 params",
         "",
         "## Per-chip HBM budget",
         "",
@@ -380,6 +426,8 @@ def main(argv=None) -> int:
     parser.add_argument("--hbm-gib", type=float, default=32.0)
     parser.add_argument("--layers", type=int, default=None,
                         help="override n_layers (default: 7B's 32)")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="analyze the N-way accumulated step")
     parser.add_argument("--no-compile", action="store_true")
     parser.add_argument("--markdown", type=str, default=None,
                         help="write the report to this path")
@@ -411,6 +459,7 @@ def main(argv=None) -> int:
         cfg=cfg, dp=args.dp, tp_size=args.tp,
         global_batch=args.global_batch, seq_len=args.seq_len,
         hbm_gib=args.hbm_gib, do_compile=not args.no_compile,
+        grad_accum=args.grad_accum,
     )
     md = to_markdown(r)
     if args.markdown:

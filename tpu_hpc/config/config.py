@@ -32,6 +32,12 @@ class TrainingConfig:
     momentum: float = 0.9
     seed: int = 42
     steps_per_epoch: int = 50
+    # Gradient accumulation: microbatches per optimizer update. The
+    # global batch is split into this many sequential forward/backward
+    # passes inside the jitted step -- same optimizer trajectory at
+    # 1/N the activation memory (how large global batches fit HBM at
+    # 7B scale). 1 = off.
+    grad_accum_steps: int = 1
 
     # Precision (reference AMP block: utils/config.py:40-44).
     param_dtype: str = "float32"

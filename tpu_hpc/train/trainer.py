@@ -47,6 +47,18 @@ ForwardFn = Callable[[Any, Any, Any, jax.Array], Tuple[jax.Array, Any, Dict]]
 EvalForwardFn = Callable[[Any, Any, Any], Tuple[jax.Array, Dict]]
 
 
+def _leading_spec_extent(mesh: Mesh, spec: P) -> int:
+    """Product of mesh-axis sizes sharding a spec's leading dim."""
+    if len(spec) == 0 or spec[0] is None:
+        return 1
+    entry = spec[0]
+    names = entry if isinstance(entry, tuple) else (entry,)
+    out = 1
+    for n in names:
+        out *= mesh.shape[n]
+    return out
+
+
 def make_optimizer(cfg: TrainingConfig) -> optax.GradientTransformation:
     """SGD+momentum or AdamW from config (reference optimizers:
     SGD in the DDP/FSDP examples, AdamW with foreach=False in TP --
@@ -60,22 +72,75 @@ def make_step_fn(
     forward: ForwardFn,
     optimizer: optax.GradientTransformation,
     seed: int,
+    grad_accum: int = 1,
+    microbatch_constrain: Optional[Callable[[Any], Any]] = None,
 ) -> Callable[[Any, Any], Tuple[Any, Dict]]:
     """The training-step body as a free function: forward, backward,
     optimizer update. The Trainer jits this; checks/fit.py AOT-lowers
     the very same function against abstract 7B-scale inputs, so the fit
-    analysis certifies the real step, not a lookalike."""
+    analysis certifies the real step, not a lookalike.
+
+    ``grad_accum > 1`` splits the batch into that many microbatches and
+    lax.scans the forward/backward, summing gradients and applying ONE
+    optimizer update -- same optimizer trajectory as the full batch
+    (gradient of the mean = mean of per-microbatch gradients), at
+    1/grad_accum of the activation memory. ``state.step`` counts
+    optimizer updates, so checkpoints, the data stream, and LR
+    schedules are accumulation-agnostic. ``microbatch_constrain``
+    re-pins each [A, B/A, ...] microbatched tree to the batch sharding
+    (leading dim replicated); without it the reshape leaves microbatch
+    rows spread over only a fraction of the data axis.
+    """
 
     def step(state: "TrainState", batch) -> Tuple["TrainState", Dict]:
         step_rng = jax.random.fold_in(jax.random.key(seed), state.step)
 
-        def loss_fn(p):
-            loss, new_ms, aux = forward(p, state.model_state, batch, step_rng)
-            return loss, (new_ms, aux)
+        if grad_accum == 1:
+            def loss_fn(p):
+                loss, new_ms, aux = forward(
+                    p, state.model_state, batch, step_rng
+                )
+                return loss, (new_ms, aux)
 
-        (loss, (new_ms, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+            (loss, (new_ms, aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+        else:
+            micro = jax.tree.map(
+                lambda a: a.reshape(
+                    grad_accum, a.shape[0] // grad_accum, *a.shape[1:]
+                ),
+                batch,
+            )
+            if microbatch_constrain is not None:
+                micro = microbatch_constrain(micro)
+            params = state.params
+
+            def body(carry, xs):
+                ms, gsum, lsum = carry
+                i, mb = xs
+                rng = jax.random.fold_in(step_rng, i)
+
+                def loss_fn(p):
+                    loss, new_ms, aux = forward(p, ms, mb, rng)
+                    return loss, (new_ms, aux)
+
+                (loss, (new_ms, aux)), g = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (new_ms, gsum, lsum + loss), aux
+
+            gzero = jax.tree.map(jnp.zeros_like, state.params)
+            (new_ms, gsum, lsum), aux_stack = jax.lax.scan(
+                body,
+                (state.model_state, gzero, jnp.zeros((), jnp.float32)),
+                (jnp.arange(grad_accum), micro),
+            )
+            loss = lsum / grad_accum
+            grads = jax.tree.map(lambda g: g / grad_accum, gsum)
+            aux = jax.tree.map(lambda a: jnp.mean(a, axis=0), aux_stack)
+
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, **aux}
@@ -196,7 +261,63 @@ class Trainer:
                 )
                 return loss, aux
         self.eval_forward = eval_forward
-        self._step_impl = make_step_fn(forward, self.optimizer, cfg.seed)
+        grad_accum = cfg.grad_accum_steps
+        if grad_accum < 1:
+            raise ValueError(
+                f"grad_accum_steps must be >= 1, got {grad_accum}"
+            )
+        micro_constrain = None
+        if grad_accum > 1:
+            if cfg.global_batch_size % grad_accum:
+                raise ValueError(
+                    f"global_batch_size {cfg.global_batch_size} not "
+                    f"divisible by grad_accum_steps {grad_accum}"
+                )
+            # Each microbatch must still cover the whole data axis --
+            # an undersized microbatch shards unevenly (GSPMD pads
+            # silently) and idles chips every pass, the half-throughput
+            # misconfiguration local_batch_size exists to reject.
+            micro_bs = cfg.global_batch_size // grad_accum
+            data_extent = max(
+                (
+                    _leading_spec_extent(mesh, s)
+                    for s in jax.tree.leaves(
+                        batch_pspec,
+                        is_leaf=lambda x: isinstance(x, P),
+                    )
+                ),
+                default=1,
+            )
+            if micro_bs % data_extent:
+                raise ValueError(
+                    f"microbatch {micro_bs} (global "
+                    f"{cfg.global_batch_size} / grad_accum "
+                    f"{grad_accum}) not divisible by the batch-sharding "
+                    f"extent {data_extent}"
+                )
+            # Re-pin each microbatched leaf [A, B/A, ...] to the batch
+            # sharding with the accumulation dim replicated: the
+            # [B] -> [A, B/A] reshape otherwise leaves each microbatch
+            # row on a 1/A fraction of the data axis.
+            micro_sharding = jax.tree.map(
+                lambda s: NamedSharding(mesh, P(None, *s.spec)),
+                self.batch_sharding,
+                is_leaf=lambda x: isinstance(x, NamedSharding),
+            )
+
+            def micro_constrain(tree):
+                return jax.tree.map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, micro_sharding
+                    ),
+                    tree,
+                )
+
+        self._step_impl = make_step_fn(
+            forward, self.optimizer, cfg.seed,
+            grad_accum=grad_accum,
+            microbatch_constrain=micro_constrain,
+        )
         # Pin the output state to the planned layout. Without this the
         # compiler may propagate a *different* layout through the update
         # -- concretely, under SHARD_GRAD_OP the new params inherit the
