@@ -23,6 +23,7 @@ run examples/01_data_parallel_dp/input_pipeline.py       ${FAST} --global-batch-
 run examples/02_fully_sharded_fsdp/train_unet_fsdp.py   ${FAST}
 run examples/02_fully_sharded_fsdp/train_resnet_fsdp.py ${FAST} --global-batch-size 16 --strategy grad-op
 run examples/02_fully_sharded_fsdp/train_resnet_fsdp.py ${FAST} --global-batch-size 16 --strategy hybrid
+run examples/03_tensor_parallel_tp/mesh_basics.py
 run examples/03_tensor_parallel_tp/train_llama_tp.py    ${FAST}
 run examples/03_tensor_parallel_tp/train_vit_tp.py      ${FAST} --global-batch-size 4
 run examples/04_pipeline_parallel_pp/train_pipeline.py  ${FAST} --global-batch-size 16 --schedule gpipe

@@ -81,3 +81,43 @@ def test_hybrid_step_compiles_on_mesh(mesh_2d):
     # analytic static accounting (params + opt state; batch is noise).
     analytic = r.param_bytes + r.opt_bytes
     assert abs(r.xla_argument_bytes - analytic) / analytic < 0.05
+
+
+def test_model_presets():
+    """Llama-2 family shapes land on the public parameter counts."""
+    import numpy as np
+
+    def count(name):
+        cfg = llama2.PRESETS[name]
+        abstract = jax.eval_shape(
+            lambda: llama2.init_llama(jax.random.key(0), cfg)
+        )
+        return sum(
+            int(np.prod(l.shape)) for l in jax.tree.leaves(abstract)
+        )
+
+    assert abs(count("7b") / 6.74e9 - 1) < 0.01
+    assert abs(count("13b") / 13.0e9 - 1) < 0.01
+    # 70B: GQA shape (8 KV heads), ffn_hidden 28672.
+    assert llama2.PRESETS["70b"].ffn_hidden == 28672
+    assert abs(count("70b") / 69.0e9 - 1) < 0.01
+
+
+def test_sizing_table_rows_fit():
+    """Every published ladder row must actually fit -- the docs table
+    is generated from this exact computation."""
+    table = fit.sizing_table()
+    assert "NO" not in table
+    assert table.count("| yes |") == len(fit._TABLE_ROWS)
+
+
+def test_sizing_table_catches_overflow():
+    """The analyzer is not a rubber stamp: 70B on 8 chips must not fit."""
+    import dataclasses as dc
+
+    cfg = dc.replace(llama2.PRESETS["70b"], max_seq_len=4096)
+    r = fit.analyze(
+        cfg=cfg, dp=2, tp_size=4, global_batch=16, seq_len=4096,
+        do_compile=False,
+    )
+    assert not r.fits

@@ -267,15 +267,11 @@ def analyze(
     forward = llama2.make_forward(cfg, constrain)
     micro_constrain = None
     if grad_accum > 1:
-        micro_sharding = NamedSharding(mesh, P(None, "data", None))
+        from tpu_hpc.train.trainer import make_microbatch_constrain
 
-        def micro_constrain(tree):
-            return jax.tree.map(
-                lambda a: jax.lax.with_sharding_constraint(
-                    a, micro_sharding
-                ),
-                tree,
-            )
+        micro_constrain = make_microbatch_constrain(
+            mesh, NamedSharding(mesh, P("data", None))
+        )
 
     step = make_step_fn(
         forward, optimizer, seed=0,
@@ -326,20 +322,24 @@ def analyze(
 def to_markdown(r: FitResult) -> str:
     cfg = r.cfg
     act_total = sum(r.act_bytes.values())
+    chips = r.dp * r.tp_size
+    size_b = f"{r.n_params/1e9:.0f}B"
     lines = [
-        "# 7B shard/fit analysis -- Llama-2 hybrid FSDPxTP(+SP) on a "
-        "v4-32-shaped mesh",
+        f"# {size_b} shard/fit analysis -- Llama-2 hybrid FSDPxTP(+SP) "
+        f"on a {chips}-chip (data={r.dp} x model={r.tp_size}) mesh",
         "",
-        "Produced by `python -m tpu_hpc.checks.fit`. The north-star "
-        "workload (BASELINE.md): the reference's hybrid example "
-        "(/root/reference/fsdp_tp/fsdp_tp_example.py:120-187) at the "
-        "full 7B ModelArgs defaults (llama2_model.py:13-16), mapped to "
-        "a TPU v4-32 pod.",
+        "Produced by `python -m tpu_hpc.checks.fit`. Capability anchor "
+        "(BASELINE.md): the reference's hybrid example "
+        "(/root/reference/fsdp_tp/fsdp_tp_example.py:120-187) run at "
+        "full scale (its ModelArgs ladder, llama2_model.py:13-16 and "
+        "docs/guide/11_choosing_a_strategy.md:109-127), mapped to a "
+        "TPU v4 pod.",
         "",
         "## Configuration",
         "",
         f"- model: dim={cfg.dim}, layers={cfg.n_layers}, "
-        f"heads={cfg.n_heads}, ffn_hidden={cfg.ffn_hidden}, "
+        f"heads={cfg.n_heads} (kv {cfg.kv_heads}), "
+        f"ffn_hidden={cfg.ffn_hidden}, "
         f"vocab={cfg.vocab_size} -> **{r.n_params/1e9:.2f}B params**",
         f"- mesh: (data={r.dp}, model={r.tp_size}) = {r.dp*r.tp_size} "
         "chips (FSDP over `data`, Megatron TP+SP over `model`)",
@@ -413,6 +413,55 @@ def to_markdown(r: FitResult) -> str:
     return "\n".join(lines) + "\n"
 
 
+# (model preset, dp, tp, grad_accum): the TPU version of the
+# reference's planning ladder (docs/guide/11_choosing_a_strategy.md:
+# 109-127, "7B: TP4xFSDP4 ... 70B: TP4xFSDP20"). TP stays within the
+# head-divisibility limits; chips = dp*tp; per-chip batch 8 at seq
+# 4096 (the REPORT_7b_v4-32.md working configuration).
+_TABLE_ROWS = (
+    ("7b", 2, 4, 1),     # 8 chips: the minimal-footprint 7B config
+    ("7b", 4, 8, 1),     # v4-32, the north star (REPORT_7b_v4-32.md)
+    ("13b", 4, 4, 1),    # 16 chips
+    ("13b", 8, 8, 1),    # 64 chips, roomy
+    ("70b", 8, 8, 1),    # 64 chips: minimal 70B footprint
+    ("70b", 16, 8, 1),   # 128 chips (v4-256 class)
+)
+
+
+def sizing_table(
+    seq_len: int = 4096, hbm_gib: float = 32.0
+) -> str:
+    """Computed (not hand-waved) strategy ladder: for each row the
+    analytic shard/fit analysis runs at per-chip batch 8 (the
+    REPORT_7b_v4-32.md working configuration), and the table records
+    the verdict against ``hbm_gib``. Regenerate
+    docs/guide/11_choosing_a_strategy.md with
+    ``python -m tpu_hpc.checks.fit --table``."""
+    lines = [
+        "| Model | params | chips | mesh | per-chip state | "
+        f"per-chip total | fits {hbm_gib:.0f} GiB? |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for name, dp, tp_size, accum in _TABLE_ROWS:
+        cfg = dataclasses.replace(
+            llama2.PRESETS[name], max_seq_len=seq_len
+        )
+        r = analyze(
+            cfg=cfg, dp=dp, tp_size=tp_size,
+            global_batch=8 * dp * accum, seq_len=seq_len,
+            hbm_gib=hbm_gib, do_compile=False, grad_accum=accum,
+        )
+        mesh = f"`{{data: {dp}, model: {tp_size}}}`" + (
+            f" + accum {accum}" if accum > 1 else ""
+        )
+        lines.append(
+            f"| {name} | {r.n_params/1e9:.1f}B | {dp*tp_size} | {mesh} "
+            f"| {r.static_bytes/GIB:.1f} GiB | {r.total_bytes/GIB:.1f} "
+            f"GiB | {'yes' if r.fits else 'NO'} |"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     import sys
 
@@ -428,12 +477,22 @@ def main(argv=None) -> int:
                         help="override n_layers (default: 7B's 32)")
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="analyze the N-way accumulated step")
+    parser.add_argument("--model", type=str, default=None,
+                        choices=sorted(llama2.PRESETS),
+                        help="model preset (default: 7B)")
+    parser.add_argument("--table", action="store_true",
+                        help="print the computed 7B..70B sizing table "
+                        "(analytic only, no compile) and exit")
     parser.add_argument("--no-compile", action="store_true")
     parser.add_argument("--markdown", type=str, default=None,
                         help="write the report to this path")
     parser.add_argument("--json", action="store_true",
                         help="print one JSON line instead of the report")
     args = parser.parse_args(argv)
+
+    if args.table:
+        print(sizing_table(seq_len=args.seq_len, hbm_gib=args.hbm_gib))
+        return 0
 
     # Self-provision the virtual pod for the compile pass: flip this
     # process to the simulated CPU backend if it's still pluripotent,
@@ -452,7 +511,12 @@ def main(argv=None) -> int:
             print(proc.stderr, end="", file=sys.stderr)
             return proc.returncode
 
-    cfg = llama2.LlamaConfig(max_seq_len=args.seq_len, remat=True)
+    if args.model is not None:
+        cfg = dataclasses.replace(
+            llama2.PRESETS[args.model], max_seq_len=args.seq_len
+        )
+    else:
+        cfg = llama2.LlamaConfig(max_seq_len=args.seq_len, remat=True)
     if args.layers is not None:
         cfg = dataclasses.replace(cfg, n_layers=args.layers)
     r = analyze(

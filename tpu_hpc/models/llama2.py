@@ -146,6 +146,25 @@ class LlamaConfig:
         return 3 * (self.n_layers * per_layer + embed)
 
 
+# Llama-2 family shapes (public architecture constants; the reference
+# ships only the 7B defaults, fsdp_tp/llama2_model.py:13-16, but its
+# planning tables reason about 7B..70B -- docs/guide/
+# 11_choosing_a_strategy.md:109-127). 70B is GQA (8 KV heads) with the
+# 1.3x/4096-rounded SwiGLU -> ffn_hidden 28672. max_seq_len 4096 = the
+# Llama-2 context window; remat on, the configuration large models run.
+PRESETS: Dict[str, LlamaConfig] = {
+    "7b": LlamaConfig(max_seq_len=4096, remat=True),
+    "13b": LlamaConfig(
+        dim=5120, n_layers=40, n_heads=40, max_seq_len=4096, remat=True
+    ),
+    "70b": LlamaConfig(
+        dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        ffn_dim_multiplier=1.3, multiple_of=4096,
+        max_seq_len=4096, remat=True,
+    ),
+}
+
+
 def rope_cos_sin(
     seq_len: int, head_dim: int, theta: float = 10000.0
 ) -> Tuple[jax.Array, jax.Array]:

@@ -59,6 +59,29 @@ def _leading_spec_extent(mesh: Mesh, spec: P) -> int:
     return out
 
 
+def make_microbatch_constrain(
+    mesh: Mesh, batch_sharding: Any
+) -> Callable[[Any], Any]:
+    """Constraint for a grad-accum microbatched tree [A, B/A, ...]:
+    the batch sharding with the accumulation dim replicated. The single
+    source for both the Trainer and the fit analyzer, so the step the
+    analysis compiles pins microbatches exactly as the training step
+    does."""
+    micro_sharding = jax.tree.map(
+        lambda s: NamedSharding(mesh, P(None, *s.spec)),
+        batch_sharding,
+        is_leaf=lambda x: isinstance(x, NamedSharding),
+    )
+
+    def constrain(tree):
+        return jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(a, micro_sharding),
+            tree,
+        )
+
+    return constrain
+
+
 def make_optimizer(cfg: TrainingConfig) -> optax.GradientTransformation:
     """SGD+momentum or AdamW from config (reference optimizers:
     SGD in the DDP/FSDP examples, AdamW with foreach=False in TP --
@@ -299,19 +322,9 @@ class Trainer:
             # sharding with the accumulation dim replicated: the
             # [B] -> [A, B/A] reshape otherwise leaves each microbatch
             # row on a 1/A fraction of the data axis.
-            micro_sharding = jax.tree.map(
-                lambda s: NamedSharding(mesh, P(None, *s.spec)),
-                self.batch_sharding,
-                is_leaf=lambda x: isinstance(x, NamedSharding),
+            micro_constrain = make_microbatch_constrain(
+                mesh, self.batch_sharding
             )
-
-            def micro_constrain(tree):
-                return jax.tree.map(
-                    lambda a: jax.lax.with_sharding_constraint(
-                        a, micro_sharding
-                    ),
-                    tree,
-                )
 
         self._step_impl = make_step_fn(
             forward, self.optimizer, cfg.seed,
