@@ -95,6 +95,24 @@ def test_pp_composes_with_dp(setup):
     np.testing.assert_allclose(float(loss), float(oracle), atol=1e-5)
 
 
+def test_pp_over_dcn_spanning_pipe_axis(setup):
+    """PP with the pipe axis spanning emulated slices (dcn_axes): the
+    70B+ layout from ch. 11 -- PP is the bandwidth-tolerant axis that
+    belongs on the slice boundary. The stage ppermute must still cross
+    the emulated-slice seam correctly."""
+    _, params, tokens, targets = setup
+    mesh = build_mesh(
+        MeshSpec(axes={"data": 2, "pipe": 2}, dcn_axes={"pipe": 2})
+    )
+    assert mesh.shape == {"data": 2, "pipe": 4}
+    from jax.sharding import PartitionSpec as P
+
+    loss_fn = _pipe_loss_fn(mesh, "gpipe", batch_spec=P(None, "data"))
+    loss = jax.jit(loss_fn)(params, tokens, targets)
+    oracle = _oracle_loss(params, tokens, targets)
+    np.testing.assert_allclose(float(loss), float(oracle), atol=1e-5)
+
+
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 def test_ppxdp_grads_match_oracle(setup, schedule):
     """Regression: 1F1B's custom vjp must psum stage grads over the

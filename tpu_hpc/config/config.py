@@ -38,6 +38,11 @@ class TrainingConfig:
     # 1/N the activation memory (how large global batches fit HBM at
     # 7B scale). 1 = off.
     grad_accum_steps: int = 1
+    # LR schedule: "constant" (the reference's fixed-lr examples) or
+    # "cosine" (warmup -> cosine decay over the whole run, the standard
+    # LLM pretraining shape). warmup_steps applies to both.
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
 
     # Precision (reference AMP block: utils/config.py:40-44).
     param_dtype: str = "float32"

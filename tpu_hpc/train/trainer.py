@@ -82,13 +82,45 @@ def make_microbatch_constrain(
     return constrain
 
 
+def make_lr_schedule(cfg: TrainingConfig):
+    """Scalar or optax schedule from config. ``state.step`` counts
+    optimizer updates, so schedules are grad-accum-agnostic and resume
+    exactly from a checkpoint (the count rides in the opt state)."""
+    total = max(cfg.epochs * cfg.steps_per_epoch, 1)
+    if cfg.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.learning_rate,
+            warmup_steps=cfg.warmup_steps,
+            decay_steps=total,
+        )
+    if cfg.lr_schedule != "constant":
+        raise ValueError(
+            f"unknown lr_schedule {cfg.lr_schedule!r}; "
+            "expected 'constant' or 'cosine'"
+        )
+    if cfg.warmup_steps > 0:
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(
+                    0.0, cfg.learning_rate, cfg.warmup_steps
+                ),
+                optax.constant_schedule(cfg.learning_rate),
+            ],
+            boundaries=[cfg.warmup_steps],
+        )
+    return cfg.learning_rate
+
+
 def make_optimizer(cfg: TrainingConfig) -> optax.GradientTransformation:
     """SGD+momentum or AdamW from config (reference optimizers:
     SGD in the DDP/FSDP examples, AdamW with foreach=False in TP --
-    tensor_parallel_vit.py:372-378; no foreach quirk exists here)."""
+    tensor_parallel_vit.py:372-378; no foreach quirk exists here),
+    with the configured LR schedule."""
+    lr = make_lr_schedule(cfg)
     if cfg.weight_decay > 0:
-        return optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
-    return optax.sgd(cfg.learning_rate, momentum=cfg.momentum)
+        return optax.adamw(lr, weight_decay=cfg.weight_decay)
+    return optax.sgd(lr, momentum=cfg.momentum)
 
 
 def make_step_fn(
