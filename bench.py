@@ -456,14 +456,15 @@ def main() -> int:
         "--pp-schedule", choices=("gpipe", "1f1b"), default="1f1b"
     )
     ap.add_argument("--pp-microbatches", type=int, default=8)
-    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--seq-len", type=int, default=None,
+                help="sequence length (default: 2048 for llama, 8192 for llama-long)")
     args = ap.parse_args()
     if args.all:
         return run_all(args.out, args.steps)
     if args.workload == "llama":
         rec = bench_llama(
             args.steps, args.remat, args.batch or 4, args.attn,
-            args.block_q, args.block_k, seq_len=args.seq_len,
+            args.block_q, args.block_k, seq_len=args.seq_len or 2048,
         )
     elif args.workload == "llama-sp":
         rec = bench_llama_sp(args.steps, args.batch or 4, args.sp_mode)
@@ -473,7 +474,8 @@ def main() -> int:
         )
     elif args.workload == "llama-long":
         rec = bench_llama_long(
-            args.steps, batch=args.batch or 1, remat=args.remat
+            args.steps, seq_len=args.seq_len or 8192,
+            batch=args.batch or 1, remat=args.remat,
         )
     else:
         rec = bench_unet(args.steps)

@@ -108,6 +108,11 @@ def main(argv=None) -> int:
     extra.add_argument("--lat", type=int, default=180)
     extra.add_argument("--lon", type=int, default=360)
     extra.add_argument("--demo", action="store_true")
+    extra.add_argument(
+        "--fsdp", action="store_true",
+        help="also ZeRO-3-shard the conv params over 'data' (the "
+        "domain+FSDP composition, 10_domain_parallel.md:156-172)",
+    )
     ns, _ = extra.parse_known_args(argv)
 
     logger = get_logger()
@@ -137,9 +142,21 @@ def main(argv=None) -> int:
         pred = model(p, x)
         return losses.lat_weighted_mse(pred, y), ms, {}
 
+    specs = None
+    if ns.fsdp:
+        from tpu_hpc.parallel import fsdp
+
+        # Conv stacks are small; min_size=1 shards every kernel whose
+        # channel dim divides -- the point here is the composition
+        # (halo ppermute over 'spatial' + FSDP all-gather over 'data'
+        # in one step), not comm savings at this toy size.
+        specs = fsdp.param_pspecs(
+            params, axis="data", axis_size=mesh.shape["data"], min_size=1
+        )
     trainer = Trainer(
         cfg, mesh, forward, params,
         batch_pspec=P("data", "spatial"),
+        param_pspecs=specs,
     )
     result = trainer.fit(ds)
     summary = result["epochs"][-1]

@@ -146,6 +146,44 @@ class TestGradients:
         np.testing.assert_allclose(gx, gx_ref, atol=1e-5)
 
 
+class TestDomainFsdpComposition:
+    def test_fsdp_sharded_kernel_matches_oracle(self, spatial_mesh):
+        """Domain + FSDP in one step (the reference's advertised
+        domain+FSDP script, 10_domain_parallel.md:156-172): the conv
+        kernel ZeRO-3-sharded over 'data' while its input rides
+        spatial halos -- forward and kernel-gradient must still equal
+        the single-device oracle."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x, kernel = rand_case(jax.random.key(11), cin=4, cout=4)
+        # Shard the kernel's output-channel dim over data (ZeRO-3);
+        # XLA all-gathers it before the conv, reduce-scatters dk.
+        kernel = jax.device_put(
+            kernel, NamedSharding(spatial_mesh, P(None, None, None, "data"))
+        )
+        x = jax.device_put(
+            x, NamedSharding(spatial_mesh, P("data", "spatial"))
+        )
+        halo = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_conv2d(t, p, axis_name=ax),
+            spatial_mesh,
+        )
+
+        def loss_halo(kernel, x):
+            return jnp.mean(halo(kernel, x) ** 2)
+
+        val, gk = jax.jit(
+            jax.value_and_grad(loss_halo)
+        )(kernel, x)
+        gk_ref = jax.grad(
+            lambda k, x: jnp.mean(single_device_conv(x, k) ** 2)
+        )(jax.device_get(kernel), jax.device_get(x))
+        np.testing.assert_allclose(
+            jax.device_get(gk), gk_ref, atol=1e-5
+        )
+        assert np.isfinite(float(val))
+
+
 class TestHaloExchange:
     def test_halo_contents(self, spatial_mesh):
         """Each tile's pad rows are exactly the neighbor's edge rows

@@ -88,6 +88,12 @@ def make_lr_schedule(cfg: TrainingConfig):
     exactly from a checkpoint (the count rides in the opt state)."""
     total = max(cfg.epochs * cfg.steps_per_epoch, 1)
     if cfg.lr_schedule == "cosine":
+        if cfg.warmup_steps >= total:
+            raise ValueError(
+                f"warmup_steps {cfg.warmup_steps} must be < the run "
+                f"length of {total} optimizer updates "
+                f"(epochs * steps_per_epoch) for cosine decay"
+            )
         return optax.warmup_cosine_decay_schedule(
             init_value=0.0,
             peak_value=cfg.learning_rate,
