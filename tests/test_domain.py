@@ -175,13 +175,17 @@ class TestDomainFsdpComposition:
         val, gk = jax.jit(
             jax.value_and_grad(loss_halo)
         )(kernel, x)
-        gk_ref = jax.grad(
-            lambda k, x: jnp.mean(single_device_conv(x, k) ** 2)
-        )(jax.device_get(kernel), jax.device_get(x))
+        ref_loss = lambda k, x: jnp.mean(  # noqa: E731
+            single_device_conv(x, k) ** 2
+        )
+        k_host, x_host = jax.device_get(kernel), jax.device_get(x)
+        gk_ref = jax.grad(ref_loss)(k_host, x_host)
         np.testing.assert_allclose(
             jax.device_get(gk), gk_ref, atol=1e-5
         )
-        assert np.isfinite(float(val))
+        np.testing.assert_allclose(
+            float(val), float(ref_loss(k_host, x_host)), atol=1e-5
+        )
 
 
 class TestHaloExchange:

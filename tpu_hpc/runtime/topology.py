@@ -30,6 +30,9 @@ def device_summary() -> List[Dict[str, Any]]:
         core = getattr(d, "core_on_chip", None)
         if core is not None:
             rec["core_on_chip"] = core
+        slice_idx = getattr(d, "slice_index", None)
+        if slice_idx is not None:
+            rec["slice_index"] = slice_idx
         try:
             stats = d.memory_stats()
             if stats:
@@ -44,6 +47,8 @@ def device_summary() -> List[Dict[str, Any]]:
 def topology_report() -> Dict[str, Any]:
     """Job-level topology: host->chip map (parity with the rank->node map
     printed by check_environment.py:240-244)."""
+    from tpu_hpc.runtime.mesh import slice_groups
+
     return {
         "jax_version": jax.__version__,
         "backend": jax.default_backend(),
@@ -51,5 +56,8 @@ def topology_report() -> Dict[str, Any]:
         "process_count": jax.process_count(),
         "global_device_count": jax.device_count(),
         "local_device_count": jax.local_device_count(),
+        # Multi-slice shape: >1 means DCN separates the groups and
+        # dcn_axes meshes apply (09_hybrid_parallelism.md).
+        "num_slices": len(slice_groups(jax.devices())),
         "devices": device_summary(),
     }
