@@ -27,7 +27,9 @@ import sys
 from tpu_hpc.config import TrainingConfig
 from tpu_hpc.logging_ import get_logger
 from tpu_hpc.models import datasets, losses
-from tpu_hpc.models.unet import UNetConfig, apply_unet, init_unet
+from tpu_hpc.models.unet import (
+    UNetConfig, apply_unet, init_unet, make_eval_forward,
+)
 from tpu_hpc.parallel import dp
 from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
 from tpu_hpc.train import Trainer
@@ -68,6 +70,10 @@ def main(argv=None) -> int:
         param_pspecs=dp.param_pspecs(params),
         batch_pspec=dp.batch_pspec(),
         checkpoint_manager=ckpt_mgr,
+        # Inference-mode eval (stored BatchNorm stats), so evaluate()
+        # reports true test loss -- and the stateful-model warning
+        # stays out of the logs.
+        eval_forward=make_eval_forward(model_cfg),
     )
     result = trainer.fit(ds)
     if ckpt_mgr is not None:

@@ -63,7 +63,8 @@ def _launch(rank_env) -> "list[subprocess.Popen]":
         # launcher vars that would win the detection cascade.
         for v in (
             "JAX_PROCESS_ID", "JAX_NUM_PROCESSES",
-            "JAX_COORDINATOR_ADDRESS", "OMPI_COMM_WORLD_RANK",
+            "JAX_COORDINATOR_ADDRESS", "JAX_COORDINATOR_PORT",
+            "OMPI_COMM_WORLD_RANK",
             "OMPI_COMM_WORLD_SIZE", "MASTER_ADDR", "MASTER_PORT",
             "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "SLURM_PROCID",
             "SLURM_NTASKS", "TPU_HPC_SIM_DEVICES", "XLA_FLAGS",
