@@ -14,10 +14,19 @@ set -euo pipefail
 TPU_NAME="${TPU_NAME:-tpu-hpc-dev}"
 ZONE="${ZONE:-us-central2-b}"
 LOG_DIR="${LOG_DIR:-}"
+# XLA/libtpu performance preset exported before the program starts --
+# the role of the reference launchers' NCCL/FI/MPICH env block
+# (torchrun_multigpu_ddp.sh:59-76). "default" = no flags; see
+# tpu_hpc/runtime/tuning.py for profiles.
+TUNING="${TUNING:-collective-overlap}"
 
 SCRIPT="${1:?usage: tpu_vm_run.sh <script.py> [args...]}"
 shift || true
 ARGS="$*"
+
+# Fail fast on a typo'd profile HERE, not as a buried argparse error
+# in the ssh log with training silently proceeding untuned.
+python -m tpu_hpc.runtime.tuning --profile "${TUNING}" >/dev/null
 
 # Per-worker output capture (parity: the per-rank redirect
 # utils/redirect.py -- here stdout tee'd per worker by gcloud).
@@ -32,6 +41,7 @@ gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
         ${REDIRECT}
         source ~/tpu-hpc-venv/bin/activate
         cd ~/tpu_hpc_repo
+        eval \$(python -m tpu_hpc.runtime.tuning --profile ${TUNING} --shell)
         python ${SCRIPT} ${ARGS}
     "
 

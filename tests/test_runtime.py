@@ -213,3 +213,58 @@ class TestTopology:
         rep = topology_report()
         assert rep["global_device_count"] == 8
         assert rep["process_count"] == 1
+
+
+class TestTuning:
+    """XLA/libtpu performance presets (the reference's NCCL-tuning env
+    block, nccl_tuning.md:11-66, as versioned code)."""
+
+    def test_profiles_are_flag_strings(self):
+        from tpu_hpc.runtime import tuning
+
+        for name, env in tuning.PROFILES.items():
+            for var, flags in env.items():
+                assert var in ("LIBTPU_INIT_ARGS", "XLA_FLAGS")
+                assert all(f.startswith("--") for f in flags.split())
+
+    def test_user_flags_preserved_and_win(self):
+        from tpu_hpc.runtime import tuning
+
+        env = tuning.tuning_env(
+            "collective-overlap",
+            base={"LIBTPU_INIT_ARGS": "--xla_enable_async_all_gather=false"},
+        )
+        merged = env["LIBTPU_INIT_ARGS"]
+        # Preset present, user's value after it (XLA last-wins).
+        assert "--xla_enable_async_all_gather=true" in merged
+        assert merged.endswith("--xla_enable_async_all_gather=false")
+
+    def test_unknown_profile_rejected(self):
+        from tpu_hpc.runtime import tuning
+
+        with pytest.raises(ValueError, match="unknown tuning profile"):
+            tuning.tuning_env("turbo")
+
+    def test_apply_after_backend_init_rejected(self, devices):
+        from tpu_hpc.runtime import tuning
+
+        with pytest.raises(RuntimeError, match="after the JAX backend"):
+            tuning.apply_tuning()
+
+    def test_shell_mode(self, capsys):
+        from tpu_hpc.runtime import tuning
+
+        tuning.main(["--profile", "data-parallel", "--shell"])
+        out = capsys.readouterr().out
+        assert out.startswith("export LIBTPU_INIT_ARGS='--xla_tpu")
+
+    def test_data_parallel_is_superset_of_overlap(self):
+        from tpu_hpc.runtime import tuning
+
+        overlap = set(
+            tuning.PROFILES["collective-overlap"]["LIBTPU_INIT_ARGS"].split()
+        )
+        dp_flags = set(
+            tuning.PROFILES["data-parallel"]["LIBTPU_INIT_ARGS"].split()
+        )
+        assert overlap < dp_flags  # docs promise a strict superset

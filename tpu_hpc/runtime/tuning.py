@@ -1,0 +1,127 @@
+"""Curated XLA/libtpu performance-flag presets.
+
+Capability parity with the reference's transport-tuning env contract
+-- the NCCL/libfabric/MPICH block every launcher exports
+(/root/reference/scripts/01_data_parallel_ddp/torchrun_multigpu_ddp.sh
+:59-76, docs/guide/nccl_tuning.md:11-66). On TPU there is no transport
+to tune, but the compiler and runtime have the equivalent knobs:
+latency-hiding scheduling and async-collective fusion decide whether
+FSDP all-gathers overlap the previous layer's matmuls the way NCCL
+ring overlap did on NVLink. These presets are the "copy one block into
+your launcher" ergonomics, kept in code so they are versioned, named,
+and testable instead of pasted.
+
+Flags are the publicly documented set popularized by large open TPU
+trainers; they are read by libtpu at backend initialization, so
+``apply_tuning`` must run before the first jax device/jit call (the
+same must-set-before-init contract as the reference's NCCL vars, which
+must be exported before ``init_process_group``).
+
+Usage (launcher or program entry)::
+
+    from tpu_hpc.runtime import tuning
+    tuning.apply_tuning("collective-overlap")   # before any jax use
+    init_distributed()
+
+or in a shell launcher: ``eval $(python -m tpu_hpc.runtime.tuning
+--profile collective-overlap --shell)``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# Overlap collectives with compute: the ICI analogue of the
+# reference's NCCL overlap tuning (nccl_tuning.md:11-35). Enables the
+# latency-hiding scheduler and async collective fusion so FSDP/TP
+# all-gathers and reduce-scatters run under the MXU work.
+_OVERLAP = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true "
+    "--xla_enable_async_all_gather=true "
+    "--xla_tpu_enable_async_collective_fusion=true "
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true "
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true "
+    "--xla_tpu_overlap_compute_collective_tc=true"
+)
+
+# Each profile: env-var -> flags to APPEND (existing user flags win by
+# coming later in the string for XLA's last-wins parsing).
+PROFILES: Dict[str, Dict[str, str]] = {
+    # No-op: measure first, tune second.
+    "default": {},
+    "collective-overlap": {"LIBTPU_INIT_ARGS": _OVERLAP},
+    # Pure-DP/FSDP jobs: the overlap set plus the data-parallel
+    # all-reduce scheduling optimizations (a strict superset).
+    "data-parallel": {
+        "LIBTPU_INIT_ARGS": (
+            _OVERLAP + " "
+            "--xla_tpu_enable_data_parallel_all_reduce_opt=true "
+            "--xla_tpu_data_parallel_opt_different_sized_ops=true"
+        ),
+    },
+}
+
+
+def tuning_env(
+    profile: str = "collective-overlap",
+    base: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    """The env additions for ``profile``, merged over ``base``
+    (defaults to ``os.environ``). Existing values are preserved and
+    the preset flags appended -- user-set flags stay in effect (XLA
+    parses duplicates last-wins, and the user's come last)."""
+    if profile not in PROFILES:
+        raise ValueError(
+            f"unknown tuning profile {profile!r}; "
+            f"available: {sorted(PROFILES)}"
+        )
+    src = dict(os.environ if base is None else base)
+    out: Dict[str, str] = {}
+    for var, flags in PROFILES[profile].items():
+        existing = src.get(var, "").strip()
+        # Preset first, user's existing flags after (last-wins).
+        out[var] = f"{flags} {existing}".strip() if existing else flags
+    return out
+
+
+def apply_tuning(profile: str = "collective-overlap") -> Dict[str, str]:
+    """Set the preset into ``os.environ``. Must run before the first
+    jax backend use -- libtpu reads LIBTPU_INIT_ARGS exactly once at
+    initialization (same contract as NCCL_* before init_process_group,
+    reference utils/distributed.py:124-158)."""
+    from tpu_hpc.runtime.sim import backends_initialized
+
+    if backends_initialized():
+        raise RuntimeError(
+            f"apply_tuning({profile!r}) called after the JAX backend "
+            "initialized -- libtpu has already read its flags. Call it "
+            "before any jax.devices()/jit use (or export the env in "
+            "the launcher: python -m tpu_hpc.runtime.tuning --shell)."
+        )
+    env = tuning_env(profile)
+    os.environ.update(env)
+    return env
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--profile", default="collective-overlap",
+                   choices=sorted(PROFILES))
+    p.add_argument("--shell", action="store_true",
+                   help="print 'export VAR=...' lines for a launcher")
+    args = p.parse_args(argv)
+    env = tuning_env(args.profile)
+    for var, val in env.items():
+        if args.shell:
+            print(f"export {var}='{val}'")
+        else:
+            print(f"{var}={val}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
