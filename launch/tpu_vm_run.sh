@@ -24,9 +24,17 @@ SCRIPT="${1:?usage: tpu_vm_run.sh <script.py> [args...]}"
 shift || true
 ARGS="$*"
 
-# Fail fast on a typo'd profile HERE, not as a buried argparse error
-# in the ssh log with training silently proceeding untuned.
-python -m tpu_hpc.runtime.tuning --profile "${TUNING}" >/dev/null
+# Fail fast on a typo'd profile HERE when possible -- best-effort: the
+# operator's workstation may have only gcloud (no python/venv), and
+# the remote side enforces regardless (set -e below).
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+PY="$(command -v python3 || command -v python || true)"
+if [[ -n "${PY}" ]]; then
+    PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:$PYTHONPATH}" \
+        "${PY}" -m tpu_hpc.runtime.tuning --profile "${TUNING}" >/dev/null
+else
+    echo ">> note: no local python; profile '${TUNING}' validated remotely"
+fi
 
 # Per-worker output capture (parity: the per-rank redirect
 # utils/redirect.py -- here stdout tee'd per worker by gcloud).
@@ -38,10 +46,11 @@ fi
 echo ">> launching ${SCRIPT} ${ARGS} on all workers of ${TPU_NAME}"
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
     --command "
+        set -e
         ${REDIRECT}
         source ~/tpu-hpc-venv/bin/activate
         cd ~/tpu_hpc_repo
-        eval \$(python -m tpu_hpc.runtime.tuning --profile ${TUNING} --shell)
+        eval \"\$(python -m tpu_hpc.runtime.tuning --profile ${TUNING} --shell)\"
         python ${SCRIPT} ${ARGS}
     "
 

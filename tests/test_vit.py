@@ -110,3 +110,30 @@ def test_tp_training_step(mesh_2d):
     )
     result = trainer.fit(ds)
     assert np.isfinite(result["final_loss"])
+
+
+def test_flash_attn_fn_matches_einsum(devices):
+    """Non-causal blockwise attention plugged into the ViT block must
+    match the default einsum path (the flash kernel serves ViT-scale
+    grids too, not just causal LLMs)."""
+    from tpu_hpc.kernels.attention import blockwise_attention
+    from tpu_hpc.models.vit import ViTConfig, apply_vit, init_vit
+
+    cfg = ViTConfig(
+        in_channels=3, out_channels=3, lat=16, lon=32, patch_size=4,
+        embed_dim=64, depth=2, n_heads=4,
+    )
+    params = init_vit(jax.random.key(0), cfg)
+    x = jax.random.normal(
+        jax.random.key(1), (2, cfg.lat, cfg.lon, 3), jnp.float32
+    )
+
+    def flash(q, k, v):
+        out, _ = blockwise_attention(q, k, v, causal=False, impl="xla")
+        return out
+
+    base = apply_vit(params, x, cfg)
+    with_kernel = apply_vit(params, x, cfg, attn_fn=flash)
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(with_kernel), atol=3e-2, rtol=3e-2
+    )

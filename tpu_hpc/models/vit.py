@@ -21,11 +21,17 @@ TPU-first design:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+# (q, k, v) [B, S, H, D] -> [B, S, H, D]: plugs the Pallas flash
+# kernel (kernels.attention.blockwise_attention, causal=False) or a
+# sequence-parallel attention into the block, same hook design as
+# models/llama2.AttnFn.
+AttnFn = Optional[Callable[[jax.Array, jax.Array, jax.Array], jax.Array]]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +82,7 @@ class ViTAttention(nn.Module):
     (the reference's explicit design note, :93-110)."""
 
     cfg: ViTConfig
+    attn_fn: AttnFn = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -88,9 +95,12 @@ class ViTAttention(nn.Module):
         q = q.reshape(b, n, cfg.n_heads, hd)
         k = k.reshape(b, n, cfg.n_heads, hd)
         v = v.reshape(b, n, cfg.n_heads, hd)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd ** -0.5
-        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cfg.dtype), v)
+        if self.attn_fn is not None:
+            out = self.attn_fn(q, k, v)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd ** -0.5
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cfg.dtype), v)
         return _dense(cfg.embed_dim, cfg.dtype, "out_proj", cfg.param_dtype)(
             out.reshape(b, n, cfg.embed_dim)
         )
@@ -98,6 +108,7 @@ class ViTAttention(nn.Module):
 
 class ViTBlock(nn.Module):
     cfg: ViTConfig
+    attn_fn: AttnFn = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -105,7 +116,7 @@ class ViTBlock(nn.Module):
         ln = lambda nm: nn.LayerNorm(  # noqa: E731
             dtype=jnp.float32, param_dtype=cfg.param_dtype, name=nm
         )
-        x = x + ViTAttention(cfg, name="attn")(
+        x = x + ViTAttention(cfg, self.attn_fn, name="attn")(
             ln("norm1")(x).astype(cfg.dtype)
         )
         h = ln("norm2")(x).astype(cfg.dtype)
@@ -116,6 +127,7 @@ class ViTBlock(nn.Module):
 
 class SimpleViT(nn.Module):
     cfg: ViTConfig
+    attn_fn: AttnFn = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -137,7 +149,7 @@ class SimpleViT(nn.Module):
         )
         tok = tok + pos.astype(cfg.dtype)
         for i in range(cfg.depth):
-            tok = ViTBlock(cfg, name=f"blocks_{i}")(tok)
+            tok = ViTBlock(cfg, self.attn_fn, name=f"blocks_{i}")(tok)
         tok = nn.LayerNorm(
             dtype=jnp.float32, param_dtype=cfg.param_dtype, name="norm"
         )(tok)
@@ -159,11 +171,13 @@ def init_vit(rng: jax.Array, cfg: ViTConfig) -> Dict:
     return SimpleViT(cfg).init(rng, sample)["params"]
 
 
-def apply_vit(params: Dict, x: jax.Array, cfg: ViTConfig) -> jax.Array:
-    return SimpleViT(cfg).apply({"params": params}, x)
+def apply_vit(
+    params: Dict, x: jax.Array, cfg: ViTConfig, attn_fn: AttnFn = None
+) -> jax.Array:
+    return SimpleViT(cfg, attn_fn).apply({"params": params}, x)
 
 
-def make_forward(cfg: ViTConfig):
+def make_forward(cfg: ViTConfig, attn_fn: AttnFn = None):
     """Trainer-contract forward: latitude-weighted MSE regression on
     (input, target) grids (the reference trains its ViT with the same
     loss, tensor_parallel_vit.py:209-217)."""
@@ -171,7 +185,7 @@ def make_forward(cfg: ViTConfig):
 
     def forward(params, model_state, batch, step_rng):
         x, y = batch
-        pred = apply_vit(params, x, cfg)
+        pred = apply_vit(params, x, cfg, attn_fn)
         return lat_weighted_mse(pred, y), model_state, {}
 
     return forward
