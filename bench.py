@@ -259,11 +259,26 @@ def bench_llama_pp(
     init_distributed(verbose=False)
     n_stages = jax.device_count()
     mesh = build_mesh(MeshSpec(axes={"pipe": n_stages}))
+    # v=2 only while the total depth (8 layers) still divides over
+    # v*S stages -- otherwise the interleaved model would have MORE
+    # layers than the gpipe/1f1b baselines and tokens/s would compare
+    # apples to oranges.
+    v = (
+        2
+        if schedule == "interleaved" and 1 < n_stages and
+        8 % (2 * n_stages) == 0
+        else 1
+    )
     model_cfg = ptx.PipeConfig(
-        vocab_size=32000, dim=1024, n_heads=8, n_stages=n_stages,
-        layers_per_stage=max(8 // n_stages, 1), max_seq_len=2048,
+        vocab_size=32000, dim=1024, n_heads=8, n_stages=n_stages * v,
+        layers_per_stage=max(8 // (n_stages * v), 1), max_seq_len=2048,
     )
     params = ptx.init_pipeline_transformer(jax.random.key(0), model_cfg)
+    if v > 1:
+        params = dict(
+            params,
+            stages=pp.interleave_stacked(params["stages"], n_stages),
+        )
     specs = {
         "embed": jax.tree.map(lambda _: P(), params["embed"]),
         "stages": pp.stage_pspecs(params["stages"], axis="pipe"),
@@ -271,7 +286,7 @@ def bench_llama_pp(
     }
     pipe = pp.pipelined(
         ptx.make_stage_fn(model_cfg), mesh, axis="pipe",
-        schedule=schedule, batch_spec=P(),
+        schedule=schedule, batch_spec=P(), n_chunks=v,
     )
 
     def forward(params, model_state, batch, step_rng):
@@ -297,7 +312,7 @@ def bench_llama_pp(
     result = trainer.fit(ds)
     summary = result["epochs"][-1]
     tokens_per_s = summary["items_per_s"] * model_cfg.max_seq_len
-    bubble = pp.bubble_fraction(n_stages, microbatches)
+    bubble = pp.bubble_fraction(n_stages, microbatches, n_chunks=v)
     print(
         f"llama-pp[{schedule}] | stages={n_stages} mb={microbatches} "
         f"bubble {bubble:.1%} | {tokens_per_s:.0f} tokens/s",
@@ -453,7 +468,8 @@ def main() -> int:
         default="zigzag",
     )
     ap.add_argument(
-        "--pp-schedule", choices=("gpipe", "1f1b"), default="1f1b"
+        "--pp-schedule", choices=("gpipe", "1f1b", "interleaved"),
+        default="1f1b"
     )
     ap.add_argument("--pp-microbatches", type=int, default=8)
     ap.add_argument("--seq-len", type=int, default=None,

@@ -42,8 +42,16 @@ from tpu_hpc.train import Trainer
 def main(argv=None) -> int:
     cfg = TrainingConfig.from_args(argv)
     extra = argparse.ArgumentParser(add_help=False)
-    extra.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe")
+    extra.add_argument(
+        "--schedule", choices=["gpipe", "1f1b", "interleaved"],
+        default="gpipe",
+    )
     extra.add_argument("--num-microbatches", type=int, default=8)
+    extra.add_argument(
+        "--num-chunks", type=int, default=2,
+        help="virtual stage chunks per device (interleaved schedule "
+        "only): bubble time shrinks by this factor",
+    )
     args, _ = extra.parse_known_args(argv)
 
     logger = get_logger()
@@ -57,19 +65,34 @@ def main(argv=None) -> int:
     # unpipelined (the reference's world_size==1 fallback pattern).
     n_stages = mesh.shape.get("pipe", 1)
     M = args.num_microbatches
+    # Interleaving needs a real pipe ring; on one device fall back
+    # to v=1 (the unpipelined path would silently run only chunk 0
+    # of a multi-chunk model otherwise).
+    v = (
+        args.num_chunks
+        if args.schedule == "interleaved" and n_stages > 1
+        else 1
+    )
     logger.info(
         "mesh: %s | schedule %s | %d microbatches | bubble fraction %.1f%%",
         dict(mesh.shape), args.schedule, M,
-        100 * pp.bubble_fraction(n_stages, M),
+        100 * pp.bubble_fraction(n_stages, M, n_chunks=v),
     )
 
     param_dtype, compute_dtype = cfg.jax_dtypes()
+    # Interleaved: v model chunks per device -> v*S model stages
+    # round-robin on the pipe ring (stack_interleaved_stage_params).
     model_cfg = ptx.PipeConfig(
-        vocab_size=4096, dim=256, n_heads=8, n_stages=n_stages,
+        vocab_size=4096, dim=256, n_heads=8, n_stages=n_stages * v,
         layers_per_stage=2, max_seq_len=256,
         dtype=compute_dtype, param_dtype=param_dtype,
     )
     params = ptx.init_pipeline_transformer(jax.random.key(cfg.seed), model_cfg)
+    if v > 1:
+        params = dict(
+            params,
+            stages=pp.interleave_stacked(params["stages"], n_stages),
+        )
     specs = {
         "embed": jax.tree.map(lambda _: P(), params["embed"]),
         "stages": pp.stage_pspecs(params["stages"], axis="pipe")
@@ -82,6 +105,7 @@ def main(argv=None) -> int:
         pipe = pp.pipelined(
             ptx.make_stage_fn(model_cfg), mesh, axis="pipe",
             schedule=args.schedule, batch_spec=batch_spec,
+            n_chunks=v,
         )
     else:
         stage_fn = ptx.make_stage_fn(model_cfg)
@@ -114,7 +138,7 @@ def main(argv=None) -> int:
         "run summary | final loss %.5f | %.0f tokens/s | bubble %.1f%% "
         "(%d stages, %d microbatches)",
         result["final_loss"], tokens_per_s,
-        100 * pp.bubble_fraction(n_stages, M), n_stages, M,
+        100 * pp.bubble_fraction(n_stages, M, n_chunks=v), n_stages, M,
     )
     return 0
 

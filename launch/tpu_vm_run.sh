@@ -50,7 +50,8 @@ gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
         ${REDIRECT}
         source ~/tpu-hpc-venv/bin/activate
         cd ~/tpu_hpc_repo
-        eval \"\$(python -m tpu_hpc.runtime.tuning --profile ${TUNING} --shell)\"
+        TUNING_VARS=\"\$(python -m tpu_hpc.runtime.tuning --profile ${TUNING} --shell)\"
+        eval \"\${TUNING_VARS}\"
         python ${SCRIPT} ${ARGS}
     "
 

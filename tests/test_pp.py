@@ -153,3 +153,165 @@ def test_manual_stage_step(setup):
     y = np.asarray(shift(x))
     np.testing.assert_array_equal(y[1:], np.asarray(x[:3]))
     np.testing.assert_array_equal(y[0], np.zeros(2))
+
+
+class TestInterleaved:
+    """Interleaved (virtual-chunk) schedule: 8 model stages round-robin
+    on 4 devices (v=2) must match the sequential oracle, forward and
+    backward -- the beyond-reference schedule that cuts bubble time by
+    the chunk count."""
+
+    CFG8 = ptx.PipeConfig(
+        vocab_size=64, dim=32, n_heads=2, n_stages=8,
+        layers_per_stage=1, max_seq_len=16,
+    )
+
+    def _loss_fn(self, mesh, n_micro=4, v=2):
+        cfg = self.CFG8
+        pipe = pp.pipelined(
+            ptx.make_stage_fn(cfg), mesh, axis="pipe",
+            schedule="interleaved", n_chunks=v,
+        )
+
+        def loss(params, tokens, targets):
+            xs = ptx.embed(params, pp.microbatch(tokens, n_micro), cfg)
+            per = [
+                jax.tree.map(lambda a: a[g], params["stages"])
+                for g in range(cfg.n_stages)
+            ]
+            stacked = pp.stack_interleaved_stage_params(per, 4)
+            ys = pipe(stacked, xs)
+            logits = ptx.head(params, ys, cfg)
+            return losses.cross_entropy(
+                logits, pp.microbatch(targets, n_micro)
+            )
+
+        return loss
+
+    @pytest.fixture(scope="class")
+    def setup8(self):
+        mesh = build_mesh(
+            MeshSpec(axes={"pipe": 4}), devices=jax.devices()[:4]
+        )
+        params = ptx.init_pipeline_transformer(
+            jax.random.key(0), self.CFG8
+        )
+        tokens = jax.random.randint(
+            jax.random.key(1), (8, 16), 0, 64, dtype=jnp.int32
+        )
+        targets = jax.random.randint(
+            jax.random.key(2), (8, 16), 0, 64, dtype=jnp.int32
+        )
+        return mesh, params, tokens, targets
+
+    def _oracle(self, params, tokens, targets):
+        logits = ptx.apply_sequential(params, tokens, self.CFG8)
+        return losses.cross_entropy(logits, targets)
+
+    def test_forward_matches_oracle(self, setup8):
+        mesh, params, tokens, targets = setup8
+        loss = jax.jit(self._loss_fn(mesh))(params, tokens, targets)
+        oracle = self._oracle(params, tokens, targets)
+        np.testing.assert_allclose(float(loss), float(oracle), atol=1e-5)
+
+    def test_grads_match_oracle(self, setup8):
+        mesh, params, tokens, targets = setup8
+        g = jax.jit(jax.grad(self._loss_fn(mesh)))(params, tokens, targets)
+        g_ref = jax.jit(jax.grad(self._oracle))(params, tokens, targets)
+        _tree_allclose(g, g_ref, atol=2e-4)
+
+    def test_single_chunk_reduces_to_gpipe(self, setup):
+        """v=1 on the 4-stage model: same loss as the gpipe schedule."""
+        mesh, params, tokens, targets = setup
+
+        pipe = pp.pipelined(
+            ptx.make_stage_fn(CFG), mesh, axis="pipe",
+            schedule="interleaved", n_chunks=1,
+        )
+
+        def loss(params, tokens, targets):
+            xs = ptx.embed(params, pp.microbatch(tokens, 4), CFG)
+            per = [
+                jax.tree.map(lambda a: a[g], params["stages"])
+                for g in range(4)
+            ]
+            ys = pipe(pp.stack_interleaved_stage_params(per, 4), xs)
+            logits = ptx.head(params, ys, CFG)
+            return losses.cross_entropy(logits, pp.microbatch(targets, 4))
+
+        got = jax.jit(loss)(params, tokens, targets)
+        want = jax.jit(_pipe_loss_fn(mesh, "gpipe"))(params, tokens, targets)
+        np.testing.assert_allclose(float(got), float(want), atol=1e-6)
+
+    def test_indivisible_microbatches_rejected(self, setup8):
+        mesh, params, tokens, targets = setup8
+        with pytest.raises(ValueError, match="divisible by pipeline"):
+            jax.jit(self._loss_fn(mesh, n_micro=2))(
+                params, tokens, targets
+            )
+
+    def test_ppxdp_grads_match_oracle(self, setup8):
+        """Interleaved x DP on a 2D mesh: param grads must include
+        every data shard's contribution (shard_map's transpose psums
+        them on this autodiff path -- pinned like the gpipe/1f1b
+        composition tests)."""
+        mesh2 = build_mesh(MeshSpec(axes={"data": 2, "pipe": 4}))
+        _, params, tokens, targets = setup8
+        from jax.sharding import PartitionSpec as P
+
+        cfg = self.CFG8
+        pipe = pp.pipelined(
+            ptx.make_stage_fn(cfg), mesh2, axis="pipe",
+            schedule="interleaved", n_chunks=2,
+            batch_spec=P(None, "data"),
+        )
+
+        def loss(params, tokens, targets):
+            xs = ptx.embed(params, pp.microbatch(tokens, 4), cfg)
+            ys = pipe(
+                pp.interleave_stacked(params["stages"], 4), xs
+            )
+            logits = ptx.head(params, ys, cfg)
+            return losses.cross_entropy(
+                logits, pp.microbatch(targets, 4)
+            )
+
+        g = jax.jit(jax.grad(loss))(params, tokens, targets)
+        g_ref = jax.jit(jax.grad(self._oracle))(params, tokens, targets)
+        _tree_allclose(g, g_ref, atol=2e-4)
+
+    def test_chunk_mismatch_rejected(self, setup8):
+        mesh, params, tokens, targets = setup8
+        cfg = self.CFG8
+        pipe = pp.pipelined(
+            ptx.make_stage_fn(cfg), mesh, axis="pipe",
+            schedule="interleaved", n_chunks=4,  # params carry 2
+        )
+
+        def loss(params, tokens, targets):
+            xs = ptx.embed(params, pp.microbatch(tokens, 4), cfg)
+            ys = pipe(pp.interleave_stacked(params["stages"], 4), xs)
+            logits = ptx.head(params, ys, cfg)
+            return losses.cross_entropy(logits, pp.microbatch(targets, 4))
+
+        with pytest.raises(ValueError, match="chunks per"):
+            jax.jit(loss)(params, tokens, targets)
+
+    def test_interleave_stacked_matches_list_helper(self):
+        per = [{"w": jnp.full((1,), float(g))} for g in range(8)]
+        stacked = pp.stack_stage_params(per)
+        a = pp.stack_interleaved_stage_params(per, 4)
+        b = pp.interleave_stacked(stacked, 4)
+        np.testing.assert_array_equal(
+            np.asarray(a["w"]), np.asarray(b["w"])
+        )
+
+    def test_interleaved_layout(self):
+        per = [{"w": jnp.full((1,), float(g))} for g in range(8)]
+        stacked = pp.stack_interleaved_stage_params(per, 4)
+        # Position s*v + j holds global stage j*S + s (S=4, v=2).
+        order = [float(stacked["w"][i, 0]) for i in range(8)]
+        assert order == [0.0, 4.0, 1.0, 5.0, 2.0, 6.0, 3.0, 7.0]
+
+    def test_bubble_shrinks_with_chunks(self):
+        assert pp.bubble_fraction(4, 8, n_chunks=2) < pp.bubble_fraction(4, 8)

@@ -50,14 +50,21 @@ from tpu_hpc.runtime.mesh import PIPE_AXIS
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
-def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
-    """Idle fraction of the pipeline: (S-1)/(M+S-1).
+def bubble_fraction(
+    n_stages: int, n_microbatches: int, n_chunks: int = 1
+) -> float:
+    """Idle fraction of the pipeline: (S-1)/(M*v + S-1).
 
     The reference reports the approximation (S-1)/M
     (03_pipeline_training.py:292, 07_pipeline_parallel.md:127-143);
-    this is the exact closed form (equal for M >> S).
+    this is the exact closed form (equal for M >> S). ``n_chunks`` = v
+    virtual stage chunks per device (the interleaved schedule): each
+    tick shrinks to 1/v of the work, so the ramp/drain cost falls from
+    (S-1) to (S-1)/v time units.
     """
-    return (n_stages - 1) / (n_microbatches + n_stages - 1)
+    return (n_stages - 1) / (
+        n_microbatches * n_chunks + n_stages - 1
+    )
 
 
 def microbatch(x: jax.Array, n_microbatches: int) -> jax.Array:
@@ -79,6 +86,43 @@ def stack_stage_params(per_stage: list) -> Any:
     """Stack a list of per-stage param pytrees on a new leading dim
     (to be sharded P(pipe_axis))."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def stack_interleaved_stage_params(per_stage: list, n_devices: int) -> Any:
+    """Stack v*S per-stage pytrees in the INTERLEAVED device layout:
+    device s owns global stages {s, S+s, 2S+s, ...} (round-robin, the
+    Megatron virtual-pipeline placement), so position ``s*v + j`` holds
+    global stage ``j*S + s``. Shard the result P(pipe_axis); each
+    device's local view [v, ...] has chunk j = its j-th owned stage."""
+    L = len(per_stage)
+    if L % n_devices != 0:
+        raise ValueError(
+            f"{L} stages not divisible by {n_devices} pipeline devices"
+        )
+    v = L // n_devices
+    order = [
+        j * n_devices + s for s in range(n_devices) for j in range(v)
+    ]
+    return stack_stage_params([per_stage[g] for g in order])
+
+
+def interleave_stacked(stacked: Any, n_devices: int) -> Any:
+    """Reorder a sequentially stacked [L, ...] stage tree (position g =
+    global stage g) into the interleaved device layout (position
+    ``s*v + j`` = global stage ``j*S + s``). The one-call form of
+    :func:`stack_interleaved_stage_params` for params that are already
+    stacked -- use it right after ``init_*`` so the forgot-to-reorder
+    mistake (silently wrong stage order) cannot happen."""
+    L = jax.tree.leaves(stacked)[0].shape[0]
+    if L % n_devices != 0:
+        raise ValueError(
+            f"{L} stages not divisible by {n_devices} pipeline devices"
+        )
+    v = L // n_devices
+    order = jnp.asarray(
+        [j * n_devices + s for s in range(n_devices) for j in range(v)]
+    )
+    return jax.tree.map(lambda a: a[order], stacked)
 
 
 def _local_stage(stacked: Any) -> Any:
@@ -129,6 +173,93 @@ def _fwd_program(stage_fn: StageFn, axis: str, n_stages: int):
         )
         # Only the last stage holds real outputs; broadcast along the
         # pipe ring so downstream (replicated head/loss) sees them.
+        if S > 1:
+            ys = jax.lax.psum(
+                jnp.where(sid == S - 1, ys, jnp.zeros_like(ys)), axis
+            )
+        return ys
+
+    return program
+
+
+def _fwd_program_interleaved(
+    stage_fn: StageFn, axis: str, n_stages: int, n_chunks: int
+):
+    """Interleaved (virtual-chunk) forward tick loop under shard_map.
+
+    Beyond the reference's two schedules: Megatron's interleaved
+    placement puts v model chunks on each device round-robin (global
+    stage g lives on device g % S), cutting the pipeline ramp/drain
+    from (S-1) to (S-1)/v time units -- on TPU the chunk hand-off
+    g -> g+1 is a ring ppermute INCLUDING the S-1 -> 0 wrap, i.e. a
+    full rotation of the ICI ring, the topology's cheapest collective.
+
+    Schedule: microbatch f = q*S + r runs global stage g at tick
+    t = q*v*S + g + r. Per device one op per tick (the decomposition
+    t-s = q*vS + jS + r is unique), activations advance exactly one
+    ring hop per tick, so a single carried state channel suffices.
+    Total ticks M*v + S - 1 over ops of 1/v the per-device model.
+    Backward comes from autodiff like GPipe (transposed ring).
+
+    Local views: ``stacked`` [v, ...] (this device's chunks in owner
+    order, from stack_interleaved_stage_params), ``xs`` [M, mb, ...].
+    Requires M % S == 0 (whole round-robin groups).
+    """
+    S, V = n_stages, n_chunks
+    # Ring rotation: neighbor hops + the chunk-boundary wrap.
+    ring = [(i, (i + 1) % S) for i in range(S)] if S > 1 else []
+
+    def program(stacked, xs):
+        sid = jax.lax.axis_index(axis)
+        M = xs.shape[0]
+
+        def tick(carry, t):
+            state, ys = carry
+            d = t - sid
+            r = jnp.maximum(d, 0) % S
+            e = jnp.maximum(d - r, 0) // S
+            j = e % V                      # chunk index
+            q = e // V                     # microbatch group
+            f = q * S + r                  # microbatch
+            valid = (d >= 0) & (f < M)
+            fclip = jnp.clip(f, 0, M - 1)
+            # Global stage 0 (device 0, chunk 0) reads fresh input.
+            first = (sid == 0) & (j == 0)
+            inp = jnp.where(
+                first,
+                jax.lax.dynamic_index_in_dim(xs, fclip, 0, keepdims=False),
+                state,
+            )
+            p_j = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, j, 0, keepdims=False
+                ),
+                stacked,
+            )
+            out = stage_fn(p_j, inp)
+            # Invalid ticks must hand a *zero* activation forward, not
+            # garbage: the consumer's validity mask covers ys writes,
+            # but the ring state itself feeds later valid ticks.
+            out = jnp.where(valid, out, jnp.zeros_like(out))
+            # Last global stage (device S-1, chunk V-1) emits ys[f].
+            done = valid & (sid == S - 1) & (j == V - 1)
+            cur = jax.lax.dynamic_index_in_dim(
+                ys, fclip, 0, keepdims=False
+            )
+            ys = jax.lax.dynamic_update_index_in_dim(
+                ys, jnp.where(done, out, cur), fclip, 0
+            )
+            if S > 1:
+                state = jax.lax.ppermute(out, axis, ring)
+            else:
+                state = out
+            return (state, ys), None
+
+        state0 = jnp.zeros_like(xs[0])
+        ys0 = jnp.zeros_like(xs)
+        (_, ys), _ = jax.lax.scan(
+            tick, (state0, ys0), jnp.arange(M * V + S - 1)
+        )
         if S > 1:
             ys = jax.lax.psum(
                 jnp.where(sid == S - 1, ys, jnp.zeros_like(ys)), axis
@@ -262,6 +393,7 @@ def pipelined(
     axis: str = PIPE_AXIS,
     schedule: str = "gpipe",
     batch_spec: P = P(),
+    n_chunks: int = 1,
 ):
     """Build ``fn(stacked_params, xs) -> ys``: the pipelined, jit-able,
     differentiable forward over ``mesh`` axis ``axis``.
@@ -269,12 +401,46 @@ def pipelined(
     ``stacked_params``: per-stage params stacked on dim 0 (shard it
     P(axis) -- see :func:`stage_pspecs`). ``xs``: [M, mb, ...]
     microbatched activations. ``schedule``: "gpipe" (autodiff backward,
-    O(M) live activations) or "1f1b" (custom_vjp interleaved backward,
-    O(S) live activations + forward remat). The returned function is
-    *not* jitted -- trace it into your training step so XLA schedules
-    the surrounding embed/head/optimizer with it.
+    O(M) live activations), "1f1b" (custom_vjp interleaved backward,
+    O(S) live activations + forward remat), or "interleaved" (v
+    virtual chunks per device, ``n_chunks``; stack params with
+    :func:`stack_interleaved_stage_params`; autodiff backward; bubble
+    time / ``n_chunks``). The returned function is *not* jitted --
+    trace it into your training step so XLA schedules the surrounding
+    embed/head/optimizer with it.
     """
     S = mesh.shape[axis]
+    if schedule == "interleaved":
+        inner = _fwd_program_interleaved(stage_fn, axis, S, n_chunks)
+
+        def checked(stacked, xs):
+            if xs.shape[0] % S:
+                raise ValueError(
+                    f"interleaved schedule needs microbatches "
+                    f"({xs.shape[0]}) divisible by pipeline devices "
+                    f"({S}) -- whole round-robin groups"
+                )
+            # Local chunk dim must equal n_chunks: a mismatch (wrong
+            # n_chunks, or sequentially stacked params that skipped
+            # interleave_stacked) would silently index-clamp into the
+            # wrong stages.
+            local = jax.tree.leaves(stacked)[0].shape[0]
+            if local != n_chunks:
+                raise ValueError(
+                    f"stacked stage params have {local} chunks per "
+                    f"device, schedule was built with n_chunks="
+                    f"{n_chunks}; stack with "
+                    f"stack_interleaved_stage_params/interleave_stacked"
+                )
+            return inner(stacked, xs)
+
+        return jax.shard_map(
+            checked,
+            mesh=mesh,
+            in_specs=(P(axis), batch_spec),
+            out_specs=batch_spec,
+            check_vma=False,
+        )
     fwd = jax.shard_map(
         _fwd_program(stage_fn, axis, S),
         mesh=mesh,
@@ -285,7 +451,9 @@ def pipelined(
     if schedule == "gpipe":
         return fwd
     if schedule != "1f1b":
-        raise ValueError(f"unknown schedule {schedule!r} (gpipe|1f1b)")
+        raise ValueError(
+            f"unknown schedule {schedule!r} (gpipe|1f1b|interleaved)"
+        )
 
     reduce_axes = tuple(a for a in _spec_axes(batch_spec) if a != axis)
     bwd = jax.shard_map(
