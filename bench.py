@@ -373,7 +373,47 @@ def bench_unet(steps: int = 20) -> dict:
     }
 
 
-def run_all(out_path: str, steps: int) -> int:
+def probe_backend(timeout_s: int = 180, retries: int = 1):
+    """Bounded check that the accelerator backend comes up before
+    committing to a (long-compiling) workload. A down tunnel otherwise
+    hangs jax initialization for ~30 min per attempt (observed during
+    a mid-round pool outage) -- fail fast with a clear message so the
+    caller records an actionable error instead of a stall.
+
+    Returns ``(device_count, device_kind)`` on success (so callers
+    never need a second, unbounded jax.devices() of their own), else
+    None."""
+    import subprocess
+
+    code = (
+        "import jax; d = jax.devices(); "
+        "print('PROBE_OK', len(d), '|', d[0].device_kind)"
+    )
+    for attempt in range(retries + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            out = proc.stdout.strip()
+            if proc.returncode == 0 and "PROBE_OK" in out:
+                line = [
+                    l for l in out.splitlines() if l.startswith("PROBE_OK")
+                ][-1]
+                head, kind = line.split("|", 1)
+                return int(head.split()[1]), kind.strip()
+            err = proc.stderr.strip().splitlines()
+            msg = err[-1] if err else f"rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            msg = f"no backend after {timeout_s}s"
+        print(
+            f"backend probe {attempt + 1}/{retries + 1} failed: {msg}",
+            file=sys.stderr,
+        )
+    return None
+
+
+def run_all(out_path: str, steps: int, devinfo=None) -> int:
     """Record every workload family into one artifact (markdown table
     + raw JSONL next to it): the recorded-evidence pass VERDICT r1
     asked for -- each parallelism family gets a measured number on
@@ -391,12 +431,16 @@ def run_all(out_path: str, steps: int) -> int:
         ("unet ddp", ["--workload", "unet"]),
     ]
     rows, raw = [], []
+    import os as _os
+
+    child_env = dict(_os.environ, TPU_HPC_BENCH_NO_PROBE="1")
     for name, argv in jobs:
         print(f"--- {name} ---", file=sys.stderr)
         try:
             proc = subprocess.run(
                 [sys.executable, __file__, *argv, "--steps", str(steps)],
                 capture_output=True, text=True, timeout=1800,
+                env=child_env,
             )
             sys.stderr.write(proc.stderr[-500:])
             out, err = proc.stdout.strip(), proc.stderr
@@ -417,14 +461,15 @@ def run_all(out_path: str, steps: int) -> int:
             f"| {name} | {rec['value']} | {rec['unit']} | "
             f"{rec.get('vs_baseline')} |"
         )
-    import jax
-
-    kind = jax.devices()[0].device_kind
+    # Device identity from the parent's bounded probe -- a direct
+    # jax.devices() here would hang unboundedly if the backend died
+    # mid-sweep, losing every already-collected row.
+    n_dev, kind = devinfo if devinfo else ("?", "unknown")
     md = "\n".join([
         "# Recorded benchmark sweep",
         "",
         f"One row per parallelism family (`python bench.py --all`), "
-        f"run on {jax.device_count()}x {kind}. vs_baseline for llama "
+        f"run on {n_dev}x {kind}. vs_baseline for llama "
         "workloads = achieved MFU / the 40% north-star target "
         "(BASELINE.md; the reference publishes no measured numbers).",
         "",
@@ -475,8 +520,22 @@ def main() -> int:
     ap.add_argument("--seq-len", type=int, default=None,
                 help="sequence length (default: 2048 for llama, 8192 for llama-long)")
     args = ap.parse_args()
+    import os as _os
+
+    devinfo = None
+    if _os.environ.get("TPU_HPC_BENCH_NO_PROBE") != "1":
+        # Children of --all skip this: the parent already probed, and
+        # each probe is a full (discarded) backend bring-up.
+        devinfo = probe_backend()
+        if devinfo is None:
+            print(
+                "bench: accelerator backend unavailable (tunnel/pool "
+                "outage?) -- aborting instead of hanging",
+                file=sys.stderr,
+            )
+            return 3
     if args.all:
-        return run_all(args.out, args.steps)
+        return run_all(args.out, args.steps, devinfo=devinfo)
     if args.workload == "llama":
         rec = bench_llama(
             args.steps, args.remat, args.batch or 4, args.attn,
