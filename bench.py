@@ -17,6 +17,7 @@ instrumented DP U-Net throughput (multinode_ddp_unet.py:348-397).
 """
 import argparse
 import json
+import os
 import sys
 
 # Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
@@ -431,9 +432,7 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
         ("unet ddp", ["--workload", "unet"]),
     ]
     rows, raw = [], []
-    import os as _os
-
-    child_env = dict(_os.environ, TPU_HPC_BENCH_NO_PROBE="1")
+    child_env = dict(os.environ, TPU_HPC_BENCH_NO_PROBE="1")
     for name, argv in jobs:
         print(f"--- {name} ---", file=sys.stderr)
         try:
@@ -480,8 +479,6 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
     ])
     with open(out_path, "w") as f:
         f.write(md)
-    import os
-
     with open(os.path.splitext(out_path)[0] + ".jsonl", "w") as f:
         f.write("\n".join(json.dumps(r) for r in raw) + "\n")
     print(md)
@@ -520,10 +517,8 @@ def main() -> int:
     ap.add_argument("--seq-len", type=int, default=None,
                 help="sequence length (default: 2048 for llama, 8192 for llama-long)")
     args = ap.parse_args()
-    import os as _os
-
     devinfo = None
-    if _os.environ.get("TPU_HPC_BENCH_NO_PROBE") != "1":
+    if os.environ.get("TPU_HPC_BENCH_NO_PROBE") != "1":
         # Children of --all skip this: the parent already probed, and
         # each probe is a full (discarded) backend bring-up.
         devinfo = probe_backend()
