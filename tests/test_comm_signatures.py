@@ -24,8 +24,8 @@ MODEL = llama2.LlamaConfig(
     max_seq_len=32,
 )
 
-_OPS = ("all-reduce", "all-gather", "reduce-scatter",
-        "collective-permute", "all-to-all")
+# Single-sourced collective-kind list (also drives the fit report).
+from tpu_hpc.checks.fit import _COLLECTIVES as _OPS  # noqa: E402
 
 
 def _signature(fn, *args) -> dict:

@@ -315,3 +315,44 @@ class TestInterleaved:
 
     def test_bubble_shrinks_with_chunks(self):
         assert pp.bubble_fraction(4, 8, n_chunks=2) < pp.bubble_fraction(4, 8)
+
+
+@pytest.mark.parametrize("schedule,v", [("gpipe", 1), ("interleaved", 2)])
+def test_remat_stage_numerics_unchanged(setup, schedule, v):
+    """remat_stage trades FLOPs for memory; values must be identical
+    (checkpointing recomputes the same forward). The interleaved case
+    runs v=2 so checkpointing is exercised against the dynamic
+    per-chunk param gather, not a degenerate single-chunk layout."""
+    mesh, params, tokens, targets = setup
+    cfg = (
+        CFG if v == 1
+        else ptx.PipeConfig(
+            vocab_size=64, dim=32, n_heads=2, n_stages=4 * v,
+            layers_per_stage=1, max_seq_len=16,
+        )
+    )
+    if v > 1:
+        params = ptx.init_pipeline_transformer(jax.random.key(0), cfg)
+
+    def build(remat):
+        pipe = pp.pipelined(
+            ptx.make_stage_fn(cfg), mesh, axis="pipe",
+            schedule=schedule, n_chunks=v, remat_stage=remat,
+        )
+
+        def loss(params, tokens, targets):
+            xs = ptx.embed(params, pp.microbatch(tokens, 4), cfg)
+            stages = (
+                pp.interleave_stacked(params["stages"], 4)
+                if schedule == "interleaved" else params["stages"]
+            )
+            logits = ptx.head(params, pipe(stages, xs), cfg)
+            return losses.cross_entropy(
+                logits, pp.microbatch(targets, 4)
+            )
+
+        return loss
+
+    g_plain = jax.jit(jax.grad(build(False)))(params, tokens, targets)
+    g_remat = jax.jit(jax.grad(build(True)))(params, tokens, targets)
+    _tree_allclose(g_plain, g_remat, atol=1e-6)

@@ -394,6 +394,7 @@ def pipelined(
     schedule: str = "gpipe",
     batch_spec: P = P(),
     n_chunks: int = 1,
+    remat_stage: bool = False,
 ):
     """Build ``fn(stacked_params, xs) -> ys``: the pipelined, jit-able,
     differentiable forward over ``mesh`` axis ``axis``.
@@ -405,11 +406,17 @@ def pipelined(
     O(S) live activations + forward remat), or "interleaved" (v
     virtual chunks per device, ``n_chunks``; stack params with
     :func:`stack_interleaved_stage_params`; autodiff backward; bubble
-    time / ``n_chunks``). The returned function is *not* jitted --
-    trace it into your training step so XLA schedules the surrounding
-    embed/head/optimizer with it.
+    time / ``n_chunks``). ``remat_stage`` wraps the stage in
+    ``jax.checkpoint`` on the autodiff schedules, so the scan saves
+    only each tick's stage *input* instead of every intermediate --
+    the per-block HBM/FLOPs trade 1F1B already makes, now available
+    without the custom backward. The returned function is *not*
+    jitted -- trace it into your training step so XLA schedules the
+    surrounding embed/head/optimizer with it.
     """
     S = mesh.shape[axis]
+    if remat_stage and schedule in ("gpipe", "interleaved"):
+        stage_fn = jax.checkpoint(stage_fn)
     if schedule == "interleaved":
         inner = _fwd_program_interleaved(stage_fn, axis, S, n_chunks)
 
