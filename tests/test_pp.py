@@ -356,3 +356,12 @@ def test_remat_stage_numerics_unchanged(setup, schedule, v):
     g_plain = jax.jit(jax.grad(build(False)))(params, tokens, targets)
     g_remat = jax.jit(jax.grad(build(True)))(params, tokens, targets)
     _tree_allclose(g_plain, g_remat, atol=1e-6)
+
+
+def test_chunks_require_interleaved(setup):
+    mesh, *_ = setup
+    with pytest.raises(ValueError, match="only applies"):
+        pp.pipelined(
+            ptx.make_stage_fn(CFG), mesh, axis="pipe",
+            schedule="gpipe", n_chunks=2,
+        )

@@ -415,6 +415,13 @@ def pipelined(
     surrounding embed/head/optimizer with it.
     """
     S = mesh.shape[axis]
+    if n_chunks != 1 and schedule != "interleaved":
+        raise ValueError(
+            f"n_chunks={n_chunks} only applies to "
+            f"schedule='interleaved', got {schedule!r} -- a multi-chunk "
+            "param stack under gpipe/1f1b would silently run wrong "
+            "stages"
+        )
     if remat_stage and schedule in ("gpipe", "interleaved"):
         stage_fn = jax.checkpoint(stage_fn)
     if schedule == "interleaved":
