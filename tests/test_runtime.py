@@ -268,3 +268,24 @@ class TestTuning:
             tuning.PROFILES["data-parallel"]["LIBTPU_INIT_ARGS"].split()
         )
         assert overlap < dp_flags  # docs promise a strict superset
+
+    def test_apply_before_backend_init(self):
+        """The positive path needs a fresh process (this test session's
+        backend is already up): apply_tuning sets the env, then jax
+        initializes normally (LIBTPU_INIT_ARGS is inert on CPU; the
+        sim machinery handles platform forcing)."""
+        from tpu_hpc.runtime.sim import run_in_sim_subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = (
+            f"import sys; sys.path.insert(0, {repo!r})\n"
+            "from tpu_hpc.runtime import tuning\n"
+            "import os\n"
+            "tuning.apply_tuning('collective-overlap')\n"
+            "assert os.environ['LIBTPU_INIT_ARGS'].startswith('--xla_tpu')\n"
+            "import jax\n"
+            "print('TUNED_OK', jax.device_count())\n"
+        )
+        proc = run_in_sim_subprocess(["-c", code], 2, timeout=180)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "TUNED_OK 2" in proc.stdout
