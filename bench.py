@@ -451,6 +451,12 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
         line = out.splitlines()[-1] if out else ""
         try:
             rec = json.loads(line)
+            # A child whose last stdout line is valid JSON but not a
+            # bench record (or lacks value/unit) must not abort the
+            # sweep and lose every already-collected row.
+            if not isinstance(rec, dict) or "value" not in rec \
+                    or "unit" not in rec:
+                raise ValueError(f"not a bench record: {line[:120]!r}")
         except (ValueError, IndexError):
             rec = {"metric": name, "value": None, "unit": "FAILED",
                    "vs_baseline": None, "error": err[-300:]}

@@ -19,8 +19,12 @@ gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
         python3 -m venv ~/tpu-hpc-venv 2>/dev/null || true
         source ~/tpu-hpc-venv/bin/activate
         pip -q install -U pip
-        pip -q install 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
-        pip -q install -e ~/tpu_hpc_repo
+        # constraints.txt pins the exact stack the recorded benchmarks
+        # were measured on (BENCH_*/REPORT_* reproducibility) -- a pod
+        # launched months later must not silently resolve newer wheels.
+        pip -q install -c ~/tpu_hpc_repo/constraints.txt 'jax[tpu]' \
+            -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+        pip -q install -c ~/tpu_hpc_repo/constraints.txt -e ~/tpu_hpc_repo
         python -c 'import tpu_hpc, jax; print(jax.devices())'
     "
 echo ">> done; use ./tpu_vm_run.sh to launch training"

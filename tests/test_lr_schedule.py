@@ -72,3 +72,22 @@ def test_trains_with_cosine(devices):
         if getattr(l, "dtype", None) == jnp.int32 and l.ndim == 0
     ]
     assert any(int(jax.device_get(c)) == 4 for c in counts)
+
+
+def test_fit_epochs_override_conflicts_with_cosine(devices):
+    """A fit(epochs=) override under cosine would silently clamp (longer
+    run) or truncate decay (shorter) -- must raise, not drift."""
+    model = llama2.LlamaConfig(
+        dim=32, n_layers=1, n_heads=4, vocab_size=64, multiple_of=16,
+        max_seq_len=16,
+    )
+    cfg = TrainingConfig(
+        global_batch_size=8, steps_per_epoch=2, epochs=1,
+        learning_rate=1e-2, lr_schedule="cosine", warmup_steps=1,
+    )
+    mesh = build_mesh(MeshSpec(axes={"data": 8}))
+    params = llama2.init_llama(jax.random.key(0), model)
+    t = Trainer(cfg, mesh, llama2.make_forward(model), params)
+    ds = datasets.TokenStream(vocab_size=64, seq_len=16)
+    with pytest.raises(ValueError, match="cosine"):
+        t.fit(ds, epochs=3)

@@ -365,3 +365,14 @@ def test_chunks_require_interleaved(setup):
             ptx.make_stage_fn(CFG), mesh, axis="pipe",
             schedule="gpipe", n_chunks=2,
         )
+
+
+def test_remat_stage_rejected_under_1f1b(setup):
+    """1f1b's custom_vjp already remats each stage forward; a silently
+    ignored remat_stage flag would mislead memory tuning."""
+    mesh, *_ = setup
+    with pytest.raises(ValueError, match="remat_stage"):
+        pp.pipelined(
+            ptx.make_stage_fn(CFG), mesh, axis="pipe",
+            schedule="1f1b", remat_stage=True,
+        )

@@ -235,9 +235,15 @@ class TestTuning:
             base={"LIBTPU_INIT_ARGS": "--xla_enable_async_all_gather=false"},
         )
         merged = env["LIBTPU_INIT_ARGS"]
-        # Preset present, user's value after it (XLA last-wins).
-        assert "--xla_enable_async_all_gather=true" in merged
-        assert merged.endswith("--xla_enable_async_all_gather=false")
+        # The user's setting wins by *dedup*, not parser order: the
+        # preset's conflicting flag is dropped entirely so correctness
+        # does not depend on libtpu's duplicate-flag handling.
+        assert "--xla_enable_async_all_gather=true" not in merged
+        assert "--xla_enable_async_all_gather=false" in merged
+        names = [t.split("=", 1)[0] for t in merged.split()]
+        assert len(names) == len(set(names)), "duplicate flag survived"
+        # Non-conflicting preset flags still present.
+        assert "--xla_tpu_enable_latency_hiding_scheduler=true" in merged
 
     def test_unknown_profile_rejected(self):
         from tpu_hpc.runtime import tuning

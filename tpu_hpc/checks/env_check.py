@@ -64,6 +64,60 @@ def _library_versions() -> Dict[str, str]:
     return out
 
 
+def _pinned_versions() -> Dict[str, str]:
+    """Parse the repo's ``constraints.txt`` (the known-good pins every
+    recorded benchmark was measured with -- the reference's
+    environment.yml:1-13 discipline). Empty dict if the file is not
+    found (installed-package deployments)."""
+    import pathlib
+
+    here = pathlib.Path(__file__).resolve()
+    # Bounded walk (checks/ -> tpu_hpc/ -> repo root), and only a dir
+    # that also holds pyproject.toml counts as the repo: an installed
+    # site-packages deployment must not pick up an unrelated
+    # constraints.txt further up the tree and report bogus drift.
+    for parent in here.parents[:3]:
+        cpath = parent / "constraints.txt"
+        if cpath.is_file() and (parent / "pyproject.toml").is_file():
+            pins = {}
+            for line in cpath.read_text().splitlines():
+                line = line.strip()
+                if line and not line.startswith("#") and "==" in line:
+                    name, _, ver = line.partition("==")
+                    pins[name.strip()] = ver.strip()
+            return pins
+    return {}
+
+
+def check_version_pins() -> Tuple[bool, str]:
+    """Warn-only drift check of installed packages vs constraints.txt.
+
+    A pod launched months later resolves different wheels than the
+    ones the recorded BENCH_*/REPORT_* artifacts were measured on;
+    this surfaces the drift at preflight instead of in a confusing
+    perf regression. Always "passes" -- drift is a warning, since
+    newer stacks are usually fine -- but the detail names every
+    mismatch."""
+    import importlib.metadata as md
+
+    pins = _pinned_versions()
+    if not pins:
+        return True, "no constraints.txt found (skipped)"
+    drift = []
+    for name, want in pins.items():
+        try:
+            have = md.version(name)
+        except md.PackageNotFoundError:
+            drift.append(f"{name}: pinned {want}, not installed")
+            continue
+        if have != want:
+            drift.append(f"{name}: pinned {want}, installed {have}")
+    if drift:
+        return True, ("DRIFT from constraints.txt (warn only): "
+                      + "; ".join(drift))
+    return True, f"all {len(pins)} pins match constraints.txt"
+
+
 def _smoke_all_reduce() -> Tuple[bool, str]:
     """All-device psum smoke test with exact expected value.
 
@@ -178,6 +232,8 @@ def check_environment(verbose: bool = True) -> Dict:
     checks.append(
         ("devices_visible", n_local > 0, f"{n_local} local device(s)")
     )
+    ok, msg = check_version_pins()
+    checks.append(("version_pins", ok, msg))
     ok, msg = _smoke_all_reduce()
     checks.append(("all_reduce_smoke", ok, msg))
 

@@ -13,10 +13,11 @@ Two interchangeable implementations:
     XLA already fuses this well on TPU for moderate sequence lengths.
   * ``flash_attention`` -- a Pallas TPU kernel: online softmax over KV
     blocks, fp32 accumulators in VMEM scratch, bf16 matmuls on the MXU,
-    causal blocks above the diagonal skipped. Forward-only; gradients
-    come from a custom_vjp whose backward rematerialises through the
-    reference path (a hand-written backward kernel is a later
-    optimisation).
+    causal blocks above the diagonal skipped. Gradients come from a
+    custom_vjp whose backward runs the hand-written Pallas dq and
+    dk/dv kernels below (``_flash_dq_kernel`` / ``_flash_dkv_kernel``),
+    rematerialising p = softmax(qk) from the saved LSE instead of
+    storing the attention matrix.
 
 Layout convention: [B, S, H, D] (model order, models/llama2.py);
 LSE is [B, S, H] fp32. Masking uses a large finite negative instead of

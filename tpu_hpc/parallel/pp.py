@@ -424,6 +424,12 @@ def pipelined(
         )
     if remat_stage and schedule in ("gpipe", "interleaved"):
         stage_fn = jax.checkpoint(stage_fn)
+    elif remat_stage and schedule == "1f1b":
+        raise ValueError(
+            f"remat_stage has no effect under schedule={schedule!r}: "
+            "the 1f1b custom_vjp already rematerialises each stage's "
+            "forward in its backward pass -- drop the flag"
+        )
     if schedule == "interleaved":
         inner = _fwd_program_interleaved(stage_fn, axis, S, n_chunks)
 

@@ -44,8 +44,9 @@ _OVERLAP = (
     "--xla_tpu_overlap_compute_collective_tc=true"
 )
 
-# Each profile: env-var -> flags to APPEND (existing user flags win by
-# coming later in the string for XLA's last-wins parsing).
+# Each profile: env-var -> flags to merge. A preset flag already set
+# by the user is dropped entirely (see tuning_env), so the user's
+# value wins no matter how libtpu orders duplicate-flag parsing.
 PROFILES: Dict[str, Dict[str, str]] = {
     # No-op: measure first, tune second.
     "default": {},
@@ -62,14 +63,21 @@ PROFILES: Dict[str, Dict[str, str]] = {
 }
 
 
+def _flag_name(token: str) -> str:
+    """``--xla_foo=true`` -> ``--xla_foo`` (bare flags name themselves)."""
+    return token.split("=", 1)[0]
+
+
 def tuning_env(
     profile: str = "collective-overlap",
     base: Optional[Dict[str, str]] = None,
 ) -> Dict[str, str]:
     """The env additions for ``profile``, merged over ``base``
-    (defaults to ``os.environ``). Existing values are preserved and
-    the preset flags appended -- user-set flags stay in effect (XLA
-    parses duplicates last-wins, and the user's come last)."""
+    (defaults to ``os.environ``). User-set flags win by construction:
+    any preset flag whose name already appears in the existing value is
+    dropped before merging, so the result never contains a duplicate
+    flag and correctness does not depend on libtpu parsing duplicates
+    in any particular order."""
     if profile not in PROFILES:
         raise ValueError(
             f"unknown tuning profile {profile!r}; "
@@ -79,8 +87,13 @@ def tuning_env(
     out: Dict[str, str] = {}
     for var, flags in PROFILES[profile].items():
         existing = src.get(var, "").strip()
-        # Preset first, user's existing flags after (last-wins).
-        out[var] = f"{flags} {existing}".strip() if existing else flags
+        if not existing:
+            out[var] = flags
+            continue
+        user_names = {_flag_name(t) for t in existing.split()}
+        kept = [t for t in flags.split()
+                if _flag_name(t) not in user_names]
+        out[var] = " ".join(kept + [existing]) if kept else existing
     return out
 
 

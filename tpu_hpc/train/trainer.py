@@ -563,6 +563,15 @@ class Trainer:
         """
         cfg = self.cfg
         epochs = epochs or cfg.epochs
+        if epochs != cfg.epochs and cfg.lr_schedule == "cosine":
+            # The cosine schedule was sized from cfg.epochs at optimizer
+            # construction; a longer override would silently flatline at
+            # the end value and a shorter one never completes decay.
+            raise ValueError(
+                f"fit(epochs={epochs}) conflicts with lr_schedule="
+                f"'cosine' sized for cfg.epochs={cfg.epochs}: set "
+                "cfg.epochs to the intended run length instead"
+            )
         start_step = self.maybe_resume()
         # Preemption safety: TPU-VM spot/maintenance events deliver
         # SIGTERM with a short grace window. Snapshot-then-exit is the
