@@ -1,0 +1,384 @@
+"""Generate the guide's teaching diagrams as deterministic SVGs.
+
+The reference guide teaches with ~19 images (pipeline timelines,
+TP column/row figures, halo arrays -- /root/reference/docs/images/);
+this script is the TPU edition: every figure is generated from the
+*actual* schedule formulas and layouts the code runs (pp.py tick
+programs, ring_attention zigzag indices, fsdp mode pspecs), so the
+diagrams cannot drift from the implementation the way hand-drawn
+images do. Run ``python docs/gen_diagrams.py`` to (re)build
+``docs/guide/images/*.svg``; CI builds the site with --strict so a
+missing image fails the build.
+"""
+from __future__ import annotations
+
+import pathlib
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+from matplotlib.patches import FancyArrow, Rectangle
+
+OUT = pathlib.Path(__file__).parent / "guide" / "images"
+
+# Okabe-Ito colorblind-safe palette; microbatches cycle through it.
+MB_COLORS = [
+    "#0072B2", "#E69F00", "#009E73", "#CC79A7",
+    "#56B4E9", "#D55E00", "#F0E442", "#999999",
+]
+FWD_ALPHA, BWD_ALPHA = 1.0, 0.45
+EDGE = "#333333"
+
+plt.rcParams.update({
+    "font.family": "DejaVu Sans",
+    "font.size": 9,
+    "svg.hashsalt": "tpu_hpc",   # deterministic ids
+})
+
+
+def _save(fig, name):
+    OUT.mkdir(parents=True, exist_ok=True)
+    fig.savefig(OUT / name, format="svg", bbox_inches="tight",
+                metadata={"Date": None})
+    plt.close(fig)
+    print(f"wrote {OUT / name}")
+
+
+def _cell(ax, t, row, mb, kind, label, h=0.8):
+    color = MB_COLORS[mb % len(MB_COLORS)]
+    alpha = FWD_ALPHA if kind == "F" else BWD_ALPHA
+    ax.add_patch(Rectangle(
+        (t, row - h / 2), 1, h, facecolor=color, alpha=alpha,
+        edgecolor=EDGE, linewidth=0.5,
+    ))
+    ax.text(t + 0.5, row, label, ha="center", va="center",
+            fontsize=6.5, color="white" if kind == "F" else "#222")
+
+
+def _schedule_axes(ax, n_rows, n_ticks, row_labels, title):
+    ax.set_xlim(0, n_ticks)
+    ax.set_ylim(n_rows - 0.5, -0.5)
+    ax.set_yticks(range(n_rows))
+    ax.set_yticklabels(row_labels)
+    ax.set_xlabel("tick")
+    ax.set_title(title, fontsize=10, loc="left")
+    ax.tick_params(length=0)
+    for spine in ax.spines.values():
+        spine.set_visible(False)
+
+
+def pipeline_schedules(S=4, M=8, V=2):
+    """GPipe vs 1F1B vs interleaved-1F1B, from the pp.py tick formulas.
+
+    Each device row is split: top half = forward ops, bottom half =
+    backward ops (the scan body runs one of each per tick)."""
+    fig, axes = plt.subplots(3, 1, figsize=(11, 7.6),
+                             gridspec_kw={"hspace": 0.55})
+
+    # -- GPipe: F_f at t=f+s; all backwards after the drain, reverse
+    # order (autodiff transposes the forward ticks).
+    ax = axes[0]
+    Tf = M + S - 1
+    for s in range(S):
+        for f in range(M):
+            _cell(ax, f + s, s, f, "F", f"F{f}", h=0.38)
+        for b in range(M - 1, -1, -1):
+            t = Tf + (M - 1 - b) + (S - 1 - s)
+            _cell(ax, t, s + 0.41, b, "B", f"B{b}", h=0.38)
+    _schedule_axes(
+        ax, S, 2 * (M + S - 1),
+        [f"dev {s}" for s in range(S)],
+        f"GPipe  (S={S}, M={M}): all forwards, then all backwards -- "
+        f"O(M) live activations, bubble 2(S-1) ticks",
+    )
+
+    # -- 1F1B: F_f at t=f+s, B_b at t=2S-1-s+b (pp.py:291-296).
+    ax = axes[1]
+    for s in range(S):
+        for f in range(M):
+            _cell(ax, f + s, s - 0.205, f, "F", f"F{f}", h=0.38)
+        for b in range(M):
+            _cell(ax, 2 * S - 1 - s + b, s + 0.205, b, "B", f"B{b}",
+                  h=0.38)
+    _schedule_axes(
+        ax, S, M + 2 * S - 1,
+        [f"dev {s}" for s in range(S)],
+        f"1F1B  (S={S}, M={M}): B_b follows S-s ticks behind F -- "
+        f"O(S) live inputs, same bubble as GPipe, steady-state 1F+1B "
+        f"per tick",
+    )
+
+    # -- Interleaved 1F1B: F of (g=jS+s, f=qS+r) at t=qVS+g+r;
+    # B at VS+qVS+(V-1-j)S+(S-1-s)+r (pp.py interleaved-1f1b).
+    ax = axes[2]
+    G = S * V
+    for s in range(S):
+        for j in range(V):
+            g = j * S + s
+            for f in range(M):
+                q, r = f // S, f % S
+                t = q * V * S + g + r
+                _cell(ax, t, s - 0.205, f, "F", f"F{f}", h=0.38)
+                u = V * S + q * V * S + (V - 1 - j) * S + (S - 1 - s) + r
+                _cell(ax, u, s + 0.205, f, "B", f"B{f}", h=0.38)
+    for s in range(S):
+        ax.text(-1.6, s - 0.205, "c0|c1", fontsize=5.5, ha="right",
+                va="center", color="#666")
+    _schedule_axes(
+        ax, S, M * V + V * S + S - 1,
+        [f"dev {s}" for s in range(S)],
+        f"Interleaved 1F1B  (S={S}, v={V}, M={M}): each device runs "
+        f"v={V} model chunks round-robin -- ramp/drain shrinks to "
+        f"(S-1)/v, live inputs O(S*v), not O(M)",
+    )
+    _save(fig, "pipeline_schedules.svg")
+
+
+def mesh_torus(nx=4, ny=4):
+    """2D device mesh with ICI torus links and sharding-axis arrows."""
+    fig, ax = plt.subplots(figsize=(6.4, 5.6))
+    for x in range(nx):
+        for y in range(ny):
+            ax.add_patch(Rectangle(
+                (x - 0.28, y - 0.28), 0.56, 0.56, facecolor="#0072B2",
+                alpha=0.85, edgecolor=EDGE, zorder=3,
+            ))
+            ax.text(x, y, f"{x},{y}", ha="center", va="center",
+                    color="white", fontsize=8, zorder=4)
+    for x in range(nx):
+        for y in range(ny):
+            if x + 1 < nx:
+                ax.plot([x + 0.28, x + 0.72], [y, y], color="#999",
+                        lw=1.6, zorder=1)
+            if y + 1 < ny:
+                ax.plot([x, x], [y + 0.28, y + 0.72], color="#999",
+                        lw=1.6, zorder=1)
+    # Torus wraparound links (dashed arcs).
+    for y in range(ny):
+        ax.plot([-0.28, -0.75], [y, y], color="#bbb", lw=1.2, ls="--")
+        ax.plot([nx - 1 + 0.28, nx - 1 + 0.75], [y, y], color="#bbb",
+                lw=1.2, ls="--")
+    for x in range(nx):
+        ax.plot([x, x], [-0.28, -0.75], color="#bbb", lw=1.2, ls="--")
+        ax.plot([x, x], [ny - 1 + 0.28, ny - 1 + 0.75], color="#bbb",
+                lw=1.2, ls="--")
+    ax.add_patch(FancyArrow(-1.2, -0.1, 0, ny - 0.9, width=0.02,
+                            head_width=0.12, color="#D55E00"))
+    ax.text(-1.45, (ny - 1) / 2, 'mesh axis "data" (FSDP/DP shards)',
+            rotation=90, va="center", fontsize=9, color="#D55E00")
+    ax.add_patch(FancyArrow(-0.1, -1.2, nx - 0.9, 0, width=0.02,
+                            head_width=0.12, color="#009E73"))
+    ax.text((nx - 1) / 2, -1.45, 'mesh axis "model" (TP shards)',
+            ha="center", fontsize=9, color="#009E73")
+    ax.text((nx - 1) / 2, ny - 0.1 + 0.9,
+            "dashed = ICI wraparound (torus): every axis is a ring",
+            ha="center", fontsize=8.5, color="#777")
+    ax.set_xlim(-1.9, nx + 0.6)
+    ax.set_ylim(-1.9, ny + 0.8)
+    ax.set_aspect("equal")
+    ax.axis("off")
+    ax.set_title(
+        f'Mesh(devices.reshape({ny},{nx}), ("data","model")) on the '
+        "ICI torus", fontsize=10, loc="left",
+    )
+    _save(fig, "mesh_torus.svg")
+
+
+def zigzag_ring(S=4, C=8):
+    """Contiguous vs zigzag sequence sharding for ring attention:
+    per-device causal-work bars from the actual chunk indices."""
+    fig, axes = plt.subplots(2, 1, figsize=(8.6, 4.6),
+                             gridspec_kw={"hspace": 0.9})
+    n = C  # chunks (2 per device for zigzag)
+    assign_contig = {d: [2 * d, 2 * d + 1] for d in range(S)}
+    assign_zig = {d: [d, 2 * S - 1 - d] for d in range(S)}
+    for ax, assign, name in (
+        (axes[0], assign_contig, "contiguous"),
+        (axes[1], assign_zig, "zigzag"),
+    ):
+        for d, chunks in assign.items():
+            for c in chunks:
+                ax.add_patch(Rectangle(
+                    (c, 0), 1, 0.8, facecolor=MB_COLORS[d],
+                    edgecolor=EDGE, lw=0.6,
+                ))
+                ax.text(c + 0.5, 0.4, f"d{d}", ha="center",
+                        va="center", color="white", fontsize=8)
+        # causal work per device = sum over owned chunks c of (c+1)
+        # kv-chunks attended (lower-triangular block count).
+        work = {d: sum(c + 1 for c in cs) for d, cs in assign.items()}
+        wmax = max(work.values())
+        for d in range(S):
+            ax.add_patch(Rectangle(
+                (n + 0.7 + d * 1.1, 0), 0.9, 0.8 * work[d] / wmax,
+                facecolor=MB_COLORS[d], edgecolor=EDGE, lw=0.6,
+            ))
+            ax.text(n + 0.7 + d * 1.1 + 0.45, -0.26, f"d{d}",
+                    ha="center", fontsize=7)
+        spread = max(work.values()) / min(work.values())
+        ax.text(n + 0.7 + S * 1.1 + 0.3, 0.4,
+                f"max/min\n= {spread:.2f}x", fontsize=8, va="center")
+        ax.set_xlim(-0.2, n + S * 1.1 + 2.6)
+        ax.set_ylim(-0.5, 1.05)
+        ax.axis("off")
+        ax.set_title(
+            f"{name}: sequence chunks 0..{n - 1} -> devices  |  "
+            "causal work per device", fontsize=9.5, loc="left",
+        )
+    fig.suptitle(
+        "Zigzag ring attention: pairing chunk d with chunk 2S-1-d "
+        "equalises causal work (ring_attention.py zigzag_indices)",
+        fontsize=10, x=0.01, ha="left",
+    )
+    _save(fig, "zigzag_ring.svg")
+
+
+def halo_exchange(S=4, W=6):
+    """1D domain decomposition with ghost cells and the two ppermute
+    hops that fill them (domain.py halo_exchange)."""
+    fig, ax = plt.subplots(figsize=(9.2, 2.9))
+    gap = 1.1
+    for d in range(S):
+        x0 = d * (W + gap)
+        for i in range(W):
+            ax.add_patch(Rectangle(
+                (x0 + i, 0), 1, 1, facecolor=MB_COLORS[d], alpha=0.85,
+                edgecolor=EDGE, lw=0.6,
+            ))
+        # ghost cells
+        for gx, src in ((x0 - 0.95, d - 1), (x0 + W - 0.05, d + 1)):
+            if 0 <= src < S:
+                ax.add_patch(Rectangle(
+                    (gx, 0), 0.92, 1, facecolor=MB_COLORS[src],
+                    alpha=0.3, edgecolor=EDGE, lw=0.6, ls="--",
+                ))
+        ax.text(x0 + W / 2, -0.42, f"device {d}", ha="center",
+                fontsize=9)
+    for d in range(S - 1):
+        x_r = d * (W + gap) + W - 1 + 0.5       # my last interior cell
+        x_gr = (d + 1) * (W + gap) - 0.5        # right nbr's left ghost
+        ax.annotate(
+            "", xy=(x_gr, 1.35), xytext=(x_r, 1.15),
+            arrowprops=dict(arrowstyle="->", color="#D55E00", lw=1.4,
+                            connectionstyle="arc3,rad=-0.3"),
+        )
+        x_l = (d + 1) * (W + gap) + 0.5
+        x_gl = d * (W + gap) + W + 0.4
+        ax.annotate(
+            "", xy=(x_gl, -0.75), xytext=(x_l, -0.62),
+            arrowprops=dict(arrowstyle="->", color="#0072B2", lw=1.4,
+                            connectionstyle="arc3,rad=-0.3"),
+        )
+    ax.text(0, 1.9, "ppermute(+1): send right edge -> right "
+            "neighbor's left ghost", color="#D55E00", fontsize=9)
+    ax.text(0, -1.35, "ppermute(-1): send left edge -> left "
+            "neighbor's right ghost", color="#0072B2", fontsize=9)
+    ax.set_xlim(-1.4, S * (W + gap))
+    ax.set_ylim(-1.7, 2.3)
+    ax.axis("off")
+    ax.set_title(
+        "Halo exchange: solid = owned cells, dashed = ghost cells "
+        "(width = stencil radius)", fontsize=10, loc="left",
+    )
+    _save(fig, "halo_exchange.svg")
+
+
+def fsdp_modes():
+    """The four FSDP sharding modes as a state matrix
+    (fsdp.py param_pspecs / grad_op_pspecs / hybrid_shard_pspecs)."""
+    modes = [
+        ("FULL_SHARD", ["sharded", "sharded", "sharded"],
+         "gather params per layer fwd+bwd; reduce-scatter grads"),
+        ("SHARD_GRAD_OP", ["replicated", "sharded", "sharded"],
+         "params stay whole; only grads + optimizer state shard"),
+        ("NO_SHARD (= DP)", ["replicated", "replicated", "replicated"],
+         "plain data parallel; all-reduce grads"),
+        ("HYBRID_SHARD", ["sharded in node", "sharded in node",
+                          "sharded in node"],
+         "FULL_SHARD inside an ICI slice, DP all-reduce across DCN"),
+    ]
+    cols = ["params", "grads", "opt state"]
+    color = {
+        "sharded": "#009E73", "replicated": "#D55E00",
+        "sharded in node": "#56B4E9",
+    }
+    fig, ax = plt.subplots(figsize=(8.6, 3.4))
+    for r, (name, cells, note) in enumerate(modes):
+        ax.text(-0.15, r, name, ha="right", va="center", fontsize=9,
+                weight="bold")
+        for c, state in enumerate(cells):
+            ax.add_patch(Rectangle(
+                (c * 1.9, r - 0.33), 1.75, 0.66,
+                facecolor=color[state], alpha=0.8, edgecolor=EDGE,
+                lw=0.6,
+            ))
+            ax.text(c * 1.9 + 0.875, r, state, ha="center",
+                    va="center", color="white", fontsize=8.5)
+        ax.text(3 * 1.9 + 0.25, r, note, va="center", fontsize=8,
+                color="#444")
+    for c, col in enumerate(cols):
+        ax.text(c * 1.9 + 0.875, -0.75, col, ha="center", fontsize=9,
+                weight="bold")
+    ax.set_xlim(-2.6, 12.4)
+    ax.set_ylim(3.6, -1.1)
+    ax.axis("off")
+    ax.set_title("FSDP sharding modes (per-chip view of each tensor "
+                 "group)", fontsize=10, loc="left")
+    _save(fig, "fsdp_modes.svg")
+
+
+def tp_col_row(T=2):
+    """Megatron column->row parallel MLP: which matmul shards how,
+    and where the one psum lands (tp.py llama/mlp rules)."""
+    fig, ax = plt.subplots(figsize=(9.6, 3.2))
+
+    def block(x, y, w, h, color, label, alpha=0.85, fs=8.5):
+        ax.add_patch(Rectangle((x, y), w, h, facecolor=color,
+                               alpha=alpha, edgecolor=EDGE, lw=0.7))
+        ax.text(x + w / 2, y + h / 2, label, ha="center", va="center",
+                fontsize=fs, color="white")
+
+    # X (replicated)
+    block(0, 0.4, 1.2, 1.2, "#999999", "X\n[B,D]")
+    ax.text(1.55, 1.0, "@", fontsize=13, va="center")
+    # A column-split
+    for t in range(T):
+        block(1.9 + t * 0.75, 0.4, 0.7, 1.2, MB_COLORS[t],
+              f"A{t}\n[D,F/{T}]")
+    ax.text(1.9 + T * 0.75 + 0.15, 1.0, "=", fontsize=13, va="center")
+    for t in range(T):
+        block(3.8 + t * 0.75, 0.4, 0.7, 1.2, MB_COLORS[t],
+              f"Y{t}")
+    ax.text(4.6, 2.0, "column-parallel: activations stay sharded,\n"
+            "gelu applies per shard, NO communication",
+            fontsize=8.5, ha="center")
+    ax.text(5.65, 1.0, "@", fontsize=13, va="center")
+    # B row-split
+    for t in range(T):
+        block(5.95 + t * 0.75, 0.4, 0.7, 1.2, MB_COLORS[t],
+              f"B{t}\n[F/{T},D]")
+    ax.text(7.6, 1.0, "->", fontsize=13, va="center")
+    block(8.1, 0.4, 1.3, 1.2, "#CC79A7", "psum\nover 'model'")
+    ax.text(9.75, 1.0, "=", fontsize=13, va="center")
+    block(10.05, 0.4, 1.2, 1.2, "#999999", "Z\n[B,D]")
+    ax.text(8.75, 2.0, "row-parallel: partial products\nmeet in ONE "
+            "all-reduce", fontsize=8.5, ha="center")
+    ax.set_xlim(-0.3, 11.6)
+    ax.set_ylim(-0.3, 2.8)
+    ax.axis("off")
+    ax.set_title(
+        f"Tensor-parallel MLP across {T} chips: shard A by columns, "
+        "B by rows -- one psum per block, riding the ICI ring",
+        fontsize=10, loc="left",
+    )
+    _save(fig, "tp_col_row.svg")
+
+
+if __name__ == "__main__":
+    pipeline_schedules()
+    mesh_torus()
+    zigzag_ring()
+    halo_exchange()
+    fsdp_modes()
+    tp_col_row()

@@ -43,7 +43,8 @@ def main(argv=None) -> int:
     cfg = TrainingConfig.from_args(argv)
     extra = argparse.ArgumentParser(add_help=False)
     extra.add_argument(
-        "--schedule", choices=["gpipe", "1f1b", "interleaved"],
+        "--schedule",
+        choices=["gpipe", "1f1b", "interleaved", "interleaved-1f1b"],
         default="gpipe",
     )
     extra.add_argument("--num-microbatches", type=int, default=8)
@@ -70,7 +71,8 @@ def main(argv=None) -> int:
     # of a multi-chunk model otherwise).
     v = (
         args.num_chunks
-        if args.schedule == "interleaved" and n_stages > 1
+        if args.schedule in ("interleaved", "interleaved-1f1b")
+        and n_stages > 1
         else 1
     )
     logger.info(

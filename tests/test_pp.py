@@ -166,11 +166,11 @@ class TestInterleaved:
         layers_per_stage=1, max_seq_len=16,
     )
 
-    def _loss_fn(self, mesh, n_micro=4, v=2):
+    def _loss_fn(self, mesh, n_micro=4, v=2, schedule="interleaved"):
         cfg = self.CFG8
         pipe = pp.pipelined(
             ptx.make_stage_fn(cfg), mesh, axis="pipe",
-            schedule="interleaved", n_chunks=v,
+            schedule=schedule, n_chunks=v,
         )
 
         def loss(params, tokens, targets):
@@ -208,17 +208,54 @@ class TestInterleaved:
         logits = ptx.apply_sequential(params, tokens, self.CFG8)
         return losses.cross_entropy(logits, targets)
 
-    def test_forward_matches_oracle(self, setup8):
+    @pytest.mark.parametrize(
+        "schedule", ["interleaved", "interleaved-1f1b"]
+    )
+    def test_forward_matches_oracle(self, setup8, schedule):
         mesh, params, tokens, targets = setup8
-        loss = jax.jit(self._loss_fn(mesh))(params, tokens, targets)
+        loss = jax.jit(self._loss_fn(mesh, schedule=schedule))(
+            params, tokens, targets
+        )
         oracle = self._oracle(params, tokens, targets)
         np.testing.assert_allclose(float(loss), float(oracle), atol=1e-5)
 
-    def test_grads_match_oracle(self, setup8):
+    @pytest.mark.parametrize(
+        "schedule", ["interleaved", "interleaved-1f1b"]
+    )
+    def test_grads_match_oracle(self, setup8, schedule):
         mesh, params, tokens, targets = setup8
-        g = jax.jit(jax.grad(self._loss_fn(mesh)))(params, tokens, targets)
+        g = jax.jit(jax.grad(self._loss_fn(mesh, schedule=schedule)))(
+            params, tokens, targets
+        )
         g_ref = jax.jit(jax.grad(self._oracle))(params, tokens, targets)
         _tree_allclose(g, g_ref, atol=2e-4)
+
+    def test_interleaved_1f1b_reduces_to_1f1b_at_v1(self, setup):
+        """v=1: the dilated tick formulas collapse to plain 1F1B's
+        exactly, so loss AND grads must match the 1f1b schedule."""
+        mesh, params, tokens, targets = setup
+
+        pipe = pp.pipelined(
+            ptx.make_stage_fn(CFG), mesh, axis="pipe",
+            schedule="interleaved-1f1b", n_chunks=1,
+        )
+
+        def loss(params, tokens, targets):
+            xs = ptx.embed(params, pp.microbatch(tokens, 4), CFG)
+            per = [
+                jax.tree.map(lambda a: a[g], params["stages"])
+                for g in range(4)
+            ]
+            ys = pipe(pp.stack_interleaved_stage_params(per, 4), xs)
+            logits = ptx.head(params, ys, CFG)
+            return losses.cross_entropy(logits, pp.microbatch(targets, 4))
+
+        got = jax.jit(jax.value_and_grad(loss))(params, tokens, targets)
+        want = jax.jit(
+            jax.value_and_grad(_pipe_loss_fn(mesh, "1f1b"))
+        )(params, tokens, targets)
+        np.testing.assert_allclose(float(got[0]), float(want[0]), atol=1e-6)
+        _tree_allclose(got[1], want[1], atol=1e-5)
 
     def test_single_chunk_reduces_to_gpipe(self, setup):
         """v=1 on the 4-stage model: same loss as the gpipe schedule."""
@@ -243,17 +280,34 @@ class TestInterleaved:
         want = jax.jit(_pipe_loss_fn(mesh, "gpipe"))(params, tokens, targets)
         np.testing.assert_allclose(float(got), float(want), atol=1e-6)
 
-    def test_indivisible_microbatches_rejected(self, setup8):
+    @pytest.mark.parametrize(
+        "schedule", ["interleaved", "interleaved-1f1b"]
+    )
+    def test_indivisible_microbatches_still_correct(self, setup8, schedule):
+        """M=2 microbatches on S=4 devices (partial round-robin group):
+        the exact tick count makes this legal -- with extra bubble
+        ticks, not wrong numerics."""
         mesh, params, tokens, targets = setup8
-        with pytest.raises(ValueError, match="divisible by pipeline"):
-            jax.jit(self._loss_fn(mesh, n_micro=2))(
-                params, tokens, targets
+        got = jax.jit(
+            jax.value_and_grad(
+                self._loss_fn(mesh, n_micro=2, schedule=schedule)
             )
+        )(params, tokens, targets)
+        want_loss = self._oracle(params, tokens, targets)
+        want_g = jax.jit(jax.grad(self._oracle))(params, tokens, targets)
+        np.testing.assert_allclose(
+            float(got[0]), float(want_loss), atol=1e-5
+        )
+        _tree_allclose(got[1], want_g, atol=2e-4)
 
-    def test_ppxdp_grads_match_oracle(self, setup8):
+    @pytest.mark.parametrize(
+        "schedule", ["interleaved", "interleaved-1f1b"]
+    )
+    def test_ppxdp_grads_match_oracle(self, setup8, schedule):
         """Interleaved x DP on a 2D mesh: param grads must include
         every data shard's contribution (shard_map's transpose psums
-        them on this autodiff path -- pinned like the gpipe/1f1b
+        them on the autodiff path; the interleaved-1f1b custom_vjp
+        must hand-insert the same psum -- pinned like the gpipe/1f1b
         composition tests)."""
         mesh2 = build_mesh(MeshSpec(axes={"data": 2, "pipe": 4}))
         _, params, tokens, targets = setup8
@@ -262,7 +316,7 @@ class TestInterleaved:
         cfg = self.CFG8
         pipe = pp.pipelined(
             ptx.make_stage_fn(cfg), mesh2, axis="pipe",
-            schedule="interleaved", n_chunks=2,
+            schedule=schedule, n_chunks=2,
             batch_spec=P(None, "data"),
         )
 

@@ -28,6 +28,11 @@ SPMD computation. Instead:
   most ``2(S-s)-1`` activations are live per stage -- O(S) instead of
   GPipe's O(M) -- at the cost of recomputing each stage forward once
   from a saved input (remat, the standard TPU trade of FLOPs for HBM).
+- **Interleaved** (Megatron virtual pipeline) places v model chunks per
+  device round-robin, cutting ramp/drain bubble by v; it comes in an
+  autodiff-backward flavor ("interleaved") and a combined-program
+  flavor ("interleaved-1f1b") whose live-activation window is O(S*v)
+  independent of microbatch count -- the full Megatron schedule.
 
 Stage functions must be shape-preserving (activation in == activation
 out), which transformer blocks are. Embedding/head run *outside* the
@@ -53,18 +58,21 @@ StageFn = Callable[[Any, jax.Array], jax.Array]
 def bubble_fraction(
     n_stages: int, n_microbatches: int, n_chunks: int = 1
 ) -> float:
-    """Idle fraction of the pipeline: (S-1)/(M*v + S-1).
+    """Exact idle fraction of the pipeline's tick programs.
 
     The reference reports the approximation (S-1)/M
-    (03_pipeline_training.py:292, 07_pipeline_parallel.md:127-143);
-    this is the exact closed form (equal for M >> S). ``n_chunks`` = v
-    virtual stage chunks per device (the interleaved schedule): each
-    tick shrinks to 1/v of the work, so the ramp/drain cost falls from
-    (S-1) to (S-1)/v time units.
+    (03_pipeline_training.py:292, 07_pipeline_parallel.md:127-143).
+    Here: work is M*v ops per device over the exact tick count the
+    scan programs run -- (S-1)/(M*v + S-1) when S divides M (and
+    always at v=1), larger when a partial round-robin group adds
+    dilated-tail ticks on the interleaved schedules. ``n_chunks`` = v
+    virtual stage chunks per device: each tick shrinks to 1/v of the
+    work, so the ramp/drain cost falls from (S-1) to (S-1)/v time
+    units.
     """
-    return (n_stages - 1) / (
-        n_microbatches * n_chunks + n_stages - 1
-    )
+    S, M, V = n_stages, n_microbatches, n_chunks
+    ticks = ((M - 1) // S) * S * V + S * V + (M - 1) % S
+    return (ticks - M * V) / ticks
 
 
 def microbatch(x: jax.Array, n_microbatches: int) -> jax.Array:
@@ -203,7 +211,9 @@ def _fwd_program_interleaved(
 
     Local views: ``stacked`` [v, ...] (this device's chunks in owner
     order, from stack_interleaved_stage_params), ``xs`` [M, mb, ...].
-    Requires M % S == 0 (whole round-robin groups).
+    M need not divide S: a partial last round-robin group just runs
+    with extra bubble ticks (the tick count below is exact for any M),
+    though whole groups (M % S == 0) are the efficient layout.
     """
     S, V = n_stages, n_chunks
     # Ring rotation: neighbor hops + the chunk-boundary wrap.
@@ -212,6 +222,10 @@ def _fwd_program_interleaved(
     def program(stacked, xs):
         sid = jax.lax.axis_index(axis)
         M = xs.shape[0]
+        # Last forward op: microbatch M-1 (group q=(M-1)//S, offset
+        # r=(M-1)%S) at global stage G-1. For M % S == 0 this reduces
+        # to the familiar M*V + S - 1.
+        n_ticks = ((M - 1) // S) * S * V + S * V - 1 + ((M - 1) % S) + 1
 
         def tick(carry, t):
             state, ys = carry
@@ -258,7 +272,7 @@ def _fwd_program_interleaved(
         state0 = jnp.zeros_like(xs[0])
         ys0 = jnp.zeros_like(xs)
         (_, ys), _ = jax.lax.scan(
-            tick, (state0, ys0), jnp.arange(M * V + S - 1)
+            tick, (state0, ys0), jnp.arange(n_ticks)
         )
         if S > 1:
             ys = jax.lax.psum(
@@ -387,6 +401,166 @@ def _fwd_bwd_program_1f1b(
     return program
 
 
+def _fwd_bwd_program_interleaved_1f1b(
+    stage_fn: StageFn, axis: str, n_stages: int, n_chunks: int,
+    grad_reduce_axes: tuple = (),
+):
+    """Interleaved 1F1B: the combined forward+backward tick loop for
+    the virtual-chunk placement (under shard_map).
+
+    The Megatron interleaved schedule's memory story
+    (docs/guide/07_pipeline_parallel.md:127-143 anchors the reference's
+    1F1B/bubble discussion): the plain interleaved schedule here used
+    autodiff (GPipe-style) backward, so its live-activation window grew
+    O(M*v). This program gives interleaving the 1F1B window instead --
+    O(S*v) saved stage *inputs* per device, independent of microbatch
+    count, with each backward rematerialising its stage forward.
+
+    Schedule. Microbatch f = q*S + r runs global stage g = j*S + s
+    (device s, chunk j) forward at tick ``q*V*S + g + r`` -- the same
+    dilated placement as :func:`_fwd_program_interleaved`, one forward
+    op per device per tick. Its backward at stage g runs at tick
+    ``V*S + q*V*S + (V-1-j)*S + (S-1-s) + r``: the mirrored
+    decomposition is unique the same way, so each device also runs
+    exactly one backward op per tick, and cotangents advance exactly
+    one *reverse* ring hop per tick (stage g's consumer g-1 lives one
+    ring position to the left, including the chunk-boundary wrap
+    0 -> S-1). At V=1 both formulas collapse to the plain 1F1B ticks
+    ``f + s`` and ``2S-1-s + b`` exactly.
+
+    Memory. Stage inputs are saved in a per-chunk ring buffer of depth
+    3S: the forward-to-backward lag of (j, s) is
+    ``VS + (V-1-2j)S + (S-1-2s) < 2VS`` ticks, and a chunk's forwards
+    recur every VS ticks in groups of S, so at most ~3S microbatch
+    inputs per chunk are ever in flight (depth is static -- no
+    data-dependent shapes under jit).
+
+    Returns (grads_stacked [V, ...] local, gxs [M, mb, ...]).
+    """
+    S, V = n_stages, n_chunks
+    G = S * V
+    C = G          # first backward tick: right behind the last stage's
+    #                first forward (C >= G keeps buf writes ahead of
+    #                reads; C == G is the tightest such offset)
+    DB = 3 * S     # saved-input ring depth per chunk (see docstring)
+    ring = [(i, (i + 1) % S) for i in range(S)] if S > 1 else []
+    rev = [(i, (i - 1) % S) for i in range(S)] if S > 1 else []
+
+    def chunk(tree, j):
+        return jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, j, 0, keepdims=False
+            ),
+            tree,
+        )
+
+    def program(stacked, xs, ybar):
+        sid = jax.lax.axis_index(axis)
+        M = xs.shape[0]
+        qmax, rmax = (M - 1) // S, (M - 1) % S
+        # Last backward op: microbatch M-1 at global stage 0
+        # (j=0, s=0). Exact for any M, M % S == 0 or not.
+        n_ticks = C + qmax * G + (V - 1) * S + (S - 1) + rmax + 1
+
+        def tick(carry, t):
+            buf, fwd_state, bwd_state, grads, gxs = carry
+            # ---- forward op: f = q*S + r at chunk j, t = q*G + g + r
+            d = t - sid
+            r = jnp.maximum(d, 0) % S
+            e = jnp.maximum(d - r, 0) // S
+            j = e % V
+            q = e // V
+            f = q * S + r
+            do_fwd = (d >= 0) & (f < M)
+            fclip = jnp.clip(f, 0, M - 1)
+            first = (sid == 0) & (j == 0)
+            inp = jnp.where(
+                first,
+                jax.lax.dynamic_index_in_dim(xs, fclip, 0, keepdims=False),
+                fwd_state,
+            )
+            # Save this stage input for the backward's remat.
+            slot = jnp.where(do_fwd, fclip % DB, DB - 1)
+            row = jax.lax.dynamic_index_in_dim(buf, j, 0, keepdims=False)
+            old = jax.lax.dynamic_index_in_dim(row, slot, 0, keepdims=False)
+            row = jax.lax.dynamic_update_index_in_dim(
+                row, jnp.where(do_fwd, inp, old), slot, 0
+            )
+            buf = jax.lax.dynamic_update_index_in_dim(buf, row, j, 0)
+            out = stage_fn(chunk(stacked, j), inp)
+            out = jnp.where(do_fwd, out, jnp.zeros_like(out))
+            # ---- backward op: mirrored dilated decomposition
+            d2 = t - C - (S - 1 - sid)
+            r2 = jnp.maximum(d2, 0) % S
+            e2 = jnp.maximum(d2 - r2, 0) // S
+            j2 = (V - 1) - (e2 % V)
+            q2 = e2 // V
+            b = q2 * S + r2
+            do_bwd = (d2 >= 0) & (b < M)
+            bclip = jnp.clip(b, 0, M - 1)
+            brow = jax.lax.dynamic_index_in_dim(buf, j2, 0, keepdims=False)
+            binp = jax.lax.dynamic_index_in_dim(
+                brow, bclip % DB, 0, keepdims=False
+            )
+            _, vjp = jax.vjp(stage_fn, chunk(stacked, j2), binp)
+            last = (sid == S - 1) & (j2 == V - 1)
+            gin = jnp.where(
+                last,
+                jax.lax.dynamic_index_in_dim(ybar, bclip, 0, keepdims=False),
+                bwd_state,
+            )
+            pg, xg = vjp(gin)
+            xg = jnp.where(do_bwd, xg, jnp.zeros_like(xg))
+
+            def acc(gs, g):
+                cur = jax.lax.dynamic_index_in_dim(
+                    gs, j2, 0, keepdims=False
+                )
+                upd = cur + jnp.where(do_bwd, g, jnp.zeros_like(g))
+                return jax.lax.dynamic_update_index_in_dim(gs, upd, j2, 0)
+
+            grads = jax.tree.map(acc, grads, pg)
+            # Global stage 0's input cotangent is d(loss)/d(xs).
+            gfirst = do_bwd & (sid == 0) & (j2 == 0)
+            gcur = jax.lax.dynamic_index_in_dim(gxs, bclip, 0, keepdims=False)
+            gxs = jax.lax.dynamic_update_index_in_dim(
+                gxs, jnp.where(gfirst, xg, gcur), bclip, 0
+            )
+            if S > 1:
+                fwd_state = jax.lax.ppermute(out, axis, ring)
+                bwd_state = jax.lax.ppermute(xg, axis, rev)
+            else:
+                fwd_state, bwd_state = out, xg
+            return (buf, fwd_state, bwd_state, grads, gxs), None
+
+        mbshape = xs.shape[1:]
+        carry0 = (
+            jnp.zeros((V, DB) + mbshape, xs.dtype),  # buf
+            jnp.zeros(mbshape, xs.dtype),            # fwd_state
+            jnp.zeros(mbshape, xs.dtype),            # bwd_state
+            jax.tree.map(jnp.zeros_like, stacked),   # grads [V, ...]
+            jnp.zeros_like(xs),                      # gxs
+        )
+        (_, _, _, grads, gxs), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(n_ticks)
+        )
+        # Same hand-inserted psums as the plain 1F1B custom backward:
+        # batch-sharding axes replicate the stage params, so each data
+        # shard contributes only its own microbatches' grads.
+        if grad_reduce_axes:
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, grad_reduce_axes), grads
+            )
+        if S > 1:
+            sid = jax.lax.axis_index(axis)
+            gxs = jax.lax.psum(
+                jnp.where(sid == 0, gxs, jnp.zeros_like(gxs)), axis
+            )
+        return grads, gxs
+
+    return program
+
+
 def pipelined(
     stage_fn: StageFn,
     mesh: Mesh,
@@ -403,43 +577,39 @@ def pipelined(
     P(axis) -- see :func:`stage_pspecs`). ``xs``: [M, mb, ...]
     microbatched activations. ``schedule``: "gpipe" (autodiff backward,
     O(M) live activations), "1f1b" (custom_vjp interleaved backward,
-    O(S) live activations + forward remat), or "interleaved" (v
-    virtual chunks per device, ``n_chunks``; stack params with
+    O(S) live activations + forward remat), "interleaved" (v virtual
+    chunks per device, ``n_chunks``; stack params with
     :func:`stack_interleaved_stage_params`; autodiff backward; bubble
-    time / ``n_chunks``). ``remat_stage`` wraps the stage in
-    ``jax.checkpoint`` on the autodiff schedules, so the scan saves
-    only each tick's stage *input* instead of every intermediate --
-    the per-block HBM/FLOPs trade 1F1B already makes, now available
-    without the custom backward. The returned function is *not*
-    jitted -- trace it into your training step so XLA schedules the
-    surrounding embed/head/optimizer with it.
+    time / ``n_chunks``), or "interleaved-1f1b" (same virtual-chunk
+    placement and bubble, custom_vjp backward: O(S*v) live activations
+    independent of M, + forward remat). ``remat_stage`` wraps the
+    stage in ``jax.checkpoint`` on the autodiff schedules, so the scan
+    saves only each tick's stage *input* instead of every
+    intermediate -- the per-block HBM/FLOPs trade the 1f1b schedules
+    already make, now available without the custom backward. The
+    returned function is *not* jitted -- trace it into your training
+    step so XLA schedules the surrounding embed/head/optimizer with it.
     """
     S = mesh.shape[axis]
-    if n_chunks != 1 and schedule != "interleaved":
+    interleaved = schedule in ("interleaved", "interleaved-1f1b")
+    if n_chunks != 1 and not interleaved:
         raise ValueError(
-            f"n_chunks={n_chunks} only applies to "
-            f"schedule='interleaved', got {schedule!r} -- a multi-chunk "
-            "param stack under gpipe/1f1b would silently run wrong "
-            "stages"
+            f"n_chunks={n_chunks} only applies to the interleaved "
+            f"schedules, got {schedule!r} -- a multi-chunk param stack "
+            "under gpipe/1f1b would silently run wrong stages"
         )
     if remat_stage and schedule in ("gpipe", "interleaved"):
         stage_fn = jax.checkpoint(stage_fn)
-    elif remat_stage and schedule == "1f1b":
+    elif remat_stage and schedule in ("1f1b", "interleaved-1f1b"):
         raise ValueError(
             f"remat_stage has no effect under schedule={schedule!r}: "
             "the 1f1b custom_vjp already rematerialises each stage's "
             "forward in its backward pass -- drop the flag"
         )
-    if schedule == "interleaved":
+    if interleaved:
         inner = _fwd_program_interleaved(stage_fn, axis, S, n_chunks)
 
         def checked(stacked, xs):
-            if xs.shape[0] % S:
-                raise ValueError(
-                    f"interleaved schedule needs microbatches "
-                    f"({xs.shape[0]}) divisible by pipeline devices "
-                    f"({S}) -- whole round-robin groups"
-                )
             # Local chunk dim must equal n_chunks: a mismatch (wrong
             # n_chunks, or sequentially stacked params that skipped
             # interleave_stacked) would silently index-clamp into the
@@ -454,13 +624,42 @@ def pipelined(
                 )
             return inner(stacked, xs)
 
-        return jax.shard_map(
+        ifwd = jax.shard_map(
             checked,
             mesh=mesh,
             in_specs=(P(axis), batch_spec),
             out_specs=batch_spec,
             check_vma=False,
         )
+        if schedule == "interleaved":
+            return ifwd
+
+        reduce_axes = tuple(
+            a for a in _spec_axes(batch_spec) if a != axis
+        )
+        ibwd = jax.shard_map(
+            _fwd_bwd_program_interleaved_1f1b(
+                stage_fn, axis, S, n_chunks, reduce_axes
+            ),
+            mesh=mesh,
+            in_specs=(P(axis), batch_spec, batch_spec),
+            out_specs=(P(axis), batch_spec),
+            check_vma=False,
+        )
+
+        @jax.custom_vjp
+        def ipipe(stacked, xs):
+            return ifwd(stacked, xs)
+
+        def ipipe_fwd(stacked, xs):
+            return ifwd(stacked, xs), (stacked, xs)
+
+        def ipipe_bwd(res, ybar):
+            stacked, xs = res
+            return ibwd(stacked, xs, ybar)
+
+        ipipe.defvjp(ipipe_fwd, ipipe_bwd)
+        return ipipe
     fwd = jax.shard_map(
         _fwd_program(stage_fn, axis, S),
         mesh=mesh,
@@ -472,7 +671,8 @@ def pipelined(
         return fwd
     if schedule != "1f1b":
         raise ValueError(
-            f"unknown schedule {schedule!r} (gpipe|1f1b|interleaved)"
+            f"unknown schedule {schedule!r} "
+            "(gpipe|1f1b|interleaved|interleaved-1f1b)"
         )
 
     reduce_axes = tuple(a for a in _spec_axes(batch_spec) if a != axis)
