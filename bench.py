@@ -180,12 +180,25 @@ def bench_llama_sp(
         multiple_of=256, max_seq_len=2048,
     )
     mesh = build_mesh(MeshSpec(axes={"data": 1, "context": n_dev}))
-    make = {
-        "ring": ra.make_ring_attn_fn,
-        "zigzag": ra.make_zigzag_ring_attn_fn,
-        "ulysses": sp_ulysses.make_ulysses_attn_fn,
-    }[sp_mode]
-    attn_fn = make(mesh, "data", "context")
+    zigzag_ring = None
+    if sp_mode == "zigzag":
+        # Production layout: loader emits zigzag order once per batch,
+        # the balanced ring runs with zero per-layer permutes, RoPE
+        # reads the slots' global positions.
+        zigzag_ring = n_dev
+        attn_fn = ra.make_zigzag_ring_attn_fn(
+            mesh, "data", "context", data_layout="zigzag"
+        )
+    elif sp_mode == "ring":
+        attn_fn = ra.make_ring_attn_fn(mesh, "data", "context")
+    elif sp_mode == "ulysses":
+        attn_fn = sp_ulysses.make_ulysses_attn_fn(
+            mesh, "data", "context"
+        )
+    else:
+        raise ValueError(
+            f"unknown sp_mode {sp_mode!r} (ring|zigzag|ulysses)"
+        )
     constrain = ra.cp_constrain(mesh, "data", "context")
 
     cfg = TrainingConfig(
@@ -196,12 +209,15 @@ def bench_llama_sp(
         weight_decay=0.1,
     )
     ds = datasets.TokenStream(
-        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
+        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len,
+        zigzag_ring=zigzag_ring,
     )
     params = llama2.init_llama(jax.random.key(0), model_cfg)
     trainer = Trainer(
         cfg, mesh,
-        llama2.make_forward(model_cfg, constrain, attn_fn),
+        llama2.make_forward(
+            model_cfg, constrain, attn_fn, ds.positions()
+        ),
         params,
     )
     result = trainer.fit(ds)

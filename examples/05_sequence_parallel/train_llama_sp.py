@@ -83,6 +83,7 @@ def main(argv=None) -> int:
         multiple_of=64, max_seq_len=ns.seq_len,
         dtype=compute_dtype, param_dtype=param_dtype,
     )
+    zigzag_ring = None
     if ns.attn == "ulysses":
         validate_ulysses_degree(model_cfg.n_heads, cfg.seq_parallel)
         attn_fn = make_ulysses_attn_fn(mesh, "data", "seq")
@@ -91,19 +92,27 @@ def main(argv=None) -> int:
             make_zigzag_ring_attn_fn,
         )
 
-        attn_fn = make_zigzag_ring_attn_fn(mesh, "data", "seq")
+        # Production layout: the loader emits tokens already in zigzag
+        # order, so the balanced ring needs no per-layer permute pair;
+        # RoPE gets the slots' global positions instead.
+        zigzag_ring = mesh.shape["seq"]
+        attn_fn = make_zigzag_ring_attn_fn(
+            mesh, "data", "seq", data_layout="zigzag"
+        )
     else:
         attn_fn = make_ring_attn_fn(mesh, "data", "seq")
     constrain = cp_constrain(mesh, "data", "seq")
 
     params = llama2.init_llama(jax.random.key(cfg.seed), model_cfg)
     ds = datasets.TokenStream(
-        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
+        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len,
+        zigzag_ring=zigzag_ring,
     )
+    positions = ds.positions()
     trainer = Trainer(
         cfg,
         mesh,
-        llama2.make_forward(model_cfg, constrain, attn_fn),
+        llama2.make_forward(model_cfg, constrain, attn_fn, positions),
         params,
     )
     result = trainer.fit(ds)

@@ -158,29 +158,63 @@ class CIFARSynthetic:
 @dataclasses.dataclass(frozen=True)
 class TokenStream:
     """Random token batches for LLM/PP training. Parity:
-    03_pipeline_training.py:220-230 (inputs + shifted targets)."""
+    03_pipeline_training.py:220-230 (inputs + shifted targets).
+
+    ``zigzag_ring=n`` emits every batch in the zigzag layout for an
+    n-way ring (slot p holds the token of global position
+    ``zigzag_indices(n, seq_len)[0][p]``; inputs and targets permute
+    together, so the next-token pairing is preserved). This is the
+    pay-once-at-the-loader layout
+    ``parallel.ring_attention.make_zigzag_ring_attn_fn(...,
+    data_layout="zigzag")`` consumes -- feed ``positions()`` to the
+    model so RoPE uses global coordinates.
+    """
 
     vocab_size: int = 32000
     seq_len: int = 2048
     seed: int = 0
+    zigzag_ring: Optional[int] = None
+
+    def _perm(self):
+        """Zigzag layout permutation (None in contiguous mode)."""
+        if self.zigzag_ring is None:
+            return None
+        from tpu_hpc.parallel.ring_attention import zigzag_indices
+
+        return zigzag_indices(self.zigzag_ring, self.seq_len)[0]
+
+    def positions(self) -> Optional[jax.Array]:
+        """Global RoPE position of each slot ([seq_len] int32), for
+        ``llama2.make_forward(..., positions=...)``. None in
+        contiguous mode (the model's default ramp is already right).
+        """
+        perm = self._perm()
+        return None if perm is None else perm.astype(jnp.int32)
 
     @staticmethod
-    def _gen(seed, batch_size, seq_len, vocab, step):
+    def _gen(seed, batch_size, seq_len, vocab, ring, step):
         rng = jax.random.fold_in(jax.random.key(seed), step)
         tokens = jax.random.randint(
             rng, (batch_size, seq_len + 1), 0, vocab, dtype=jnp.int32
         )
-        return tokens[:, :-1], tokens[:, 1:]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if ring is not None:
+            from tpu_hpc.parallel.ring_attention import zigzag_indices
+
+            idx = zigzag_indices(ring, seq_len)[0]
+            inputs, targets = inputs[:, idx], targets[:, idx]
+        return inputs, targets
 
     def batch_at(self, step: int, batch_size: int) -> Tuple[jax.Array, jax.Array]:
         return _jitted_gen(
             TokenStream._gen, self.seed, batch_size,
-            self.seq_len, self.vocab_size,
+            self.seq_len, self.vocab_size, self.zigzag_ring,
         )(step)
 
     def traced_batch(self, step, batch_size: int):
         return TokenStream._gen(
-            self.seed, batch_size, self.seq_len, self.vocab_size, step
+            self.seed, batch_size, self.seq_len, self.vocab_size,
+            self.zigzag_ring, step,
         )
 
 

@@ -166,17 +166,27 @@ PRESETS: Dict[str, LlamaConfig] = {
 
 
 def rope_cos_sin(
-    seq_len: int, head_dim: int, theta: float = 10000.0
+    seq_len: int,
+    head_dim: int,
+    theta: float = 10000.0,
+    positions: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """RoPE tables as fp32 (cos, sin) of shape [seq, head_dim//2].
 
     Parity: precompute_freqs_cis (reference :30-55); real-pair form
     instead of complex64 -- the rotation is two fused multiply-adds.
+    ``positions`` overrides the default 0..seq_len-1 ramp: slot p gets
+    the rotation of global position positions[p]. This is what lets a
+    permuted token layout (zigzag ring sharding, packed sequences)
+    keep exact RoPE without un-permuting activations per layer.
     """
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
-    t = jnp.arange(seq_len, dtype=jnp.float32)
+    if positions is None:
+        t = jnp.arange(seq_len, dtype=jnp.float32)
+    else:
+        t = positions.astype(jnp.float32)
     freqs = jnp.outer(t, inv_freq)
     return jnp.cos(freqs), jnp.sin(freqs)
 
@@ -247,7 +257,9 @@ class Attention(nn.Module):
     attn_fn: AttnFn = None
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, positions: Optional[jax.Array] = None
+    ) -> jax.Array:
         cfg = self.cfg
         b, s, _ = x.shape
         hd = cfg.head_dim
@@ -259,7 +271,7 @@ class Attention(nn.Module):
         k = _dense(n_kv * hd, std, cfg, "wk")(x)
         v = _dense(n_kv * hd, std, cfg, "wv")(x)
 
-        cos, sin = rope_cos_sin(s, hd)
+        cos, sin = rope_cos_sin(s, hd, positions=positions)
         q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), cos, sin)
         k = apply_rope(k.reshape(b, s, n_kv, hd), cos, sin)
         v = v.reshape(b, s, n_kv, hd)
@@ -312,7 +324,9 @@ class TransformerBlock(nn.Module):
     attn_fn: AttnFn = None
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, positions: Optional[jax.Array] = None
+    ) -> jax.Array:
         cfg = self.cfg
         depth = (
             self.layer_id + 1 if cfg.depth_init else cfg.n_layers
@@ -320,7 +334,8 @@ class TransformerBlock(nn.Module):
         out_std = 0.02 / (2 * depth) ** 0.5
         h = x + self.constrain(
             Attention(cfg, out_std, self.attn_fn, name="attention")(
-                RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x)
+                RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attention_norm")(x),
+                positions,
             )
         )
         return h + self.constrain(
@@ -339,7 +354,9 @@ class Llama(nn.Module):
     attn_fn: AttnFn = None
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
+    def __call__(
+        self, tokens: jax.Array, positions: Optional[jax.Array] = None
+    ) -> jax.Array:
         cfg = self.cfg
         emb = nn.Embed(
             cfg.vocab_size,
@@ -366,7 +383,7 @@ class Llama(nn.Module):
         for i in range(cfg.n_layers):
             x = block(
                 cfg, i, self.constrain, self.attn_fn, name=f"layers_{i}"
-            )(x)
+            )(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="norm")(x)
         logits = nn.Dense(
             cfg.vocab_size,
@@ -402,24 +419,36 @@ def apply_llama(
     cfg: LlamaConfig,
     constrain: Constrain = _identity,
     attn_fn: AttnFn = None,
+    positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """[B, S] int tokens -> [B, S, vocab] logits in cfg.dtype (the
-    loss upcasts to fp32 inside its reductions; see Llama.__call__)."""
-    return Llama(cfg, constrain, attn_fn).apply({"params": params}, tokens)
+    loss upcasts to fp32 inside its reductions; see Llama.__call__).
+    ``positions`` [S]: global RoPE position of each slot, for permuted
+    token layouts (zigzag ring); None = the usual 0..S-1."""
+    return Llama(cfg, constrain, attn_fn).apply(
+        {"params": params}, tokens, positions
+    )
 
 
 def make_forward(
     cfg: LlamaConfig,
     constrain: Constrain = _identity,
     attn_fn: AttnFn = None,
+    positions: Optional[jax.Array] = None,
 ):
     """Trainer-contract forward: next-token cross-entropy on (inputs,
-    targets) token batches (datasets.TokenStream)."""
+    targets) token batches (datasets.TokenStream). ``positions`` as in
+    :func:`apply_llama` -- pass the dataset's layout positions (e.g.
+    ``TokenStream.positions()`` in zigzag mode) so RoPE stays exact
+    under a permuted token layout; per-token mean cross-entropy is
+    itself permutation-invariant."""
     from tpu_hpc.models.losses import cross_entropy
 
     def forward(params, model_state, batch, step_rng):
         inputs, targets = batch
-        logits = apply_llama(params, inputs, cfg, constrain, attn_fn)
+        logits = apply_llama(
+            params, inputs, cfg, constrain, attn_fn, positions
+        )
         return cross_entropy(logits, targets), model_state, {}
 
     return forward

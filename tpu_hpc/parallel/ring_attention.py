@@ -241,17 +241,27 @@ def make_zigzag_ring_attn_fn(
     impl: str = "auto",
     block_q: int = 512,
     block_k: int = 512,
+    data_layout: str = "contiguous",
 ) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
-    """Drop-in zigzag variant of ``make_ring_attn_fn``: permutes the
-    (contiguously sequence-sharded) inputs into zigzag layout, runs the
-    balanced ring, and permutes back.
+    """Zigzag (balanced) ring attention factory.
 
-    The two permutations reshard across the sp axis, so for production
-    long-context training prefer laying the tokens out in zigzag order
-    at the data loader (``zigzag_indices``) and calling
-    ``zigzag_ring_attention`` directly -- then the permutation cost is
-    paid once per batch on the host instead of twice per layer.
+    ``data_layout="contiguous"``: drop-in for ``make_ring_attn_fn`` on
+    normally-ordered sequences -- permutes inputs into zigzag layout,
+    runs the balanced ring, permutes back. The two permutations
+    reshard across the sp axis *per layer*.
+
+    ``data_layout="zigzag"``: the production path -- the tokens are
+    already laid out in zigzag order (``TokenStream(zigzag_ring=n)``
+    at the loader, or ``x[:, zigzag_indices(n, S)[0]]`` once per
+    batch), so the per-layer permute pair disappears entirely; feed
+    the model the matching RoPE positions
+    (``llama2.make_forward(..., positions=...)``) and an
+    order-insensitive loss (per-token mean CE is).
     """
+    if data_layout not in ("contiguous", "zigzag"):
+        raise ValueError(
+            f"unknown data_layout {data_layout!r} (contiguous|zigzag)"
+        )
     spec = P(dp_axis, sp_axis, None, None)
     n = mesh.shape[sp_axis]
 
@@ -261,15 +271,29 @@ def make_zigzag_ring_attn_fn(
             causal=causal, impl=impl, block_q=block_q, block_k=block_k,
         )
 
+    sharded = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    if data_layout == "zigzag":
+        def prelaid_attn_fn(q, k, v):
+            # Same divisibility contract the contiguous path gets from
+            # zigzag_indices -- without it, an odd shard traces into
+            # an opaque XLA scan-carry shape error.
+            if q.shape[1] % (2 * n):
+                raise ValueError(
+                    f"zigzag needs seq {q.shape[1]} divisible by "
+                    f"2*ring={2 * n}"
+                )
+            return sharded(q, k, v)
+
+        return prelaid_attn_fn
+
     def attn_fn(q, k, v):
         idx, inv = zigzag_indices(n, q.shape[1])
         qz, kz, vz = (x[:, idx] for x in (q, k, v))
-        out = jax.shard_map(
-            inner, mesh=mesh,
-            in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False,
-        )(qz, kz, vz)
-        return out[:, inv]
+        return sharded(qz, kz, vz)[:, inv]
 
     return attn_fn
 
