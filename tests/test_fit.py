@@ -121,3 +121,34 @@ def test_sizing_table_catches_overflow():
         do_compile=False,
     )
     assert not r.fits
+
+
+def test_count_collectives_backend_spellings():
+    """The counter must see all three backend spellings: plain ops,
+    the TPU async start/done pairs, and the v5e fused reduce-scatter
+    (a kCustom fusion calls=%all-reduce-scatter) -- counting only
+    'reduce-scatter(' reported 0 on real TPU lowerings."""
+    hlo = "\n".join([
+        '%ag = f32[8] all-gather(%x), dimensions={0}',
+        '%ags = f32[8] all-gather-start(%x)',
+        '%ar = f32[8] all-reduce(%x)',
+        # Two fused reduce-scatters: computation def + body all-reduce
+        # + kCustom call site each. The body all-reduces implement the
+        # reduce-scatters and must not inflate the all-reduce row.
+        '%all-reduce-scatter (input: f32[8]) -> f32[2] {',
+        '  %body-ar = f32[8] all-reduce(%input)',
+        '}',
+        '%all-reduce-scatter.1 (input: f32[8]) -> f32[2] {',
+        '  %body-ar.1 = f32[8] all-reduce(%input)',
+        '}',
+        '%rs = f32[2] reduce-scatter(%x)',
+        '%f = f32[2] fusion(%x), kind=kCustom, calls=%all-reduce-scatter',
+        '%f2 = f32[2] fusion(%y), kind=kCustom, calls=%all-reduce-scatter.1',
+        '%cp = f32[8] collective-permute-start(%x)',
+    ])
+    c = fit._count_collectives(hlo)
+    assert c["all-gather"] == 2          # plain + async start
+    assert c["all-reduce"] == 1          # top-level only; bodies excluded
+    assert c["reduce-scatter"] == 3      # plain + 2 fused
+    assert c["collective-permute"] == 1  # async start
+    assert c["all-to-all"] == 0

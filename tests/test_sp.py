@@ -546,7 +546,6 @@ class TestZigzagDataLayout:
 
     def test_loss_and_grads_match_contiguous(self, sp_mesh):
         from tpu_hpc.models import datasets, llama2
-        from tpu_hpc.models.losses import cross_entropy
         from tpu_hpc.parallel.ring_attention import (
             cp_constrain, make_ring_attn_fn, make_zigzag_ring_attn_fn,
         )

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_hpc.models import llama2
 from tpu_hpc.parallel import hybrid, tp
@@ -100,6 +100,8 @@ class FitResult:
     compile_seconds: float = 0.0
     collectives: Dict[str, int] = dataclasses.field(default_factory=dict)
     xla_argument_bytes: int = 0  # per chip, XLA's own accounting
+    xla_temp_bytes: int = 0      # per chip, XLA scratch/live temps
+    compile_backend: str = "cpu-sim"  # or "tpu-topology:<name>"
 
     @property
     def static_bytes(self) -> int:
@@ -185,6 +187,32 @@ def activation_model(
     }
 
 
+def _count_collectives(hlo: str) -> Dict[str, int]:
+    """Collective op applications in compiled HLO, across backend
+    spellings: plain ``op(``, the async pair form ``op-start(`` (the
+    TPU latency-hiding scheduler splits collectives into start/done),
+    and the TPU backend's fused reduce-scatter -- a kCustom fusion
+    ``calls=%all-reduce-scatter`` that consumes the full gradient and
+    emits the sharded shard directly (observed on v5e topology
+    compiles; counting only ``reduce-scatter(`` would report 0 and
+    understate the real lowering)."""
+    counts = {}
+    # Each %all-reduce-scatter computation *body* contains one
+    # all-reduce op implementing it -- that op must not also count in
+    # the all-reduce row (it IS the fused reduce-scatter).
+    fused_defs = len(
+        re.findall(r"(?m)^\s*%all-reduce-scatter[\w.\-]*\s+\(", hlo)
+    )
+    for op in _COLLECTIVES:
+        n = len(re.findall(rf"\b{op}(?:-start)?\(", hlo))
+        if op == "reduce-scatter":
+            n += len(re.findall(r"calls=%all-reduce-scatter", hlo))
+        elif op == "all-reduce":
+            n = max(0, n - fused_defs)
+        counts[op] = n
+    return counts
+
+
 def analyze(
     cfg: Optional[llama2.LlamaConfig] = None,
     dp: int = 4,
@@ -194,6 +222,7 @@ def analyze(
     hbm_gib: float = 32.0,
     do_compile: bool = True,
     grad_accum: int = 1,
+    tpu_topology: Optional[str] = None,
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
 
@@ -201,6 +230,14 @@ def analyze(
     (data=4, model=8) mesh, 32 GiB HBM per chip. ``grad_accum`` analyzes
     (and compiles) the accumulated step -- the configuration large
     global batches actually run.
+
+    ``tpu_topology`` (e.g. ``"v5e:4x8"``): AOT-compile against a
+    *virtual TPU topology* via libtpu instead of the CPU-sim backend.
+    No chips needed -- the TPU compiler itself partitions the step, so
+    the collective table shows the REAL lowering (reduce-scatters stay
+    reduce-scatters; the CPU simulator legalizes them to
+    all-reduce+slice) and ``memory_analysis`` is the TPU compiler's own
+    HBM accounting.
     """
     if cfg is None:
         cfg = llama2.LlamaConfig(max_seq_len=seq_len, remat=True)
@@ -252,17 +289,34 @@ def analyze(
     from tpu_hpc.train.trainer import TrainState, make_step_fn
 
     n_dev = dp * tp_size
-    devices = jax.devices()
-    if len(devices) < n_dev:
-        raise RuntimeError(
-            f"need {n_dev} devices for the compile pass, have "
-            f"{len(devices)}; run under TPU_HPC_SIM_DEVICES={n_dev} or "
-            "pass do_compile=False"
+    if tpu_topology is not None:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name=tpu_topology
         )
-    mesh = build_mesh(
-        MeshSpec(axes={"data": dp, "model": tp_size}),
-        devices=devices[:n_dev],
-    )
+        devices = list(topo.devices)
+        if len(devices) != n_dev:
+            raise RuntimeError(
+                f"topology {tpu_topology!r} has {len(devices)} chips, "
+                f"mesh needs dp*tp = {n_dev}"
+            )
+        result.compile_backend = f"tpu-topology:{tpu_topology}"
+        mesh = Mesh(
+            np.asarray(devices).reshape(dp, tp_size), ("data", "model")
+        )
+    else:
+        devices = jax.devices()
+        if len(devices) < n_dev:
+            raise RuntimeError(
+                f"need {n_dev} devices for the compile pass, have "
+                f"{len(devices)}; run under TPU_HPC_SIM_DEVICES={n_dev} "
+                "or pass do_compile=False"
+            )
+        mesh = build_mesh(
+            MeshSpec(axes={"data": dp, "model": tp_size}),
+            devices=devices[:n_dev],
+        )
     constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
     forward = llama2.make_forward(cfg, constrain)
     micro_constrain = None
@@ -310,12 +364,13 @@ def analyze(
     result.compile_seconds = time.time() - t0
     result.compiled = True
     hlo = compiled.as_text()
-    result.collectives = {
-        op: len(re.findall(rf"\b{op}\(", hlo)) for op in _COLLECTIVES
-    }
+    result.collectives = _count_collectives(hlo)
     mem = compiled.memory_analysis()
     if mem is not None:
         result.xla_argument_bytes = int(mem.argument_size_in_bytes)
+        result.xla_temp_bytes = int(
+            getattr(mem, "temp_size_in_bytes", 0) or 0
+        )
     return result
 
 
@@ -388,10 +443,18 @@ def to_markdown(r: FitResult) -> str:
             f"The real Trainer step (`train.trainer.make_step_fn`) was "
             f"AOT-lowered and XLA-compiled against the "
             f"{r.dp}x{r.tp_size} mesh in {r.compile_seconds:.1f}s "
-            "(SPMD partitioning enabled). XLA's per-chip argument "
+            f"(SPMD partitioning enabled; backend: "
+            f"**{r.compile_backend}**). XLA's per-chip argument "
             f"accounting: {r.xla_argument_bytes:,} bytes "
             f"({r.xla_argument_bytes/GIB:.2f} GiB) -- cross-checks the "
-            "static rows above (params + opt state + batch).",
+            "static rows above (params + opt state + batch)."
+            + (
+                f" Compiler temp/scratch accounting: "
+                f"{r.xla_temp_bytes:,} bytes "
+                f"({r.xla_temp_bytes/GIB:.2f} GiB) -- the compiler's "
+                "own view of the activation/workspace footprint."
+                if r.xla_temp_bytes else ""
+            ),
             "",
             "Collectives in the compiled module (op applications):",
             "",
@@ -410,6 +473,13 @@ def to_markdown(r: FitResult) -> str:
                 "FSDP param gathering + SP boundary gathers, "
                 "reduce-scatter/all-reduce pairs for the TP block "
                 "reductions and FSDP gradient scatter."
+                + (
+                    " This is the real TPU lowering (libtpu compiled "
+                    "against the virtual topology), so the "
+                    "reduce-scatter form is directly evidenced."
+                    if r.compile_backend.startswith("tpu-topology")
+                    else ""
+                )
             )
         else:
             conclusion = (
@@ -500,6 +570,11 @@ def main(argv=None) -> int:
                         help="write the report to this path")
     parser.add_argument("--json", action="store_true",
                         help="print one JSON line instead of the report")
+    parser.add_argument("--tpu-topology", type=str, default=None,
+                        help="AOT-compile against a virtual TPU "
+                        "topology (e.g. v5e:4x8) via libtpu -- no "
+                        "chips needed; collective counts show the "
+                        "real TPU lowering incl. reduce-scatters")
     args = parser.parse_args(argv)
 
     if args.table:
@@ -508,8 +583,10 @@ def main(argv=None) -> int:
 
     # Self-provision the virtual pod for the compile pass: flip this
     # process to the simulated CPU backend if it's still pluripotent,
-    # else re-exec in a child that comes up simulated.
-    if not args.no_compile:
+    # else re-exec in a child that comes up simulated. A TPU-topology
+    # compile needs no devices at all -- libtpu compiles against the
+    # topology description -- so skip provisioning entirely.
+    if not args.no_compile and args.tpu_topology is None:
         from tpu_hpc.runtime import sim
 
         n_dev = args.dp * args.tp
@@ -535,7 +612,7 @@ def main(argv=None) -> int:
         cfg=cfg, dp=args.dp, tp_size=args.tp,
         global_batch=args.global_batch, seq_len=args.seq_len,
         hbm_gib=args.hbm_gib, do_compile=not args.no_compile,
-        grad_accum=args.grad_accum,
+        grad_accum=args.grad_accum, tpu_topology=args.tpu_topology,
     )
     md = to_markdown(r)
     if args.markdown:
