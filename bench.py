@@ -56,7 +56,6 @@ def bench_llama(
     import jax
 
     from tpu_hpc.config import TrainingConfig
-    from tpu_hpc.kernels.attention import blockwise_attention
     from tpu_hpc.models import datasets, llama2
     from tpu_hpc.parallel import fsdp, hybrid, tp
     from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
@@ -69,29 +68,14 @@ def bench_llama(
         multiple_of=256, max_seq_len=seq_len, remat=remat,
     )
 
-    def flash(q, k, v):
-        # Pallas flash on TPU, XLA path elsewhere (GQA handled
-        # in-kernel -- no repeated KV).
-        out, _ = blockwise_attention(
-            q, k, v, causal=True, block_q=block_q, block_k=block_k
-        )
-        return out
-
     def make_attn_fn(mesh, tp_size):
         if attn == "xla":
             return None  # the model's einsum path (XLA-fused)
-        if mesh.size == 1:
-            return flash
-        # Multi-chip: XLA has no SPMD partitioning rule for a Pallas
-        # call, so run it under shard_map -- heads on the TP axis
-        # (each shard does full-sequence attention for its heads),
-        # batch on data.
-        from jax.sharding import PartitionSpec as P
-
-        spec = P("data", None, "model" if tp_size > 1 else None, None)
-        return jax.shard_map(
-            flash, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=spec, check_vma=False,
+        # Pallas flash (GQA in-kernel, no repeated KV); multi-chip
+        # runs it under shard_map with heads on the TP axis.
+        return tp.make_tp_flash_attn_fn(
+            mesh, "data", "model" if tp_size > 1 else None,
+            block_q=block_q, block_k=block_k,
         )
 
     tp_size = tp.auto_tp_degree(

@@ -231,3 +231,41 @@ def test_mlp_rules_anchor_on_path_components():
     assert apply_rules(rules, "in/kernel") == P(None, "model")
     assert apply_rules(rules, "block/up/kernel") == P(None, "model")
     assert apply_rules(rules, "block/down/kernel") == P("model", None)
+
+
+def test_tp_flash_attn_fn_matches_local(devices):
+    """The Pallas-flash-under-shard_map factory (heads on the TP axis,
+    batch on data) must reproduce the model's local attention path --
+    the production attention configuration for hybrid FSDPxTP
+    (fit.py --attn flash, bench.py). On the CPU sim the kernel runs
+    its XLA reference path; the sharding layout is what's under test."""
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(axes={"data": 2, "model": 4}))
+    cfg = llama2.LlamaConfig(
+        dim=32, n_layers=2, n_heads=4, vocab_size=64,
+        multiple_of=16, max_seq_len=32, dtype=jnp.float32,
+    )
+    params = llama2.init_llama(jax.random.key(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.key(1), (4, 32), 0, 64, dtype=jnp.int32
+    )
+    local = llama2.apply_llama(params, tokens, cfg)
+    attn = tp.make_tp_flash_attn_fn(mesh, "data", "model", impl="xla")
+    con = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
+    sharded = jax.jit(
+        lambda p, t: llama2.apply_llama(p, t, cfg, con, attn)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(local), atol=2e-4
+    )
+
+
+def test_tp_flash_attn_fn_single_device_passthrough():
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("data",))
+    fn = tp.make_tp_flash_attn_fn(mesh, "data", None, impl="xla")
+    q = jax.random.normal(jax.random.key(0), (2, 16, 4, 8))
+    out = fn(q, q, q)
+    assert out.shape == q.shape

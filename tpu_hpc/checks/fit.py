@@ -102,6 +102,10 @@ class FitResult:
     xla_argument_bytes: int = 0  # per chip, XLA's own accounting
     xla_temp_bytes: int = 0      # per chip, XLA scratch/live temps
     compile_backend: str = "cpu-sim"  # or "tpu-topology:<name>"
+    attn: str = "xla"            # attention path the compile pass used
+    compiler_options: Dict[str, str] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def static_bytes(self) -> int:
@@ -223,6 +227,8 @@ def analyze(
     do_compile: bool = True,
     grad_accum: int = 1,
     tpu_topology: Optional[str] = None,
+    attn: str = "xla",
+    compiler_options: Optional[Dict[str, str]] = None,
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
 
@@ -238,6 +244,13 @@ def analyze(
     reduce-scatters; the CPU simulator legalizes them to
     all-reduce+slice) and ``memory_analysis`` is the TPU compiler's own
     HBM accounting.
+
+    ``attn="flash"`` compiles the production attention path -- the
+    Pallas flash kernel under shard_map with heads on the TP axis
+    (tp.make_tp_flash_attn_fn). The default ``"xla"`` einsum path
+    materialises per-layer score blocks whose HBM temps dominate at
+    seq 4096+ and can overflow a real core's budget that the flash
+    kernel's online softmax avoids.
     """
     if cfg is None:
         cfg = llama2.LlamaConfig(max_seq_len=seq_len, remat=True)
@@ -281,6 +294,10 @@ def analyze(
         act_bytes=act,
         grad_accum=grad_accum,
     )
+    if attn not in ("xla", "flash"):
+        raise ValueError(f"unknown attn {attn!r} (xla|flash)")
+    result.attn = attn
+    result.compiler_options = dict(compiler_options or {})
     if not do_compile:
         return result
 
@@ -318,7 +335,17 @@ def analyze(
             devices=devices[:n_dev],
         )
     constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
-    forward = llama2.make_forward(cfg, constrain)
+    if attn == "flash":
+        # impl pinned to "pallas": in a topology AOT compile no
+        # backend is initialized, so blockwise_attention's "auto"
+        # would pick the XLA path and silently defeat the point.
+        attn_fn = tp.make_tp_flash_attn_fn(
+            mesh, "data", "model",
+            impl="pallas" if tpu_topology else "auto",
+        )
+    else:
+        attn_fn = None  # "xla": the model's einsum path (validated above)
+    forward = llama2.make_forward(cfg, constrain, attn_fn)
     micro_constrain = None
     if grad_accum > 1:
         from tpu_hpc.train.trainer import make_microbatch_constrain
@@ -359,7 +386,7 @@ def analyze(
             donate_argnums=(0,),
         )
         .lower(state_abstract, batch_abstract)
-        .compile()
+        .compile(compiler_options=compiler_options or None)
     )
     result.compile_seconds = time.time() - t0
     result.compiled = True
@@ -444,7 +471,14 @@ def to_markdown(r: FitResult) -> str:
             f"AOT-lowered and XLA-compiled against the "
             f"{r.dp}x{r.tp_size} mesh in {r.compile_seconds:.1f}s "
             f"(SPMD partitioning enabled; backend: "
-            f"**{r.compile_backend}**). XLA's per-chip argument "
+            f"**{r.compile_backend}**; attention path: {r.attn}"
+            + (
+                f"; compiler options: "
+                + ", ".join(f"{k}={v}" for k, v in
+                            sorted(r.compiler_options.items()))
+                if r.compiler_options else ""
+            )
+            + "). XLA's per-chip argument "
             f"accounting: {r.xla_argument_bytes:,} bytes "
             f"({r.xla_argument_bytes/GIB:.2f} GiB) -- cross-checks the "
             "static rows above (params + opt state + batch)."
@@ -544,6 +578,19 @@ def sizing_table(
     return "\n".join(lines)
 
 
+def _parse_xla_opts(opts) -> Optional[Dict[str, str]]:
+    parsed = {}
+    for opt in opts:
+        key, sep, val = opt.partition("=")
+        if not sep or not key:
+            raise SystemExit(
+                f"--xla-opt expects KEY=VALUE, got {opt!r} "
+                "(e.g. xla_tpu_enable_latency_hiding_scheduler=false)"
+            )
+        parsed[key] = val
+    return parsed or None
+
+
 def main(argv=None) -> int:
     import sys
 
@@ -575,6 +622,18 @@ def main(argv=None) -> int:
                         "topology (e.g. v5e:4x8) via libtpu -- no "
                         "chips needed; collective counts show the "
                         "real TPU lowering incl. reduce-scatters")
+    parser.add_argument("--attn", choices=("xla", "flash"),
+                        default="xla",
+                        help="attention path for the compile pass: "
+                        "'flash' = the production Pallas kernel under "
+                        "shard_map (heads on the TP axis)")
+    parser.add_argument("--xla-opt", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="extra XLA compiler option for the "
+                        "compile pass (repeatable), e.g. "
+                        "--xla-opt xla_tpu_enable_latency_hiding_"
+                        "scheduler=false to trade collective overlap "
+                        "for a lower HBM temp watermark")
     args = parser.parse_args(argv)
 
     if args.table:
@@ -613,6 +672,8 @@ def main(argv=None) -> int:
         global_batch=args.global_batch, seq_len=args.seq_len,
         hbm_gib=args.hbm_gib, do_compile=not args.no_compile,
         grad_accum=args.grad_accum, tpu_topology=args.tpu_topology,
+        attn=args.attn,
+        compiler_options=_parse_xla_opts(args.xla_opt),
     )
     md = to_markdown(r)
     if args.markdown:

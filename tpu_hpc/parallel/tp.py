@@ -129,3 +129,54 @@ def validate_tp_degree(
             f"n_kv_heads={kv_heads} not divisible by tp={tp}; "
             "GQA requires kv_heads % tp == 0"
         )
+
+
+def make_tp_flash_attn_fn(
+    mesh: Mesh,
+    dp_axis: Optional[str] = "data",
+    tp_axis: Optional[str] = "model",
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+    """The Pallas flash kernel under tensor parallelism: heads shard
+    over ``tp_axis``, batch over ``dp_axis``, full sequence per shard.
+
+    XLA has no SPMD partitioning rule for a Pallas call, so inside a
+    GSPMD-partitioned step the kernel must run under ``shard_map`` --
+    each shard does full-sequence attention for its own heads (the
+    head-parallel split of Megatron TP; parity: the reference's
+    per-head SDPA sharding, tensor_parallel_vit.py:107-123). GQA is
+    handled in-kernel (no KV repeat), so kv_heads only need to divide
+    ``tp_axis`` -- validate with :func:`validate_tp_degree`.
+
+    The production attention path for hybrid FSDPxTP training: the
+    XLA einsum attention materialises per-layer [B,H,S,S] score
+    blocks that dominate HBM temps at seq 4096+ (a 70B/128-core
+    topology compile overflows a 15.25 GiB core by ~0.6 GiB on
+    scores alone); the flash kernel's online softmax removes them.
+    """
+    from tpu_hpc.kernels.attention import blockwise_attention
+
+    def flash(q, k, v):
+        out, _ = blockwise_attention(
+            q, k, v, causal=causal, impl=impl,
+            block_q=block_q, block_k=block_k,
+        )
+        return out
+
+    if mesh.size == 1:
+        return flash
+    tp_size = mesh.shape.get(tp_axis, 1) if tp_axis else 1
+    spec = P(
+        dp_axis if dp_axis and mesh.shape.get(dp_axis, 1) > 1 else None,
+        None,
+        tp_axis if tp_size > 1 else None,
+        None,
+    )
+    return jax.shard_map(
+        flash, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False,
+    )
