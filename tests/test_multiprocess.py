@@ -262,6 +262,7 @@ def _run_hybrid_pair(mode: str, ckpt_dir: str):
     return outs[0][3]
 
 
+@pytest.mark.slow
 def test_hybrid_fsdp_tp_trainer_across_two_processes(tmp_path):
     """The multi-node rehearsal (reference utils/distributed.py:124-158
     + fsdp_tp/fsdp_tp_example.py:80-97, without hardware): 2 processes

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_hpc.models import llama2
 from tpu_hpc.parallel import hybrid, tp
@@ -319,9 +319,6 @@ def analyze(
                 f"mesh needs dp*tp = {n_dev}"
             )
         result.compile_backend = f"tpu-topology:{tpu_topology}"
-        mesh = Mesh(
-            np.asarray(devices).reshape(dp, tp_size), ("data", "model")
-        )
     else:
         devices = jax.devices()
         if len(devices) < n_dev:
@@ -330,10 +327,14 @@ def analyze(
                 f"{len(devices)}; run under TPU_HPC_SIM_DEVICES={n_dev} "
                 "or pass do_compile=False"
             )
-        mesh = build_mesh(
-            MeshSpec(axes={"data": dp, "model": tp_size}),
-            devices=devices[:n_dev],
-        )
+    # build_mesh gives TPU device subsets (real or topology) ICI-aware
+    # placement -- a flat reshape makes ring neighbors physically
+    # distant, which v5e's limited ICI routing rejects outright for
+    # async collective-permutes.
+    mesh = build_mesh(
+        MeshSpec(axes={"data": dp, "model": tp_size}),
+        devices=devices[:n_dev],
+    )
     constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
     if attn == "flash":
         # impl pinned to "pallas": in a topology AOT compile no

@@ -155,7 +155,25 @@ def build_mesh(
         return jax.make_mesh(
             shape, names, axis_types=(jax.sharding.AxisType.Auto,) * len(names)
         )
-    arr = np.asarray(devices[:total]).reshape(shape)
+    subset = list(devices[:total])
+    if all(getattr(d, "platform", None) == "tpu" for d in subset):
+        # Explicit TPU device subsets (pod sub-meshes, virtual-topology
+        # AOT compiles) still need ICI-aware placement: a flat reshape
+        # makes ring neighbors physically distant, which v5e's limited
+        # ICI routing rejects outright for async collective-permutes
+        # and which throttles any real pod. mesh_utils orders by
+        # physical coords; fall through to the flat reshape only if it
+        # cannot (e.g. an irregular subset).
+        from jax.experimental import mesh_utils
+
+        try:
+            return Mesh(
+                mesh_utils.create_device_mesh(shape, devices=subset),
+                names,
+            )
+        except Exception:
+            pass
+    arr = np.asarray(subset).reshape(shape)
     return Mesh(arr, names)
 
 
