@@ -103,6 +103,7 @@ class FitResult:
     xla_temp_bytes: int = 0      # per chip, XLA scratch/live temps
     compile_backend: str = "cpu-sim"  # or "tpu-topology:<name>"
     attn: str = "xla"            # attention path the compile pass used
+    moments_dtype: str = "float32"  # AdamW moment storage dtype
     compiler_options: Dict[str, str] = dataclasses.field(
         default_factory=dict
     )
@@ -229,6 +230,7 @@ def analyze(
     tpu_topology: Optional[str] = None,
     attn: str = "xla",
     compiler_options: Optional[Dict[str, str]] = None,
+    moments_dtype: str = "float32",
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
 
@@ -273,7 +275,13 @@ def analyze(
     specs = hybrid.hybrid_pspecs(
         abstract_params, tp.llama_rules(), data_size=dp
     )
-    optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    # The Trainer's own AdamW construction (shared helper, so the fit
+    # analysis can never drift from the step it certifies); bf16
+    # moments halve the opt-state rows below -- the documented unlock
+    # for 70B-class models on 16 GiB chips.
+    from tpu_hpc.train.trainer import make_adamw
+
+    optimizer = make_adamw(3e-4, 0.1, moments_dtype)
     opt_abstract = jax.eval_shape(optimizer.init, abstract_params)
     opt_specs = derived_pspecs(opt_abstract, abstract_params, specs)
 
@@ -293,6 +301,7 @@ def analyze(
         opt_bytes=tree_bytes_per_chip(opt_abstract, opt_specs, mesh_axes),
         act_bytes=act,
         grad_accum=grad_accum,
+        moments_dtype=moments_dtype,
     )
     if attn not in ("xla", "flash"):
         raise ValueError(f"unknown attn {attn!r} (xla|flash)")
@@ -443,7 +452,8 @@ def to_markdown(r: FitResult) -> str:
         f"{r.param_bytes/GIB:.2f} |",
         f"| gradients (fp32, same layout) | {r.grad_bytes:,} | "
         f"{r.grad_bytes/GIB:.2f} |",
-        f"| AdamW mu+nu (fp32, same layout) | {r.opt_bytes:,} | "
+        f"| AdamW mu+nu ({'bf16' if r.moments_dtype == 'bfloat16' else 'fp32'}, "
+        f"same layout) | {r.opt_bytes:,} | "
         f"{r.opt_bytes/GIB:.2f} |",
     ]
     for name, b in r.act_bytes.items():
@@ -628,6 +638,11 @@ def main(argv=None) -> int:
                         help="attention path for the compile pass: "
                         "'flash' = the production Pallas kernel under "
                         "shard_map (heads on the TP axis)")
+    parser.add_argument("--moments-dtype",
+                        choices=("float32", "bfloat16"),
+                        default="float32",
+                        help="AdamW moment storage dtype; bfloat16 "
+                        "halves optimizer-state HBM")
     parser.add_argument("--xla-opt", action="append", default=[],
                         metavar="KEY=VALUE",
                         help="extra XLA compiler option for the "
@@ -675,6 +690,7 @@ def main(argv=None) -> int:
         grad_accum=args.grad_accum, tpu_topology=args.tpu_topology,
         attn=args.attn,
         compiler_options=_parse_xla_opts(args.xla_opt),
+        moments_dtype=args.moments_dtype,
     )
     md = to_markdown(r)
     if args.markdown:

@@ -43,6 +43,12 @@ class TrainingConfig:
     # LLM pretraining shape). warmup_steps applies to both.
     lr_schedule: str = "constant"
     warmup_steps: int = 0
+    # AdamW moment dtype: "float32" (default; exact parity with the
+    # reference's AdamW) or "bfloat16" -- halves optimizer-state HBM
+    # (the documented unlock for 70B-class models on 16 GiB chips,
+    # REPORT_70b_128chip_2M.md) at a small update-noise cost. Applies
+    # to both mu and nu; master params stay fp32 either way.
+    adam_moments_dtype: str = "float32"
 
     # Precision (reference AMP block: utils/config.py:40-44).
     param_dtype: str = "float32"

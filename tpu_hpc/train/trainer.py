@@ -122,11 +122,79 @@ def make_optimizer(cfg: TrainingConfig) -> optax.GradientTransformation:
     """SGD+momentum or AdamW from config (reference optimizers:
     SGD in the DDP/FSDP examples, AdamW with foreach=False in TP --
     tensor_parallel_vit.py:372-378; no foreach quirk exists here),
-    with the configured LR schedule."""
+    with the configured LR schedule. ``adam_moments_dtype="bfloat16"``
+    halves AdamW state HBM (mu AND nu; optax keeps the update math in
+    fp32 and rounds the stored moments)."""
     lr = make_lr_schedule(cfg)
     if cfg.weight_decay > 0:
-        return optax.adamw(lr, weight_decay=cfg.weight_decay)
+        return make_adamw(
+            lr, cfg.weight_decay, cfg.adam_moments_dtype
+        )
+    if cfg.adam_moments_dtype != "float32":
+        # The default optimizer is SGD (weight_decay=0); silently
+        # ignoring an explicit HBM-halving request would OOM the very
+        # run the knob exists for, with no pointer at the cause.
+        raise ValueError(
+            f"adam_moments_dtype={cfg.adam_moments_dtype!r} has no "
+            "effect on the SGD path -- set weight_decay > 0 to get "
+            "AdamW, or drop the moments override"
+        )
     return optax.sgd(lr, momentum=cfg.momentum)
+
+
+def make_adamw(
+    lr, weight_decay: float, moments_dtype: str = "float32"
+) -> optax.GradientTransformation:
+    """AdamW with both moments stored in ``moments_dtype``.
+
+    The single construction point shared by the Trainer and the fit
+    analyzer (checks/fit.py) -- the fit report certifies the real
+    step, so the two must not drift. ``"bfloat16"`` halves
+    optimizer-state HBM (the documented unlock for 70B-class models
+    on 16 GiB chips, REPORT_70b_128chip_2M.md): optax's ``mu_dtype``
+    covers mu, and :func:`_cast_nu` stores nu in bf16 as well. The
+    moment *math* stays fp32 -- the stored carries promote against
+    the fp32 gradient inside scale_by_adam; only the carry rounds.
+    """
+    if moments_dtype == "bfloat16":
+        return _cast_nu(
+            optax.adamw(
+                lr, weight_decay=weight_decay, mu_dtype=jnp.bfloat16
+            ),
+            jnp.bfloat16,
+        )
+    if moments_dtype != "float32":
+        raise ValueError(
+            f"adam_moments_dtype {moments_dtype!r} (float32|bfloat16)"
+        )
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def _cast_nu(tx: optax.GradientTransformation, dtype):
+    """Store the Adam second moment in ``dtype`` across steps.
+
+    Wraps init/update to round ``ScaleByAdamState.nu`` after each
+    update; the inner transform's arithmetic runs at its own (fp32)
+    precision because the stored nu promotes on first use."""
+    is_adam = lambda s: isinstance(s, optax.ScaleByAdamState)  # noqa: E731
+
+    def cast(state):
+        return jax.tree.map(
+            lambda s: s._replace(
+                nu=jax.tree.map(lambda a: a.astype(dtype), s.nu)
+            ) if is_adam(s) else s,
+            state,
+            is_leaf=is_adam,
+        )
+
+    def init(params):
+        return cast(tx.init(params))
+
+    def update(updates, state, params=None):
+        new_updates, new_state = tx.update(updates, state, params)
+        return new_updates, cast(new_state)
+
+    return optax.GradientTransformation(init, update)
 
 
 def make_step_fn(
