@@ -266,8 +266,8 @@ def bench_llama_pp(
     # apples to oranges.
     v = (
         2
-        if schedule == "interleaved" and 1 < n_stages and
-        8 % (2 * n_stages) == 0
+        if schedule in ("interleaved", "interleaved-1f1b")
+        and 1 < n_stages and 8 % (2 * n_stages) == 0
         else 1
     )
     model_cfg = ptx.PipeConfig(
@@ -324,6 +324,12 @@ def bench_llama_pp(
         "value": round(tokens_per_s / jax.device_count(), 1),
         "unit": "tokens/s/chip",
         "vs_baseline": 1.0,
+        # Self-describing: the interleaved schedules degenerate to
+        # v=1 when the 8-layer bench model cannot split into 2*S
+        # chunks (e.g. 8 stages) -- a record without this field would
+        # present a duplicate of the 1f1b row as interleaved evidence.
+        "n_chunks": v,
+        "bubble_fraction": round(bubble, 4),
     }
 
 
@@ -428,6 +434,8 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
         ("llama-sp zigzag ring", ["--workload", "llama-sp", "--sp-mode", "zigzag"]),
         ("llama-sp ulysses", ["--workload", "llama-sp", "--sp-mode", "ulysses"]),
         ("llama-pp 1f1b", ["--workload", "llama-pp", "--pp-schedule", "1f1b"]),
+        ("llama-pp interleaved-1f1b",
+         ["--workload", "llama-pp", "--pp-schedule", "interleaved-1f1b"]),
         ("llama-long seq 8192", ["--workload", "llama-long"]),
         ("unet ddp", ["--workload", "unet"]),
     ]
@@ -516,7 +524,8 @@ def main() -> int:
         default="zigzag",
     )
     ap.add_argument(
-        "--pp-schedule", choices=("gpipe", "1f1b", "interleaved"),
+        "--pp-schedule",
+        choices=("gpipe", "1f1b", "interleaved", "interleaved-1f1b"),
         default="1f1b"
     )
     ap.add_argument("--pp-microbatches", type=int, default=8)

@@ -131,3 +131,47 @@ def test_hybrid_emits_both_families(params, tokens, devices):
     )
     assert sig["all-gather"] > 0, sig
     assert sig["all-reduce"] + sig["reduce-scatter"] > 0, sig
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "interleaved-1f1b"])
+def test_pp_custom_backwards_emit_ring_permutes_only(devices, schedule):
+    """The custom_vjp pipeline backwards: activations and cotangents
+    move by collective-permute ring hops -- no all-to-alls (a
+    resharding fallback would mean the stacked-stage layout broke) and
+    no all-gathers (stage params must stay device-local)."""
+    from tpu_hpc.models import pipeline_transformer as ptx
+    from tpu_hpc.parallel import pp
+
+    mesh = build_mesh(MeshSpec(axes={"pipe": 4}), devices=jax.devices()[:4])
+    v = 2 if schedule == "interleaved-1f1b" else 1
+    cfg = ptx.PipeConfig(
+        vocab_size=64, dim=32, n_heads=2, n_stages=4 * v,
+        layers_per_stage=1, max_seq_len=16,
+    )
+    p = ptx.init_pipeline_transformer(jax.random.key(0), cfg)
+    pipe = pp.pipelined(
+        ptx.make_stage_fn(cfg), mesh, axis="pipe",
+        schedule=schedule, n_chunks=v,
+    )
+
+    def loss(params, tokens, targets):
+        from tpu_hpc.models import losses
+
+        xs = ptx.embed(params, pp.microbatch(tokens, 4), cfg)
+        stacked = (
+            pp.interleave_stacked(params["stages"], 4)
+            if v == 2 else params["stages"]
+        )
+        logits = ptx.head(params, pipe(stacked, xs), cfg)
+        return losses.cross_entropy(logits, pp.microbatch(targets, 4))
+
+    tokens = jax.random.randint(
+        jax.random.key(1), (8, 16), 0, 64, jnp.int32
+    )
+    sig = _signature(
+        jax.grad(loss), p, tokens,
+        jax.random.randint(jax.random.key(2), (8, 16), 0, 64, jnp.int32),
+    )
+    assert sig["collective-permute"] > 0, sig
+    assert sig["all-to-all"] == 0, sig
+    assert sig["all-gather"] == 0, sig

@@ -295,3 +295,22 @@ class TestTuning:
         proc = run_in_sim_subprocess(["-c", code], 2, timeout=180)
         assert proc.returncode == 0, proc.stderr[-800:]
         assert "TUNED_OK 2" in proc.stdout
+
+
+class TestMemoryBoundProfile:
+    def test_force_profile_overrides_existing_flag(self):
+        """memory-bound exists to flip the scheduler flag an earlier
+        collective-overlap export set to true -- under plain
+        user-wins dedup it would silently no-op in exactly that
+        scenario, so it must override."""
+        from tpu_hpc.runtime import tuning
+
+        pre = tuning.tuning_env("collective-overlap", base={})
+        env = tuning.tuning_env("memory-bound", base=pre)
+        merged = env["LIBTPU_INIT_ARGS"]
+        assert "--xla_tpu_enable_latency_hiding_scheduler=false" in merged
+        assert "--xla_tpu_enable_latency_hiding_scheduler=true" not in merged
+        names = [t.split("=", 1)[0] for t in merged.split()]
+        assert len(names) == len(set(names))
+        # Unrelated flags from the earlier export survive.
+        assert "--xla_tpu_enable_async_collective_fusion=true" in merged

@@ -60,7 +60,27 @@ PROFILES: Dict[str, Dict[str, str]] = {
             "--xla_tpu_data_parallel_opt_different_sized_ops=true"
         ),
     },
+    # Jobs at the edge of HBM: the latency-hiding scheduler buys
+    # overlap by prefetching gathered params/collective buffers, which
+    # RAISES the peak watermark -- measured on the 70B virtual-topology
+    # compiles, where hoisted FSDP all-gathers ballooned temps ~10x
+    # (REPORT_70b_128chip_2M.md evidence table). Turn it off when a
+    # config OOMs by a sliver; re-enable once grad-accum/bf16-moments
+    # restore headroom, because the overlap is real throughput.
+    "memory-bound": {
+        "LIBTPU_INIT_ARGS": (
+            "--xla_tpu_enable_latency_hiding_scheduler=false"
+        ),
+    },
 }
+
+# Profiles whose flags OVERRIDE a pre-existing env value instead of
+# yielding to it. memory-bound exists to flip a flag the overlap
+# profiles (or a launcher's default export) already set to true --
+# under the usual user-wins merge it would silently no-op in exactly
+# its headline scenario (sliver-OOM after running with
+# collective-overlap exported).
+_FORCE_PROFILES = frozenset({"memory-bound"})
 
 
 def _flag_name(token: str) -> str:
@@ -73,22 +93,32 @@ def tuning_env(
     base: Optional[Dict[str, str]] = None,
 ) -> Dict[str, str]:
     """The env additions for ``profile``, merged over ``base``
-    (defaults to ``os.environ``). User-set flags win by construction:
-    any preset flag whose name already appears in the existing value is
-    dropped before merging, so the result never contains a duplicate
-    flag and correctness does not depend on libtpu parsing duplicates
-    in any particular order."""
+    (defaults to ``os.environ``). Pre-existing flags win by
+    construction -- any preset flag whose name already appears in the
+    existing value is dropped before merging -- EXCEPT for the
+    override profiles (``_FORCE_PROFILES``), whose whole purpose is to
+    flip a flag an earlier profile export set: there the preset wins
+    and the conflicting existing token is dropped. Either way the
+    result never contains a duplicate flag, so correctness does not
+    depend on libtpu parsing duplicates in any particular order."""
     if profile not in PROFILES:
         raise ValueError(
             f"unknown tuning profile {profile!r}; "
             f"available: {sorted(PROFILES)}"
         )
+    force = profile in _FORCE_PROFILES
     src = dict(os.environ if base is None else base)
     out: Dict[str, str] = {}
     for var, flags in PROFILES[profile].items():
         existing = src.get(var, "").strip()
         if not existing:
             out[var] = flags
+            continue
+        if force:
+            preset_names = {_flag_name(t) for t in flags.split()}
+            survivors = [t for t in existing.split()
+                         if _flag_name(t) not in preset_names]
+            out[var] = " ".join(flags.split() + survivors)
             continue
         user_names = {_flag_name(t) for t in existing.split()}
         kept = [t for t in flags.split()
