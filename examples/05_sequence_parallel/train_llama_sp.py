@@ -41,6 +41,7 @@ import argparse
 import sys
 
 import jax
+from jax.sharding import PartitionSpec as P
 
 from tpu_hpc.config import TrainingConfig
 from tpu_hpc.logging_ import get_logger
@@ -61,6 +62,13 @@ def main(argv=None) -> int:
         "--attn", choices=("ring", "zigzag", "ulysses"), default="ring"
     )
     extra.add_argument("--seq-len", type=int, default=512)
+    extra.add_argument(
+        "--fsdp", action="store_true",
+        help="shard params over the data axis (FSDP x context "
+        "parallel): the composition long-context training of >8B "
+        "models needs -- context parallelism alone leaves params "
+        "replicated",
+    )
     ns, _ = extra.parse_known_args(argv)
 
     logger = get_logger()
@@ -109,11 +117,27 @@ def main(argv=None) -> int:
         zigzag_ring=zigzag_ring,
     )
     positions = ds.positions()
+    param_pspecs = None
+    batch_pspec = P("data")
+    if ns.fsdp:
+        from tpu_hpc.parallel import fsdp
+
+        # FSDP x CP: params shard over data, activations stay
+        # sequence-sharded over seq; numerics match the replicated
+        # layout to reduction-order tolerance
+        # (tests/test_sp.py::TestFSDPWithRing).
+        param_pspecs = fsdp.param_pspecs(
+            params, axis="data",
+            axis_size=mesh.shape.get("data", 1),
+        )
+        batch_pspec = P("data", "seq")
     trainer = Trainer(
         cfg,
         mesh,
         llama2.make_forward(model_cfg, constrain, attn_fn, positions),
         params,
+        param_pspecs=param_pspecs,
+        batch_pspec=batch_pspec,
     )
     result = trainer.fit(ds)
     summary = result["epochs"][-1]

@@ -425,7 +425,7 @@ def to_markdown(r: FitResult) -> str:
         "(/root/reference/fsdp_tp/fsdp_tp_example.py:120-187) run at "
         "full scale (its ModelArgs ladder, llama2_model.py:13-16 and "
         "docs/guide/11_choosing_a_strategy.md:109-127), mapped to a "
-        "TPU v4 pod.",
+        "TPU pod.",
         "",
         "## Configuration",
         "",
@@ -462,7 +462,7 @@ def to_markdown(r: FitResult) -> str:
         f"| **total** | **{r.total_bytes:,}** | "
         f"**{r.total_bytes/GIB:.2f}** |",
         "",
-        f"Against **{r.hbm_gib:.0f} GiB** HBM per v4 chip: "
+        f"Against **{r.hbm_gib:.0f} GiB** HBM per chip: "
         f"**{'FITS' if r.fits else 'DOES NOT FIT'}** "
         f"({r.total_bytes/ (r.hbm_gib*GIB) * 100:.1f}% of HBM; "
         f"static {r.static_bytes/GIB:.2f} GiB + activations "
