@@ -182,3 +182,59 @@ def test_topology_compile_emits_reduce_scatter():
     assert r.compile_backend == "tpu-topology:v5e:2x4"
     assert r.collectives["reduce-scatter"] > 0, r.collectives
     assert r.xla_temp_bytes > 0
+
+
+class TestCPLayout:
+    """--layout cp / --cp: the long-context fit model (FSDP over data
+    x ring attention over context)."""
+
+    def test_static_shards_over_data_only(self):
+        cfg = llama2.LlamaConfig(n_layers=2, max_seq_len=8192, remat=True)
+        r = fit.analyze(
+            cfg=cfg, dp=2, tp_size=4, global_batch=4, seq_len=8192,
+            do_compile=False, layout="cp",
+        )
+        assert r.layout == "cp"
+        # Params shard over dp=2 only (no TP axis): per-chip statics
+        # are half the fp32 totals, not an eighth.
+        full = 16 * r.n_params  # params+grads+mu+nu fp32 bytes
+        assert full / 2 * 0.95 < r.static_bytes < full / 2 * 1.10
+        assert set(r.act_bytes) >= {
+            "residual_checkpoints", "block_recompute_live",
+            "lm_head_and_loss",
+        }
+
+    def test_activations_scale_inversely_with_ring(self):
+        cfg = llama2.LlamaConfig(n_layers=2, max_seq_len=8192, remat=True)
+
+        def act_total(cp):
+            r = fit.analyze(
+                cfg=cfg, dp=2, tp_size=cp, global_batch=4,
+                seq_len=8192, do_compile=False, layout="cp",
+            )
+            return sum(r.act_bytes.values())
+
+        # Doubling the ring roughly halves per-chip activations (the
+        # whole point of context parallelism).
+        assert act_total(8) < 0.6 * act_total(4)
+
+    def test_indivisible_seq_rejected(self):
+        cfg = llama2.LlamaConfig(n_layers=2, max_seq_len=100, remat=True)
+        with pytest.raises(ValueError, match="divisible"):
+            fit.analyze(
+                cfg=cfg, dp=2, tp_size=3, global_batch=4, seq_len=100,
+                do_compile=False, layout="cp",
+            )
+
+    def test_cp_step_compiles_on_sim_mesh(self, mesh_2d):
+        """The real Trainer step under the CP layout compiles end-to-end
+        on the sim mesh and shows the ring (collective-permute) +
+        FSDP (all-gather) signature."""
+        cfg = llama2.LlamaConfig(n_layers=2, max_seq_len=512, remat=True)
+        r = fit.analyze(
+            cfg=cfg, dp=2, tp_size=4, global_batch=4, seq_len=512,
+            do_compile=True, layout="cp",
+        )
+        assert r.compiled
+        assert r.collectives["collective-permute"] > 0, r.collectives
+        assert r.collectives["all-gather"] > 0, r.collectives

@@ -104,6 +104,7 @@ class FitResult:
     compile_backend: str = "cpu-sim"  # or "tpu-topology:<name>"
     attn: str = "xla"            # attention path the compile pass used
     moments_dtype: str = "float32"  # AdamW moment storage dtype
+    layout: str = "tp"           # "tp" (FSDPxTP+SP) | "cp" (FSDP x ring)
     compiler_options: Dict[str, str] = dataclasses.field(
         default_factory=dict
     )
@@ -192,6 +193,44 @@ def activation_model(
     }
 
 
+def activation_model_cp(
+    cfg: llama2.LlamaConfig, dp: int, cp: int,
+    global_batch: int, seq_len: int, grad_accum: int = 1,
+) -> Dict[str, int]:
+    """Per-chip activation bytes for the long-context layout: FSDP
+    over ``data``, ring-attention context parallelism over
+    ``context`` (examples/05 --fsdp). The residual stream is
+    sequence-sharded EVERYWHERE (cp_constrain), attention is the ring
+    (O(S/cp) per chip: a device never holds more than its own Q chunk
+    plus the KV chunk passing through), and there is no TP -- heads,
+    FFN and vocab are full-width but only S/cp tokens deep.
+    """
+    bl = global_batch // dp // grad_accum
+    s_loc = seq_len // cp
+    d, hd = cfg.dim, cfg.head_dim
+    h, kv = cfg.n_heads, cfg.kv_heads
+    bf16, f32 = 2, 4
+
+    checkpoints = (cfg.n_layers + 1) * bl * s_loc * d * bf16
+    qkv = bl * s_loc * (h + 2 * kv) * hd * bf16
+    # Ring state: the rotating K/V chunk is double-buffered (current +
+    # in-flight ppermute), and the merge carries an fp32 output
+    # accumulator + LSE.
+    ring_kv = 2 * 2 * bl * s_loc * kv * hd * bf16
+    out_acc = bl * s_loc * h * hd * f32
+    lse = bl * h * s_loc * f32
+    mlp = 2 * bl * s_loc * cfg.ffn_hidden * bf16
+    block_live = 2 * (
+        bl * s_loc * d * bf16 + qkv + ring_kv + out_acc + lse + mlp
+    )
+    head = bl * s_loc * cfg.vocab_size * (2 * bf16 + f32)
+    return {
+        "residual_checkpoints": checkpoints,
+        "block_recompute_live": block_live,
+        "lm_head_and_loss": head,
+    }
+
+
 def _count_collectives(hlo: str) -> Dict[str, int]:
     """Collective op applications in compiled HLO, across backend
     spellings: plain ``op(``, the async pair form ``op-start(`` (the
@@ -231,6 +270,7 @@ def analyze(
     attn: str = "xla",
     compiler_options: Optional[Dict[str, str]] = None,
     moments_dtype: str = "float32",
+    layout: str = "tp",
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
 
@@ -249,14 +289,24 @@ def analyze(
 
     ``attn="flash"`` compiles the production attention path -- the
     Pallas flash kernel under shard_map with heads on the TP axis
-    (tp.make_tp_flash_attn_fn). The default ``"xla"`` einsum path
+    (tp.make_tp_flash_attn_fn), or inside the KV ring with full-width
+    heads under ``layout="cp"``. The default ``"xla"`` einsum path
     materialises per-layer score blocks whose HBM temps dominate at
     seq 4096+ and can overflow a real core's budget that the flash
     kernel's online softmax avoids.
     """
     if cfg is None:
         cfg = llama2.LlamaConfig(max_seq_len=seq_len, remat=True)
-    tp.validate_tp_degree(cfg.n_heads, cfg.kv_heads, tp_size)
+    if layout not in ("tp", "cp"):
+        raise ValueError(f"unknown layout {layout!r} (tp|cp)")
+    axis2 = "model" if layout == "tp" else "context"
+    if layout == "tp":
+        tp.validate_tp_degree(cfg.n_heads, cfg.kv_heads, tp_size)
+    elif seq_len % tp_size:
+        raise ValueError(
+            f"context parallelism needs seq_len {seq_len} divisible "
+            f"by the ring degree {tp_size}"
+        )
     if grad_accum < 1 or global_batch % grad_accum or (
         (global_batch // grad_accum) % dp
     ):
@@ -271,10 +321,19 @@ def analyze(
     n_params = sum(
         int(np.prod(l.shape)) for l in jax.tree.leaves(abstract_params)
     )
-    mesh_axes = {"data": dp, "model": tp_size}
-    specs = hybrid.hybrid_pspecs(
-        abstract_params, tp.llama_rules(), data_size=dp
-    )
+    mesh_axes = {"data": dp, axis2: tp_size}
+    if layout == "cp":
+        # Long-context layout: pure FSDP over data (the context axis
+        # carries activations, not params).
+        from tpu_hpc.parallel import fsdp as fsdp_mod
+
+        specs = fsdp_mod.param_pspecs(
+            abstract_params, axis="data", axis_size=dp
+        )
+    else:
+        specs = hybrid.hybrid_pspecs(
+            abstract_params, tp.llama_rules(), data_size=dp
+        )
     # The Trainer's own AdamW construction (shared helper, so the fit
     # analysis can never drift from the step it certifies); bf16
     # moments halve the opt-state rows below -- the documented unlock
@@ -285,9 +344,14 @@ def analyze(
     opt_abstract = jax.eval_shape(optimizer.init, abstract_params)
     opt_specs = derived_pspecs(opt_abstract, abstract_params, specs)
 
-    act = activation_model(
-        cfg, dp, tp_size, global_batch, seq_len, grad_accum
-    )
+    if layout == "cp":
+        act = activation_model_cp(
+            cfg, dp, tp_size, global_batch, seq_len, grad_accum
+        )
+    else:
+        act = activation_model(
+            cfg, dp, tp_size, global_batch, seq_len, grad_accum
+        )
     grad_bytes = tree_bytes_per_chip(abstract_params, specs, mesh_axes)
     if grad_accum > 1:
         # The fp32 gradient-sum carry coexists with each microbatch's
@@ -302,6 +366,7 @@ def analyze(
         act_bytes=act,
         grad_accum=grad_accum,
         moments_dtype=moments_dtype,
+        layout=layout,
     )
     if attn not in ("xla", "flash"):
         raise ValueError(f"unknown attn {attn!r} (xla|flash)")
@@ -341,27 +406,40 @@ def analyze(
     # distant, which v5e's limited ICI routing rejects outright for
     # async collective-permutes.
     mesh = build_mesh(
-        MeshSpec(axes={"data": dp, "model": tp_size}),
+        MeshSpec(axes={"data": dp, axis2: tp_size}),
         devices=devices[:n_dev],
     )
-    constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
-    if attn == "flash":
-        # impl pinned to "pallas": in a topology AOT compile no
-        # backend is initialized, so blockwise_attention's "auto"
-        # would pick the XLA path and silently defeat the point.
-        attn_fn = tp.make_tp_flash_attn_fn(
-            mesh, "data", "model",
-            impl="pallas" if tpu_topology else "auto",
+    impl = "pallas" if tpu_topology else "auto"
+    if layout == "cp":
+        from tpu_hpc.parallel import ring_attention as ra
+
+        constrain = ra.cp_constrain(mesh, "data", "context")
+        attn_fn = ra.make_ring_attn_fn(
+            mesh, "data", "context",
+            impl=impl if attn == "flash" else "xla",
         )
+        batch_spec = P("data", "context")
     else:
-        attn_fn = None  # "xla": the model's einsum path (validated above)
+        constrain = tp.sp_constrain(
+            mesh, dp_axis="data", sp_axis="model"
+        )
+        if attn == "flash":
+            # impl pinned to "pallas": in a topology AOT compile no
+            # backend is initialized, so blockwise_attention's "auto"
+            # would pick the XLA path and silently defeat the point.
+            attn_fn = tp.make_tp_flash_attn_fn(
+                mesh, "data", "model", impl=impl,
+            )
+        else:
+            attn_fn = None  # "xla": the model's einsum path
+        batch_spec = P("data", None)
     forward = llama2.make_forward(cfg, constrain, attn_fn)
     micro_constrain = None
     if grad_accum > 1:
         from tpu_hpc.train.trainer import make_microbatch_constrain
 
         micro_constrain = make_microbatch_constrain(
-            mesh, NamedSharding(mesh, P("data", None))
+            mesh, NamedSharding(mesh, batch_spec)
         )
 
     step = make_step_fn(
@@ -386,7 +464,7 @@ def analyze(
         for _ in range(2)
     )
     batch_shardings = tuple(
-        NamedSharding(mesh, P("data", None)) for _ in range(2)
+        NamedSharding(mesh, batch_spec) for _ in range(2)
     )
     t0 = time.time()
     compiled = (
@@ -416,9 +494,14 @@ def to_markdown(r: FitResult) -> str:
     act_total = sum(r.act_bytes.values())
     chips = r.dp * r.tp_size
     size_b = f"{r.n_params/1e9:.0f}B"
+    strategy = (
+        "hybrid FSDPxTP(+SP)" if r.layout == "tp"
+        else "FSDP x ring-attention context parallel"
+    )
+    axis2 = "model" if r.layout == "tp" else "context"
     lines = [
-        f"# {size_b} shard/fit analysis -- Llama-2 hybrid FSDPxTP(+SP) "
-        f"on a {chips}-chip (data={r.dp} x model={r.tp_size}) mesh",
+        f"# {size_b} shard/fit analysis -- Llama-2 {strategy} "
+        f"on a {chips}-chip (data={r.dp} x {axis2}={r.tp_size}) mesh",
         "",
         "Produced by `python -m tpu_hpc.checks.fit`. Capability anchor "
         "(BASELINE.md): the reference's hybrid example "
@@ -433,8 +516,15 @@ def to_markdown(r: FitResult) -> str:
         f"heads={cfg.n_heads} (kv {cfg.kv_heads}), "
         f"ffn_hidden={cfg.ffn_hidden}, "
         f"vocab={cfg.vocab_size} -> **{r.n_params/1e9:.2f}B params**",
-        f"- mesh: (data={r.dp}, model={r.tp_size}) = {r.dp*r.tp_size} "
-        "chips (FSDP over `data`, Megatron TP+SP over `model`)",
+        f"- mesh: (data={r.dp}, {axis2}={r.tp_size}) = "
+        f"{r.dp*r.tp_size} chips "
+        + (
+            "(FSDP over `data`, Megatron TP+SP over `model`)"
+            if r.layout == "tp"
+            else "(FSDP over `data`, ring attention over `context`: "
+            f"each chip holds {r.seq_len//r.tp_size} of "
+            f"{r.seq_len} tokens)"
+        ),
         f"- batch: global {r.global_batch} sequences x {r.seq_len} "
         f"tokens (per-chip batch {r.global_batch//r.dp}"
         + (
@@ -448,7 +538,9 @@ def to_markdown(r: FitResult) -> str:
         "",
         "| Component | Bytes | GiB |",
         "|---|---|---|",
-        f"| params (fp32, FSDPxTP-sharded) | {r.param_bytes:,} | "
+        f"| params (fp32, "
+        f"{'FSDPxTP-sharded' if r.layout == 'tp' else 'FSDP-sharded'}) "
+        f"| {r.param_bytes:,} | "
         f"{r.param_bytes/GIB:.2f} |",
         f"| gradients (fp32, same layout) | {r.grad_bytes:,} | "
         f"{r.grad_bytes/GIB:.2f} |",
@@ -469,9 +561,16 @@ def to_markdown(r: FitResult) -> str:
         f"{act_total/GIB:.2f} GiB).",
         "",
         "Static accounting is exact (eval_shape + the PartitionSpec "
-        "plan); the activation rows are the analytic model described in "
-        "`tpu_hpc/checks/fit.py:activation_model` (remat-per-block, "
-        "SP-sharded residual checkpoints, flash attention).",
+        "plan); the activation rows are the analytic model described "
+        + (
+            "in `tpu_hpc/checks/fit.py:activation_model` "
+            "(remat-per-block, SP-sharded residual checkpoints, flash "
+            "attention)."
+            if r.layout == "tp" else
+            "in `tpu_hpc/checks/fit.py:activation_model_cp` "
+            "(remat-per-block, context-sharded residual stream, "
+            "double-buffered KV ring, full-width FFN/vocab)."
+        ),
     ]
     if r.compiled:
         lines += [
@@ -512,12 +611,19 @@ def to_markdown(r: FitResult) -> str:
         # XLA legalizes reduce-scatter to all-reduce+slice, so a
         # reduce-scatter count of 0 there is a backend artifact, and
         # the fixed "matches the plan" sentence would overstate it.
+        plan = (
+            "all-gathers for FSDP param gathering + SP boundary "
+            "gathers, reduce-scatter/all-reduce pairs for the TP "
+            "block reductions and FSDP gradient scatter."
+            if r.layout == "tp" else
+            "collective-permutes for the KV ring rotation, "
+            "all-gathers for FSDP param gathering, "
+            "reduce-scatter/all-reduce for the FSDP gradient "
+            "reduction."
+        )
         if r.collectives.get("reduce-scatter", 0) > 0:
             conclusion = (
-                "The signature matches the plan: all-gathers for "
-                "FSDP param gathering + SP boundary gathers, "
-                "reduce-scatter/all-reduce pairs for the TP block "
-                "reductions and FSDP gradient scatter."
+                "The signature matches the plan: " + plan
                 + (
                     " This is the real TPU lowering (libtpu compiled "
                     "against the virtual topology), so the "
@@ -528,13 +634,13 @@ def to_markdown(r: FitResult) -> str:
             )
         else:
             conclusion = (
-                "All-gathers cover FSDP param gathering + SP boundary "
-                "gathers as planned; every TP/FSDP reduction was "
-                "legalized to all-reduce by this backend "
-                "(reduce-scatter: 0 -- on the CPU simulator XLA "
-                "lowers reduce-scatter to all-reduce+slice, so this "
-                "compile does not evidence the reduce-scatter form; "
-                "an on-TPU compile is needed for that)."
+                "The planned signature is: " + plan
+                + " Every reduction was legalized to all-reduce by "
+                "this backend (reduce-scatter: 0 -- on the CPU "
+                "simulator XLA lowers reduce-scatter to "
+                "all-reduce+slice, so this compile does not evidence "
+                "the reduce-scatter form; an on-TPU compile is "
+                "needed for that)."
             )
         lines += ["", conclusion]
     return "\n".join(lines) + "\n"
@@ -610,6 +716,11 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--dp", type=int, default=4)
     parser.add_argument("--tp", type=int, default=8)
+    parser.add_argument("--cp", type=int, default=0,
+                        help="context-parallel ring degree: switches "
+                        "to the long-context layout (FSDP over data x "
+                        "ring attention over context; no TP) and "
+                        "replaces --tp as the second mesh axis")
     parser.add_argument("--global-batch", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=4096)
     parser.add_argument("--hbm-gib", type=float, default=32.0)
@@ -637,7 +748,9 @@ def main(argv=None) -> int:
                         default="xla",
                         help="attention path for the compile pass: "
                         "'flash' = the production Pallas kernel under "
-                        "shard_map (heads on the TP axis)")
+                        "shard_map (heads on the TP axis; under --cp "
+                        "it runs inside the KV ring with full-width "
+                        "heads)")
     parser.add_argument("--moments-dtype",
                         choices=("float32", "bfloat16"),
                         default="float32",
@@ -664,7 +777,7 @@ def main(argv=None) -> int:
     if not args.no_compile and args.tpu_topology is None:
         from tpu_hpc.runtime import sim
 
-        n_dev = args.dp * args.tp
+        n_dev = args.dp * (args.cp or args.tp)
         if not sim.backends_initialized():
             sim.force_sim_devices(n_dev)
         elif len(jax.devices()) < n_dev:
@@ -684,13 +797,14 @@ def main(argv=None) -> int:
     if args.layers is not None:
         cfg = dataclasses.replace(cfg, n_layers=args.layers)
     r = analyze(
-        cfg=cfg, dp=args.dp, tp_size=args.tp,
+        cfg=cfg, dp=args.dp, tp_size=args.cp or args.tp,
         global_batch=args.global_batch, seq_len=args.seq_len,
         hbm_gib=args.hbm_gib, do_compile=not args.no_compile,
         grad_accum=args.grad_accum, tpu_topology=args.tpu_topology,
         attn=args.attn,
         compiler_options=_parse_xla_opts(args.xla_opt),
         moments_dtype=args.moments_dtype,
+        layout="cp" if args.cp else "tp",
     )
     md = to_markdown(r)
     if args.markdown:
