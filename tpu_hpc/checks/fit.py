@@ -318,9 +318,7 @@ def analyze(
     abstract_params = jax.eval_shape(
         lambda: llama2.init_llama(jax.random.key(0), cfg)
     )
-    n_params = sum(
-        int(np.prod(l.shape)) for l in jax.tree.leaves(abstract_params)
-    )
+    n_params = llama2.count_params(cfg)
     mesh_axes = {"data": dp, axis2: tp_size}
     if layout == "cp":
         # Long-context layout: pure FSDP over data (the context axis

@@ -1,0 +1,324 @@
+"""Roofline step-time estimator: compute/memory/comm bounds per config.
+
+The quantitative half of docs/guide/11_choosing_a_strategy.md: before
+spending pod-hours, answer "is this (model, mesh, batch) compute-,
+memory-, or communication-bound, and what MFU can it possibly reach?"
+The reference chooses strategies by rules of thumb
+(/root/reference/docs/guide/11_choosing_a_strategy.md:109-127); this
+module makes the choice a calculation, using the standard
+ring-collective cost model (time = bytes * (n-1)/n / link_bw) over
+public per-chip specs.
+
+Three lower bounds per step, reported with their breakdown:
+
+  * **compute**: model FLOPs / (peak * chips) -- the 6ND convention
+    via ``LlamaConfig.flops_per_token`` (what MFU is measured against).
+  * **memory**: bytes every chip must move through HBM at least once
+    per step (param reads fwd+bwd, gradient writes, AdamW state
+    read+write, checkpointed activations write+read) / HBM bandwidth.
+  * **comm**: per-strategy collective bytes over the slowest-axis ICI
+    link bandwidth -- FSDP param gathers + gradient reduce-scatter
+    over ``data``, TP/SP block reductions over ``model``, or the KV
+    ring over ``context``.
+
+``step_time_lower_bound = max(compute, memory, comm)`` -- a *bound*,
+not a prediction: a perfect schedule overlaps the three, a real one
+adds gaps (the measured single-chip bench runs at ~0.65 of its
+compute-bound MFU ceiling after non-matmul work; see
+docs/guide/xla_performance_notes.md's step budget).
+
+Validated against the round-2 measured numbers: the single-chip bench
+config's bounds bracket the observed 76 ms step
+(tests/test_roofline.py).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from typing import Dict, Optional
+
+from tpu_hpc.models import llama2
+
+GIB = 1024 ** 3
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Approximate public per-chip numbers (spec sheets / the public
+    scaling literature); ici_gbps is ONE link, one direction."""
+
+    name: str
+    peak_bf16_flops: float
+    hbm_gib: float   # capacity context for readers; the fit analyzer
+    #                  owns does-it-fit, this module owns how-fast
+    hbm_gbps: float
+    ici_gbps: float
+
+
+CHIPS: Dict[str, ChipSpec] = {
+    "v4": ChipSpec("v4", 275e12, 32, 1228, 50),
+    "v5e": ChipSpec("v5e", 197e12, 16, 819, 45),
+    "v5p": ChipSpec("v5p", 459e12, 95, 2765, 100),
+    "v6e": ChipSpec("v6e", 918e12, 32, 1640, 90),
+}
+
+
+def _ring_collective_s(bytes_full: int, n: int, bw_gbps: float) -> float:
+    """Ring all-gather/reduce-scatter time: every chip sends/receives
+    (n-1)/n of the full buffer over one link (bidirectional rings halve
+    this; we keep the conservative single-direction figure)."""
+    if n <= 1:
+        return 0.0
+    return bytes_full * (n - 1) / n / (bw_gbps * 1e9)
+
+
+@dataclasses.dataclass
+class RooflineResult:
+    chip: ChipSpec
+    dp: int
+    axis2: int                  # tp or cp degree
+    layout: str                 # "tp" | "cp" | "dp" (axis2 == 1)
+    global_batch: int
+    seq_len: int
+    grad_accum: int
+    tokens_per_step: int
+    compute_s: float
+    memory_s: float
+    comm_s: float
+    comm_breakdown: Dict[str, float]
+    memory_breakdown: Dict[str, float]
+
+    @property
+    def chips(self) -> int:
+        return self.dp * self.axis2
+
+    @property
+    def step_time_lower_bound_s(self) -> float:
+        return max(self.compute_s, self.memory_s, self.comm_s)
+
+    @property
+    def bound(self) -> str:
+        t = self.step_time_lower_bound_s
+        if t == self.compute_s:
+            return "compute"
+        return "memory" if t == self.memory_s else "comm"
+
+    @property
+    def mfu_upper_bound(self) -> float:
+        return self.compute_s / self.step_time_lower_bound_s
+
+    @property
+    def tokens_per_s_per_chip_bound(self) -> float:
+        return (
+            self.tokens_per_step
+            / self.step_time_lower_bound_s
+            / self.chips
+        )
+
+
+def estimate(
+    cfg: Optional[llama2.LlamaConfig] = None,
+    chip: str = "v5e",
+    dp: int = 1,
+    axis2: int = 1,
+    layout: str = "tp",
+    global_batch: int = 4,
+    seq_len: Optional[int] = None,
+    grad_accum: int = 1,
+    moments_dtype: str = "float32",
+) -> RooflineResult:
+    """Roofline bounds for one training step of the Llama family.
+
+    ``layout="tp"``: hybrid FSDP(data) x Megatron-TP+SP(model).
+    ``layout="cp"``: FSDP(data) x ring-attention context(axis2).
+    ``axis2=1`` degenerates to DP/FSDP-only either way.
+    """
+    if cfg is None:
+        cfg = llama2.LlamaConfig()
+    c = CHIPS[chip]
+    s = seq_len or cfg.max_seq_len
+    n_chips = dp * axis2
+    tokens = global_batch * s
+    if grad_accum < 1 or global_batch % (dp * grad_accum):
+        # Same contract as fit.analyze: a silently truncated bl would
+        # zero the activation/comm terms and the tool would name a
+        # binding constraint for a configuration that cannot run.
+        raise ValueError(
+            f"global_batch {global_batch} must divide into dp {dp} x "
+            f"grad_accum {grad_accum} microbatch rows"
+        )
+    n_params = llama2.count_params(cfg)
+
+    # -- compute bound (the MFU denominator) --
+    compute_s = (
+        tokens * cfg.flops_per_token(s) / (c.peak_bf16_flops * n_chips)
+    )
+
+    # -- memory bound: per-chip HBM bytes each step must move --
+    shard = dp * (axis2 if layout == "tp" else 1)  # param shard ways
+    p_local = n_params / shard
+    bf16, f32 = 2, 4
+    mom = 2 if moments_dtype == "bfloat16" else 4
+    bl = global_batch // dp
+    s_loc = s // axis2 if layout == "cp" else s // max(axis2, 1)
+    mem = {
+        # bf16 params read once per fwd and once per bwd per microbatch
+        "param_reads": grad_accum * 2 * p_local * bf16,
+        "grad_write_and_opt": p_local * (f32 + 2 * (f32 + mom)),
+        # checkpointed residuals written in fwd, read in bwd
+        "activation_checkpoints": (
+            2 * (cfg.n_layers + 1) * bl * s_loc * cfg.dim * bf16
+        ),
+        "logits_roundtrip": 2 * bl * s_loc * cfg.vocab_size * bf16,
+    }
+    memory_s = sum(mem.values()) / (c.hbm_gbps * 1e9)
+
+    # -- comm bound: per-axis terms; the bound takes the MAX because
+    # different axes ride disjoint ICI links (to_markdown says so) --
+    comm: Dict[str, float] = {}
+    if dp > 1:
+        # FSDP: bf16 param gathers fwd+bwd per microbatch + one fp32
+        # gradient reduce-scatter per step.
+        gather_bytes = grad_accum * 2 * n_params / (
+            axis2 if layout == "tp" else 1
+        ) * bf16
+        rs_bytes = n_params / (axis2 if layout == "tp" else 1) * f32
+        comm["fsdp_data_axis"] = _ring_collective_s(
+            int(gather_bytes + rs_bytes), dp, c.ici_gbps
+        )
+    if axis2 > 1 and layout == "tp":
+        # Megatron-SP: RS+AG pair twice per layer fwd and twice bwd on
+        # [bl_micro, s, d] bf16 activations, once per microbatch --
+        # totals the same bytes as one full-batch pass, so use the
+        # whole per-row batch `bl` exactly once (NOT bl * grad_accum:
+        # the microbatches each carry 1/grad_accum of the rows).
+        act_bytes = bl * s * cfg.dim * bf16
+        comm["tp_model_axis"] = (
+            cfg.n_layers * 4 * 2
+            * _ring_collective_s(act_bytes, axis2, c.ici_gbps)
+        )
+    if axis2 > 1 and layout == "cp":
+        # KV ring, three full rotations per layer: forward, the
+        # backward's remat recompute of the forward ring, and the
+        # dk/dv cotangent return ring. Same whole-batch-once
+        # accounting as above.
+        kv_bytes = 2 * bl * s_loc * cfg.kv_heads * cfg.head_dim * bf16
+        hop = kv_bytes / (c.ici_gbps * 1e9)
+        comm["kv_ring_context_axis"] = (
+            cfg.n_layers * 3 * (axis2 - 1) * hop
+        )
+    comm_s = max(comm.values()) if comm else 0.0
+
+    return RooflineResult(
+        chip=c, dp=dp, axis2=axis2,
+        layout=layout if axis2 > 1 else "dp",
+        global_batch=global_batch, seq_len=s, grad_accum=grad_accum,
+        tokens_per_step=tokens,
+        compute_s=compute_s, memory_s=memory_s, comm_s=comm_s,
+        comm_breakdown=comm, memory_breakdown=mem,
+    )
+
+
+def to_markdown(r: RooflineResult, cfg: llama2.LlamaConfig) -> str:
+    ms = 1e3
+    lines = [
+        f"# Roofline -- {r.chips}x {r.chip.name} "
+        f"(data={r.dp} x {r.layout}={r.axis2}), "
+        f"batch {r.global_batch} x seq {r.seq_len}"
+        + (f", accum {r.grad_accum}" if r.grad_accum > 1 else ""),
+        "",
+        f"Model: dim={cfg.dim}, layers={cfg.n_layers}, "
+        f"{cfg.flops_per_token(r.seq_len)/1e6:.0f} MFLOP/token.",
+        "",
+        "| bound | time/step | detail |",
+        "|---|---|---|",
+        f"| compute | {r.compute_s*ms:.2f} ms | model FLOPs at "
+        f"{r.chip.peak_bf16_flops/1e12:.0f} TF/chip peak |",
+        f"| memory | {r.memory_s*ms:.2f} ms | "
+        + ", ".join(
+            f"{k} {v/GIB:.2f} GiB" for k, v in r.memory_breakdown.items()
+        )
+        + f" at {r.chip.hbm_gbps:.0f} GB/s |",
+        f"| comm | {r.comm_s*ms:.2f} ms | "
+        + (
+            ", ".join(
+                f"{k} {v*ms:.2f} ms" for k, v in r.comm_breakdown.items()
+            )
+            if r.comm_breakdown else "single chip: none"
+        )
+        + " |",
+        "",
+        f"**Binding constraint: {r.bound}.** Step time >= "
+        f"{r.step_time_lower_bound_s*ms:.2f} ms -> MFU <= "
+        f"{r.mfu_upper_bound:.1%}, throughput <= "
+        f"{r.tokens_per_s_per_chip_bound:,.0f} tokens/s/chip.",
+        "",
+        "Bounds assume perfect overlap within each category and none "
+        "across categories; a measured step lands between the max and "
+        "the sum. Axis collectives ride disjoint ICI links, so only "
+        "the slowest axis is counted in the comm bound.",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--model", choices=sorted(llama2.PRESETS), default=None)
+    p.add_argument("--layers", type=int, default=None)
+    p.add_argument("--chip", choices=sorted(CHIPS), default="v5e")
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--cp", type=int, default=0,
+                   help="ring/context degree (switches layout to cp)")
+    p.add_argument("--global-batch", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=None)
+    p.add_argument("--grad-accum", type=int, default=1)
+    p.add_argument("--moments-dtype", default="float32",
+                   choices=("float32", "bfloat16"))
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    import dataclasses as dc
+
+    cfg = (
+        llama2.PRESETS[args.model] if args.model
+        else llama2.LlamaConfig(
+            dim=1024, n_layers=8, n_heads=8, vocab_size=32000,
+            multiple_of=256, max_seq_len=2048,
+        )  # the bench model
+    )
+    if args.seq_len:
+        cfg = dc.replace(cfg, max_seq_len=args.seq_len)
+    if args.layers:
+        cfg = dc.replace(cfg, n_layers=args.layers)
+    r = estimate(
+        cfg, chip=args.chip, dp=args.dp,
+        axis2=args.cp or args.tp,
+        layout="cp" if args.cp else "tp",
+        global_batch=args.global_batch,
+        seq_len=args.seq_len or cfg.max_seq_len,
+        grad_accum=args.grad_accum,
+        moments_dtype=args.moments_dtype,
+    )
+    if args.json:
+        print(json.dumps({
+            "bound": r.bound,
+            "step_time_lower_bound_ms":
+                round(r.step_time_lower_bound_s * 1e3, 3),
+            "mfu_upper_bound": round(r.mfu_upper_bound, 4),
+            "tokens_per_s_per_chip_bound":
+                round(r.tokens_per_s_per_chip_bound, 1),
+            "compute_ms": round(r.compute_s * 1e3, 3),
+            "memory_ms": round(r.memory_s * 1e3, 3),
+            "comm_ms": round(r.comm_s * 1e3, 3),
+        }))
+    else:
+        print(to_markdown(r, cfg))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -165,6 +165,22 @@ PRESETS: Dict[str, LlamaConfig] = {
 }
 
 
+def count_params(cfg: "LlamaConfig") -> int:
+    """Total trainable parameters for ``cfg``, via eval_shape of the
+    real init (no arrays materialized). The single source both
+    checks/fit.py (HBM accounting) and checks/roofline.py (memory
+    bound) divide by -- two copies would silently disagree the day
+    the param tree changes."""
+    import numpy as np
+
+    abstract = jax.eval_shape(
+        lambda: init_llama(jax.random.key(0), cfg)
+    )
+    return sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(abstract)
+    )
+
+
 def rope_cos_sin(
     seq_len: int,
     head_dim: int,
