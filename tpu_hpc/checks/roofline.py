@@ -136,6 +136,8 @@ def estimate(
     """
     if cfg is None:
         cfg = llama2.LlamaConfig()
+    if layout not in ("tp", "cp"):
+        raise ValueError(f"unknown layout {layout!r} (tp|cp)")
     c = CHIPS[chip]
     s = seq_len or cfg.max_seq_len
     n_chips = dp * axis2
@@ -147,6 +149,11 @@ def estimate(
         raise ValueError(
             f"global_batch {global_batch} must divide into dp {dp} x "
             f"grad_accum {grad_accum} microbatch rows"
+        )
+    if s % max(axis2, 1):
+        raise ValueError(
+            f"seq_len {s} must be divisible by the second mesh axis "
+            f"{axis2} (fit.analyze rejects the same configuration)"
         )
     n_params = llama2.count_params(cfg)
 
