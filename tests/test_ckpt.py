@@ -221,3 +221,54 @@ def test_sigterm_snapshots_and_stops(mesh8, setup):
     tr2 = _trainer(cfg_model, params, ms, mesh8, ckpt_dir, dp.param_pspecs,
                    epochs=5, resume=True)
     assert tr2.maybe_resume() == 4
+
+
+def test_restore_fp32_checkpoint_into_bf16_moments_run(tmp_path, mesh8):
+    """Switching adam_moments_dtype to bfloat16 mid-training (the
+    16 GiB-chip unlock, REPORT_70b_128chip_2M.md) must restore an
+    existing fp32-moments checkpoint: orbax casts into the template's
+    dtype, training continues, and the moments stay bf16."""
+    import optax
+
+    from tpu_hpc.models import llama2
+
+    mesh = mesh8
+    m = llama2.LlamaConfig(
+        dim=32, n_layers=1, n_heads=4, vocab_size=64,
+        multiple_of=16, max_seq_len=16,
+    )
+    params = llama2.init_llama(jax.random.key(0), m)
+    ds = datasets.TokenStream(vocab_size=64, seq_len=16)
+    d = str(tmp_path / "ck")
+
+    cfg32 = TrainingConfig(
+        global_batch_size=8, steps_per_epoch=2, epochs=1,
+        weight_decay=0.1, save_every=1, learning_rate=1e-2,
+    )
+    Trainer(
+        cfg32, mesh, llama2.make_forward(m), params,
+        checkpoint_manager=CheckpointManager(d, async_save=False),
+    ).fit(ds)
+
+    cfg16 = TrainingConfig(
+        global_batch_size=8, steps_per_epoch=2, epochs=2,
+        weight_decay=0.1, resume=True, learning_rate=1e-2,
+        adam_moments_dtype="bfloat16",
+    )
+    t16 = Trainer(
+        cfg16, mesh, llama2.make_forward(m), params,
+        checkpoint_manager=CheckpointManager(d, async_save=False),
+    )
+    out = t16.fit(ds)
+    assert jnp.isfinite(out["final_loss"])
+    adam = [
+        s for s in jax.tree.leaves(
+            t16.state.opt_state,
+            is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState),
+        )
+        if isinstance(s, optax.ScaleByAdamState)
+    ]
+    assert adam
+    for s in adam:
+        for leaf in jax.tree.leaves(s.mu) + jax.tree.leaves(s.nu):
+            assert leaf.dtype == jnp.bfloat16
