@@ -46,7 +46,8 @@ def resolve_batch_accum(batch, accum, microbatch: int):
     microbatch accumulated 8x (batch = microbatch x accum, so an
     explicit --grad-accum-steps alone sweeps the accum lever at
     CONSTANT microbatch -- the lever-table protocol in
-    docs/guide/xla_performance_notes.md section 5); with an explicit
+    docs/guide/xla_performance_notes.md, ceiling-budget subsection of
+    the measured case study); with an explicit
     --batch and no --grad-accum-steps, run it unaccumulated (--batch 4
     reproduces the round-2 headline unchanged). ``0`` is passed
     through to the Trainer's own validation rather than silently

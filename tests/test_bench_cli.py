@@ -1,0 +1,61 @@
+"""The benchmark CLI's batch/accum default policy.
+
+bench.py is the driver-facing artifact entry point; its CLI policy
+(resolve_batch_accum) decides what configuration every recorded number
+describes. The invariants pinned here are the lever-table protocol
+from docs/guide/xla_performance_notes.md (measured case study,
+ceiling-budget subsection): sweeping
+--grad-accum-steps alone holds the microbatch constant, and an
+explicit --batch alone reproduces the unaccumulated config.
+"""
+import importlib.util
+import pathlib
+
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+
+
+@pytest.fixture(scope="module")
+def bench():
+    # Import by path: bench.py is a repo-root script, not a package
+    # module, and importing it must not initialize a backend.
+    spec = importlib.util.spec_from_file_location("bench_cli", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_default_is_microbatch_times_accum8(bench):
+    assert bench.resolve_batch_accum(None, None, microbatch=4) == (32, 8)
+    assert bench.resolve_batch_accum(None, None, microbatch=1) == (8, 8)
+
+
+def test_accum_sweep_holds_microbatch_constant(bench):
+    # The lever-table protocol: batch scales with accum so every
+    # sweep point runs the measured-best microbatch.
+    for accum in (1, 2, 4, 8, 16):
+        batch, got = bench.resolve_batch_accum(None, accum, microbatch=4)
+        assert got == accum
+        assert batch // accum == 4
+    batch, got = bench.resolve_batch_accum(None, 8, microbatch=1)
+    assert (batch, got) == (8, 8)
+
+
+def test_explicit_batch_runs_unaccumulated(bench):
+    # --batch 4 alone must reproduce the round-2 headline config.
+    assert bench.resolve_batch_accum(4, None, microbatch=4) == (4, 1)
+    assert bench.resolve_batch_accum(16, None, microbatch=1) == (16, 1)
+
+
+def test_explicit_batch_and_accum_pass_through(bench):
+    assert bench.resolve_batch_accum(16, 4, microbatch=4) == (16, 4)
+
+
+def test_invalid_accum_reaches_trainer_validation(bench):
+    # 0 is not silently replaced: it flows to the Trainer, whose
+    # config validation rejects it loudly (trainer.py grad_accum >= 1).
+    _, accum = bench.resolve_batch_accum(None, 0, microbatch=4)
+    assert accum == 0
+    _, accum = bench.resolve_batch_accum(8, 0, microbatch=4)
+    assert accum == 0

@@ -97,3 +97,39 @@ def test_cli_json(capsys):
     out = json.loads(capsys.readouterr().out)
     assert out["bound"] in ("compute", "memory", "comm")
     assert out["step_time_lower_bound_ms"] > 0
+
+
+def test_estimate_accepts_chip_spec_instance():
+    # A ChipSpec (e.g. host-calibrated measured rates) can replace the
+    # CHIPS-key lookup; derated rates must move the bounds accordingly.
+    spec = roofline.CHIPS["v5e"]
+    import dataclasses
+    derated = dataclasses.replace(
+        spec, name="v5e-measured",
+        peak_bf16_flops=spec.peak_bf16_flops * 0.5,
+        hbm_gbps=spec.hbm_gbps * 0.5,
+    )
+    base = roofline.estimate(BENCH, chip=spec, global_batch=4)
+    slow = roofline.estimate(BENCH, chip=derated, global_batch=4)
+    assert slow.compute_s == pytest.approx(2 * base.compute_s)
+    assert slow.memory_s == pytest.approx(2 * base.memory_s)
+    assert slow.chip.name == "v5e-measured"
+
+
+def test_measured_chip_spec_substitutes_microbench_rates(monkeypatch):
+    # The calibration path swaps in the microbench's measured matmul
+    # and HBM rates, keeps spec ICI/capacity, and tags the name --
+    # verified against fixed fake rates (the real microbench needs a
+    # real chip; its marginal-rate protocol is hardware-timing based).
+    from tpu_hpc.checks import env_check
+
+    monkeypatch.setattr(
+        env_check, "chip_microbench",
+        lambda: {"matmul_tflops": 192.0, "hbm_gb_s": 657.0},
+    )
+    spec = roofline.measured_chip_spec(roofline.CHIPS["v5e"])
+    assert spec.name == "v5e-measured"
+    assert spec.peak_bf16_flops == pytest.approx(192.0e12)
+    assert spec.hbm_gbps == pytest.approx(657.0)
+    assert spec.ici_gbps == roofline.CHIPS["v5e"].ici_gbps
+    assert spec.hbm_gib == roofline.CHIPS["v5e"].hbm_gib
