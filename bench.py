@@ -63,6 +63,7 @@ def bench_llama(
     attn: str = "flash", block_q: int = 512, block_k: int = 512,
     seq_len: int = 2048, grad_accum_steps: int = 1,
     moments_dtype: str = "float32",
+    block_q_bwd: int = None, block_k_bwd: int = None,
 ) -> dict:
     """Best measured single-chip config (v5e) -- what the CLI runs by
     default (the *function* defaults are the unaccumulated round-2
@@ -104,6 +105,7 @@ def bench_llama(
         return tp.make_tp_flash_attn_fn(
             mesh, "data", "model" if tp_size > 1 else None,
             block_q=block_q, block_k=block_k,
+            block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
         )
 
     tp_size = tp.auto_tp_degree(
@@ -562,6 +564,10 @@ def main() -> int:
     ap.add_argument("--attn", choices=("flash", "xla"), default="flash")
     ap.add_argument("--block-q", type=int, default=512)
     ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument("--block-q-bwd", type=int, default=None,
+                    help="backward-kernel q tiling (default: --block-q)")
+    ap.add_argument("--block-k-bwd", type=int, default=None,
+                    help="backward-kernel k tiling (default: --block-k)")
     ap.add_argument(
         "--sp-mode", choices=("ring", "zigzag", "ulysses"),
         default="zigzag",
@@ -613,6 +619,7 @@ def main() -> int:
             args.block_q, args.block_k, seq_len=args.seq_len or 2048,
             grad_accum_steps=accum,
             moments_dtype=args.moments_dtype,
+            block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
         )
     elif args.workload == "llama-sp":
         batch, accum = resolve_batch_accum(

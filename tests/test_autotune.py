@@ -1,0 +1,118 @@
+"""Flash-attention autotuner + independent backward tiling.
+
+The backward dq/dkv kernels may be tiled independently of the forward
+(blockwise_attention block_q_bwd/block_k_bwd). Invariants: tiling is
+a schedule choice, never a numerics choice -- gradients must be
+identical across tilings -- and the autotuner must rank candidates by
+measured time with honest records.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_hpc.kernels import autotune
+from tpu_hpc.kernels.attention import blockwise_attention
+
+B, S, H, D = 2, 256, 2, 64
+
+
+def _qkv(seed=0):
+    kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
+    shape = (B, S, H, D)
+    return (
+        jax.random.normal(kq, shape, jnp.float32),
+        jax.random.normal(kk, shape, jnp.float32),
+        jax.random.normal(kv, shape, jnp.float32),
+    )
+
+
+def _grads(block_q_bwd, block_k_bwd):
+    q, k, v = _qkv()
+
+    def loss(q, k, v):
+        out, _ = blockwise_attention(
+            q, k, v, causal=True, impl="pallas_interpret",
+            block_q=128, block_k=128,
+            block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
+        )
+        return jnp.sum(out * out)
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+def test_bwd_tiling_is_numerics_invariant():
+    base = _grads(None, None)
+    for bq, bk in ((256, 128), (128, 256), (256, 256)):
+        other = _grads(bq, bk)
+        for g0, g1 in zip(base, other):
+            assert jnp.allclose(g0, g1, atol=1e-5), (bq, bk)
+
+
+def test_autotune_ranks_and_records():
+    records = autotune.autotune(
+        seq_len=S, batch=B, n_heads=H, head_dim=D,
+        mode="grad", candidates=((128, 128), (128, 256)),
+        iters=2, impl="pallas_interpret",
+    )
+    assert len(records) == 2
+    times = [r.ms_per_call for r in records]
+    assert times == sorted(times)
+    md = autotune.to_markdown(
+        records, seq_len=S, batch=B, n_heads=H, kv_heads=H,
+        head_dim=D, device_kind="cpu-interpret",
+    )
+    assert "Best:" in md and "ms/call" in md
+
+
+def test_autotune_sweep_bwd_appends_pinned_fwd_rows():
+    records = autotune.autotune(
+        seq_len=S, batch=B, n_heads=H, head_dim=D,
+        mode="grad", candidates=((128, 128), (256, 256)),
+        sweep_bwd=True, iters=1, impl="pallas_interpret",
+    )
+    # 2 shared-tiling rows + 1 bwd-only row (the best fwd pair is
+    # skipped as already measured).
+    assert len(records) == 3
+    bwd_rows = [r for r in records if r.block_q_bwd is not None]
+    assert len(bwd_rows) == 1
+    # The bwd-only row must pin its forward tiling to the FASTEST
+    # shared-tiling pair.
+    best_shared = min(
+        (r for r in records if r.block_q_bwd is None),
+        key=lambda r: r.ms_per_call,
+    )
+    assert (bwd_rows[0].block_q, bwd_rows[0].block_k) == (
+        best_shared.block_q, best_shared.block_k
+    )
+    # And its bwd pair is the other candidate (the best pair itself is
+    # skipped as already measured with shared tiling).
+    assert (bwd_rows[0].block_q_bwd, bwd_rows[0].block_k_bwd) != (
+        best_shared.block_q, best_shared.block_k
+    )
+
+
+def test_autotune_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        autotune.autotune(
+            seq_len=S, batch=B, n_heads=H, head_dim=D, mode="bogus",
+            candidates=((128, 128),), iters=1, impl="pallas_interpret",
+        )
+
+
+def test_autotune_rejects_no_fitting_candidate():
+    with pytest.raises(ValueError, match="no candidate fits"):
+        autotune.autotune(
+            seq_len=128, batch=B, n_heads=H, head_dim=D,
+            candidates=((256, 256),), iters=1, impl="pallas_interpret",
+        )
+
+
+def test_autotune_warns_on_fwd_sweep_bwd(capsys):
+    records = autotune.autotune(
+        seq_len=S, batch=B, n_heads=H, head_dim=D,
+        mode="fwd", sweep_bwd=True, candidates=((128, 128),),
+        iters=1, impl="pallas_interpret",
+    )
+    # The no-op is visible, and no bwd rows were appended.
+    assert "ignoring" in capsys.readouterr().err
+    assert all(r.block_q_bwd is None for r in records)

@@ -5,3 +5,6 @@ from tpu_hpc.kernels.attention import (  # noqa: F401
     lse_merge,
     MASK_VALUE,
 )
+# NOTE: the autotuner is used as a module (tpu_hpc.kernels.autotune)
+# -- re-exporting its like-named function here would shadow the
+# module attribute for `from tpu_hpc.kernels import autotune`.

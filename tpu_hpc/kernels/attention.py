@@ -317,17 +317,20 @@ def _flash_forward(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11)
 )
 def flash_attention(
     q, k, v, q_offset, kv_offset,
     causal=True, sm_scale=None, block_q=512, block_k=512,
-    interpret=False,
+    interpret=False, block_q_bwd=None, block_k_bwd=None,
 ):
     """Pallas flash attention: (out, lse), same contract as
-    ``attention_reference``. Backward rematerialises through the
-    reference path (correct everywhere; a dedicated bwd kernel is a
-    planned optimisation)."""
+    ``attention_reference``. Gradients come from the hand-written
+    Pallas dq/dkv kernels below (_flash_bwd) -- no forward recompute,
+    no [S, S] buffer. ``block_q_bwd``/``block_k_bwd`` tile the
+    backward kernels independently of the forward (None = same as
+    forward; the backward's dkv kernel transposes the score block, so
+    its best tiling can differ -- see kernels/autotune.py)."""
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     return _flash_forward(
         q, k, v, q_offset, kv_offset,
@@ -337,15 +340,18 @@ def flash_attention(
 
 
 def _flash_fwd(q, k, v, q_offset, kv_offset,
-               causal, sm_scale, block_q, block_k, interpret):
+               causal, sm_scale, block_q, block_k, interpret,
+               block_q_bwd, block_k_bwd):
     out, lse = flash_attention(
         q, k, v, q_offset, kv_offset,
         causal, sm_scale, block_q, block_k, interpret,
+        block_q_bwd, block_k_bwd,
     )
     return (out, lse), (q, k, v, out, lse, q_offset, kv_offset)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret,
+               block_q_bwd, block_k_bwd,
                residuals, grads):
     """Backward from saved (out, lse) via the Pallas dq/dkv kernels --
     the standard flash-attention gradient identities with no forward
@@ -362,7 +368,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret,
     dq, dk, dv = _flash_backward(
         q, k, v, out, lse, dout, dlse, q_offset, kv_offset,
         causal=causal, sm_scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q_bwd or block_q, block_k=block_k_bwd or block_k,
+        interpret=interpret,
     )
     return dq, dk, dv, None, None
 
@@ -639,10 +646,14 @@ def blockwise_attention(
     impl: str = "auto",
     block_q: int = 512,
     block_k: int = 512,
+    block_q_bwd: Optional[int] = None,
+    block_k_bwd: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Chunk attention with LSE; ``impl`` in {auto, xla, pallas,
     pallas_interpret}. ``auto`` picks the Pallas kernel on TPU and the
-    XLA path elsewhere (CPU-simulated meshes in tests)."""
+    XLA path elsewhere (CPU-simulated meshes in tests).
+    ``block_q_bwd``/``block_k_bwd`` tile the backward kernels
+    independently (None = same as forward)."""
     if q.shape[2] % k.shape[2]:
         # Checked here for BOTH impls: the Pallas index maps would
         # otherwise silently read cross-batch / clamped KV heads.
@@ -663,5 +674,6 @@ def blockwise_attention(
             jnp.asarray(kv_offset, jnp.int32),
             causal, sm_scale, block_q, block_k,
             impl == "pallas_interpret",
+            block_q_bwd, block_k_bwd,
         )
     raise ValueError(f"unknown attention impl: {impl!r}")

@@ -140,6 +140,8 @@ def make_tp_flash_attn_fn(
     impl: str = "auto",
     block_q: int = 512,
     block_k: int = 512,
+    block_q_bwd: Optional[int] = None,
+    block_k_bwd: Optional[int] = None,
 ) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
     """The Pallas flash kernel under tensor parallelism: heads shard
     over ``tp_axis``, batch over ``dp_axis``, full sequence per shard.
@@ -164,6 +166,7 @@ def make_tp_flash_attn_fn(
         out, _ = blockwise_attention(
             q, k, v, causal=causal, impl=impl,
             block_q=block_q, block_k=block_k,
+            block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
         )
         return out
 
