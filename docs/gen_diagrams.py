@@ -375,6 +375,189 @@ def tp_col_row(T=2):
     _save(fig, "tp_col_row.svg")
 
 
+def ulysses_all_to_all(C=4, H=8):
+    """DeepSpeed-Ulysses head/sequence exchange: the all_to_all turns
+    seq-sharded/all-heads into head-sharded/full-seq and back
+    (sp_ulysses.py). Tiles are colored by the device that OWNED them
+    before the exchange, so the shuffle is visible."""
+    fig, axes = plt.subplots(1, 2, figsize=(10.2, 3.4),
+                             gridspec_kw={"wspace": 0.35})
+    hp = H // C  # heads per device after the exchange
+    for ax, phase in ((axes[0], "before"), (axes[1], "after")):
+        for d in range(C):          # device row
+            for c in range(C):      # seq-chunk column
+                for g in range(C):  # head-group sub-column
+                    if phase == "before":
+                        owner, visible = d, (c == d)
+                    else:
+                        owner, visible = c, (g == d)
+                    x = c * (C + 0.6) + g
+                    ax.add_patch(Rectangle(
+                        (x, d * 1.3), 0.92, 1,
+                        facecolor=MB_COLORS[owner % len(MB_COLORS)],
+                        alpha=0.9 if visible else 0.12,
+                        edgecolor=EDGE, lw=0.5,
+                    ))
+                    if visible:
+                        ax.text(x + 0.46, d * 1.3 + 0.5,
+                                f"s{c}\nh{g * hp}-{g * hp + hp - 1}",
+                                ha="center", va="center", fontsize=5.6,
+                                color="white")
+            ax.text(-0.7, d * 1.3 + 0.5, f"d{d}", ha="right",
+                    va="center", fontsize=9)
+        ax.set_xlim(-1.6, C * (C + 0.6))
+        ax.set_ylim(C * 1.3, -0.6)
+        ax.axis("off")
+        ax.set_title(
+            "before: seq chunk s_d, ALL heads" if phase == "before"
+            else f"after all_to_all: FULL seq, heads {hp}/device",
+            fontsize=9.5, loc="left",
+        )
+    fig.suptitle(
+        f"Ulysses sequence parallelism ({C} devices, {H} heads): one "
+        "all_to_all scatters heads / gathers sequence before "
+        "attention; the inverse follows it (sp_ulysses.py)",
+        fontsize=10, x=0.01, ha="left",
+    )
+    _save(fig, "ulysses_all_to_all.svg")
+
+
+def ring_attention_rotation(C=4):
+    """Ring attention's KV rotation: C-1 ppermute hops; every device
+    sees every KV block once, merging partials by LSE
+    (ring_attention.py)."""
+    fig, ax = plt.subplots(figsize=(9.6, 3.1))
+    for step in range(C):
+        x0 = step * (C * 0.62 + 1.5)
+        for d in range(C):
+            kv = (d - step) % C
+            ax.add_patch(Rectangle(
+                (x0 + d * 0.62, 0), 0.56, 0.9,
+                facecolor=MB_COLORS[kv], alpha=0.9,
+                edgecolor=EDGE, lw=0.6,
+            ))
+            ax.text(x0 + d * 0.62 + 0.28, 0.45, f"kv{kv}",
+                    ha="center", va="center", fontsize=6.5,
+                    color="white")
+            ax.text(x0 + d * 0.62 + 0.28, -0.28, f"d{d}", ha="center",
+                    fontsize=6.5, color="#555")
+        ax.text(x0 + C * 0.31, 1.25,
+                f"step {step}:\nattn(q_d, kv_{{d-{step}}})",
+                ha="center", fontsize=7.5)
+        if step < C - 1:
+            ax.annotate(
+                "", xy=(x0 + C * 0.62 + 1.1, 0.45),
+                xytext=(x0 + C * 0.62 + 0.15, 0.45),
+                arrowprops=dict(arrowstyle="->", color="#D55E00",
+                                lw=1.6),
+            )
+            ax.text(x0 + C * 0.62 + 0.62, 0.72, "ppermute",
+                    ha="center", fontsize=6.5, color="#D55E00")
+    ax.set_xlim(-0.4, C * (C * 0.62 + 1.5))
+    ax.set_ylim(-0.8, 2.1)
+    ax.axis("off")
+    ax.set_title(
+        f"Ring attention ({C} devices): KV blocks rotate one hop per "
+        "step; each device merges C partial attentions exactly via "
+        "online-softmax LSE (lse_merge), overlapping the hop with "
+        "compute", fontsize=10, loc="left",
+    )
+    _save(fig, "ring_attention.svg")
+
+
+def fsdp_step_flow():
+    """One FULL_SHARD training step as a comm/compute timeline
+    (fsdp.py + the trainer's donated-state jit)."""
+    fig, ax = plt.subplots(figsize=(10.4, 2.7))
+    stages = [
+        ("all-gather\nparams (bf16)", "#56B4E9", 1.5),
+        ("forward\n(sharded batch)", "#0072B2", 2.4),
+        ("all-gather\nparams (bf16)", "#56B4E9", 1.5),
+        ("backward", "#0072B2", 2.9),
+        ("reduce-scatter\ngrads (fp32)", "#CC79A7", 1.7),
+        ("AdamW on\nLOCAL shard", "#009E73", 1.6),
+    ]
+    x = 0.0
+    for label, color, w in stages:
+        ax.add_patch(Rectangle((x, 0), w - 0.12, 1, facecolor=color,
+                               alpha=0.88, edgecolor=EDGE, lw=0.7))
+        ax.text(x + (w - 0.12) / 2, 0.5, label, ha="center",
+                va="center", fontsize=8, color="white")
+        x += w
+    ax.annotate("", xy=(3.9, 1.45), xytext=(0.7, 1.45),
+                arrowprops=dict(arrowstyle="->", color="#777", lw=1.1))
+    ax.text(2.3, 1.62, "XLA prefetches the NEXT layer's gather under "
+            "this layer's compute (latency-hiding scheduler)",
+            ha="center", fontsize=7.5, color="#555")
+    ax.text(x - 1.0, -0.42,
+            "params/grads/opt state never exist whole on any chip",
+            ha="right", fontsize=8, color="#444")
+    ax.set_xlim(-0.2, x + 0.3)
+    ax.set_ylim(-0.7, 2.0)
+    ax.axis("off")
+    ax.set_title(
+        "FULL_SHARD step: per-layer bf16 gathers ride ICI, one fp32 "
+        "reduce-scatter per step, optimizer touches only the local "
+        "1/N shard", fontsize=10, loc="left",
+    )
+    _save(fig, "fsdp_step_flow.svg")
+
+
+def multislice_mesh(nslices=2, nx=2, ny=2):
+    """Multi-slice topology: ICI torus inside each slice, DCN between
+    slices; the hybrid mesh maps model/data axes accordingly
+    (runtime/mesh.py multi-slice MeshSpec)."""
+    fig, ax = plt.subplots(figsize=(8.8, 4.2))
+    gap = nx + 1.6
+    for s in range(nslices):
+        x_off = s * gap
+        ax.add_patch(Rectangle(
+            (x_off - 0.55, -0.55), nx - 1 + 1.1, ny - 1 + 1.1,
+            facecolor="none", edgecolor="#999", lw=1.2, ls=":",
+        ))
+        ax.text(x_off + (nx - 1) / 2, ny - 1 + 0.75,
+                f"slice {s} (ICI torus)", ha="center", fontsize=8.5,
+                color="#666")
+        for x in range(nx):
+            for y in range(ny):
+                ax.add_patch(Rectangle(
+                    (x_off + x - 0.26, y - 0.26), 0.52, 0.52,
+                    facecolor=MB_COLORS[s], alpha=0.88,
+                    edgecolor=EDGE, zorder=3,
+                ))
+                if x + 1 < nx:
+                    ax.plot([x_off + x + 0.26, x_off + x + 0.74],
+                            [y, y], color="#999", lw=1.4)
+                if y + 1 < ny:
+                    ax.plot([x_off + x, x_off + x],
+                            [y + 0.26, y + 0.74], color="#999", lw=1.4)
+    for y in range(ny):
+        ax.annotate(
+            "", xy=(gap - 0.65, y), xytext=(nx - 1 + 0.35, y),
+            arrowprops=dict(arrowstyle="<->", color="#D55E00", lw=1.5),
+        )
+    ax.text((gap + nx - 1) / 2 - 0.15, ny - 0.4, "DCN",
+            ha="center", fontsize=9, color="#D55E00", weight="bold")
+    ax.text(
+        (gap + nx - 1) / 2 - 0.15, -1.05,
+        'axes={"data": slices x ..., "model": intra-slice}:\n'
+        "TP/SP collectives stay on ICI; only the per-step FSDP/DP "
+        "gradient reduction crosses DCN",
+        ha="center", fontsize=8.5, color="#444",
+    )
+    ax.set_xlim(-1.1, gap * nslices - 1.0)
+    ax.set_ylim(-1.7, ny + 0.6)
+    ax.set_aspect("equal")
+    ax.axis("off")
+    ax.set_title(
+        "Multi-slice mesh: bandwidth-hungry axes inside the slice, "
+        "bandwidth-tolerant axis across DCN (the reference's "
+        "NVLink-intra / Slingshot-inter doctrine, TPU edition)",
+        fontsize=10, loc="left",
+    )
+    _save(fig, "multislice_mesh.svg")
+
+
 if __name__ == "__main__":
     pipeline_schedules()
     mesh_torus()
@@ -382,3 +565,7 @@ if __name__ == "__main__":
     halo_exchange()
     fsdp_modes()
     tp_col_row()
+    ulysses_all_to_all()
+    ring_attention_rotation()
+    fsdp_step_flow()
+    multislice_mesh()
