@@ -1,6 +1,8 @@
 from tpu_hpc.native.dataloader import (  # noqa: F401
     NativeERA5Stream,
     NativeFileDataset,
+    NativeTokenDataset,
     native_available,
     write_dataset,
+    write_token_dataset,
 )

@@ -340,6 +340,130 @@ class FileDataset {
   std::unique_ptr<Prefetcher> prefetcher_;
 };
 
+// ---------------------------------------------------------------------------
+// Token corpus: mmap'd flat binary of token ids (uint16 or uint32),
+// sliced into seq_len+1 windows with the same per-epoch Feistel
+// shuffle. The LLM-pretraining counterpart of FileDataset: the
+// reference trains its Llama on random tokens
+// (scripts/04_pipeline_parallel_pp/03_pipeline_training.py:220-230);
+// a real corpus is a token stream on disk, and this reader turns it
+// into deterministic (inputs, targets) next-token batches with zero
+// Python in the hot path.
+//
+// Format (tpu_hpc/native/dataloader.py:write_token_dataset):
+//   uint64 magic 'TPUHPCT1'
+//   uint64 n_tokens, uint64 token_bytes (2|4), uint64 reserved
+//   n_tokens ids, little-endian, token_bytes each.
+//
+// Outputs are int32 written through the float* ring buffers as raw
+// bit patterns (memcpy punning -- the ring only moves bytes); the
+// Python side reinterprets. Window w covers tokens
+// [w*S, w*S + S]: inputs = first S, targets = last S (shift by one).
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t kTokenMagic = 0x3154435048555054ULL;  // "TPUHPCT1" LE
+
+class TokenDataset {
+ public:
+  TokenDataset(const char* path, int64_t batch, int64_t seq_len,
+               uint64_t seed, int depth, int n_threads)
+      : batch_(batch), seq_(seq_len), seed_(seed) {
+    if (seq_ <= 0 || batch_ <= 0) return;  // ok_ stays false; a 0
+    // seq_len would otherwise SIGFPE the n_windows_ division below.
+    fd_ = open(path, O_RDONLY);
+    if (fd_ < 0) return;
+    struct stat st;
+    if (fstat(fd_, &st) != 0) return;
+    size_ = static_cast<size_t>(st.st_size);
+    base_ = static_cast<const uint8_t*>(
+        mmap(nullptr, size_, PROT_READ, MAP_PRIVATE, fd_, 0));
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      return;
+    }
+    const uint64_t* hdr = reinterpret_cast<const uint64_t*>(base_);
+    if (size_ < 4 * sizeof(uint64_t) || hdr[0] != kTokenMagic) return;
+    n_tokens_ = static_cast<int64_t>(hdr[1]);
+    tok_bytes_ = static_cast<int64_t>(hdr[2]);
+    if (tok_bytes_ != 2 && tok_bytes_ != 4) return;
+    const size_t need = 4 * sizeof(uint64_t) +
+        static_cast<size_t>(n_tokens_) * tok_bytes_;
+    if (size_ < need) return;
+    data_ = base_ + 4 * sizeof(uint64_t);
+    // Each window needs seq_len + 1 tokens (the shifted target).
+    n_windows_ = (n_tokens_ - 1) / seq_;
+    if (n_windows_ <= 0) return;
+    ok_ = true;
+    prefetcher_.reset(new Prefetcher(
+        batch * seq_, batch * seq_,
+        [this](int64_t step, float* x, float* y) { Fill(step, x, y); },
+        depth, n_threads));
+  }
+
+  ~TokenDataset() {
+    prefetcher_.reset();
+    if (base_ != nullptr && base_ != MAP_FAILED) munmap(
+        const_cast<uint8_t*>(base_), size_);
+    if (fd_ >= 0) close(fd_);
+  }
+
+  bool ok() const { return ok_; }
+  int64_t n_tokens() const { return n_tokens_; }
+  int64_t n_windows() const { return n_windows_; }
+
+  void Fill(int64_t step, float* xf, float* yf) {
+    int32_t* x = reinterpret_cast<int32_t*>(xf);
+    int32_t* y = reinterpret_cast<int32_t*>(yf);
+    uint64_t cur_epoch =
+        static_cast<uint64_t>(step) * batch_ / n_windows_;
+    EpochShuffle shuffle(seed_, cur_epoch, n_windows_);
+    for (int64_t b = 0; b < batch_; ++b) {
+      const uint64_t pos = static_cast<uint64_t>(step) * batch_ + b;
+      const uint64_t epoch = pos / n_windows_;
+      if (epoch != cur_epoch) {
+        cur_epoch = epoch;
+        shuffle = EpochShuffle(seed_, cur_epoch, n_windows_);
+      }
+      const int64_t w = static_cast<int64_t>(shuffle(pos % n_windows_));
+      CopyWindow(w, x + b * seq_, y + b * seq_);
+    }
+  }
+
+ private:
+  void CopyWindow(int64_t w, int32_t* x, int32_t* y) const {
+    const int64_t start = w * seq_;
+    if (tok_bytes_ == 2) {
+      const uint16_t* t =
+          reinterpret_cast<const uint16_t*>(data_) + start;
+      for (int64_t i = 0; i < seq_; ++i) {
+        x[i] = static_cast<int32_t>(t[i]);
+        y[i] = static_cast<int32_t>(t[i + 1]);
+      }
+    } else {
+      const uint32_t* t =
+          reinterpret_cast<const uint32_t*>(data_) + start;
+      for (int64_t i = 0; i < seq_; ++i) {
+        x[i] = static_cast<int32_t>(t[i]);
+        y[i] = static_cast<int32_t>(t[i + 1]);
+      }
+    }
+  }
+
+ public:
+  Prefetcher* prefetcher() { return prefetcher_.get(); }
+
+ private:
+  int64_t batch_, seq_;
+  uint64_t seed_;
+  int fd_ = -1;
+  size_t size_ = 0;
+  const uint8_t* base_ = nullptr;
+  const uint8_t* data_ = nullptr;
+  int64_t n_tokens_ = 0, tok_bytes_ = 0, n_windows_ = 0;
+  bool ok_ = false;
+  std::unique_ptr<Prefetcher> prefetcher_;
+};
+
 }  // namespace
 
 extern "C" {
@@ -403,5 +527,43 @@ void file_dataset_seek(void* p, int64_t step) {
 }
 
 void file_dataset_close(void* p) { delete static_cast<FileDataset*>(p); }
+
+// -- token corpus --
+
+void* token_dataset_open(const char* path, int64_t batch,
+                         int64_t seq_len, uint64_t seed, int depth,
+                         int n_threads) {
+  auto* ds = new TokenDataset(path, batch, seq_len, seed, depth,
+                              n_threads);
+  if (!ds->ok()) {
+    delete ds;
+    return nullptr;
+  }
+  return ds;
+}
+
+void token_dataset_info(void* p, int64_t* n_tokens,
+                        int64_t* n_windows) {
+  auto* ds = static_cast<TokenDataset*>(p);
+  *n_tokens = ds->n_tokens();
+  *n_windows = ds->n_windows();
+}
+
+// Synchronous random access; outputs are int32 bit patterns in the
+// float* buffers (see TokenDataset comment).
+void token_dataset_batch(void* p, int64_t step, float* x, float* y) {
+  static_cast<TokenDataset*>(p)->Fill(step, x, y);
+}
+
+int token_dataset_next(void* p, float* x, float* y, int64_t* step_out) {
+  return static_cast<TokenDataset*>(p)->prefetcher()->Next(x, y,
+                                                           step_out);
+}
+
+void token_dataset_seek(void* p, int64_t step) {
+  static_cast<TokenDataset*>(p)->prefetcher()->Seek(step);
+}
+
+void token_dataset_close(void* p) { delete static_cast<TokenDataset*>(p); }
 
 }  // extern "C"
