@@ -8,6 +8,11 @@ layouts, then ZeRO-3 over the data axis. The north-star workload
 ICI axis, FSDP all-gather/reduce-scatter the outer.
 
 Run: python train_llama_hybrid.py --data-parallel 2 --model-parallel 4
+
+Real-corpus mode: ``--tokens-file corpus.tok`` trains from a
+pretokenized mmap'd token binary via the native C++ prefetch reader
+(tpu_hpc.native.write_token_dataset converts any 1D id array once)
+instead of the synthetic TokenStream.
 """
 import os as _os
 import sys as _sys
@@ -33,7 +38,17 @@ from tpu_hpc.train import Trainer
 
 
 def main(argv=None) -> int:
-    cfg = TrainingConfig.from_args(argv)
+    import argparse
+
+    extra = argparse.ArgumentParser(add_help=False)
+    extra.add_argument(
+        "--tokens-file", type=str, default=None,
+        help="train from this pretokenized binary "
+        "(tpu_hpc.native.write_token_dataset) via the native reader "
+        "instead of the synthetic TokenStream",
+    )
+    own, rest = extra.parse_known_args(argv)
+    cfg = TrainingConfig.from_args(rest)
     logger = get_logger()
     init_distributed()  # before any device query (multi-host contract)
     param_dtype, compute_dtype = cfg.jax_dtypes()
@@ -77,9 +92,31 @@ def main(argv=None) -> int:
         specs = fsdp.param_pspecs(params, axis="data", axis_size=dp_size)
         constrain = lambda x: x  # noqa: E731
 
-    ds = datasets.TokenStream(
-        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
-    )
+    if own.tokens_file:
+        from tpu_hpc.native import NativeTokenDataset
+
+        ds = NativeTokenDataset(
+            own.tokens_file, batch_size=cfg.global_batch_size,
+            seq_len=model_cfg.max_seq_len, seed=cfg.seed,
+        )
+        if ds.max_token_id >= model_cfg.vocab_size:
+            # Out-of-range ids would train silently on all-zero
+            # embeddings; the file header carries the corpus max so
+            # this is checkable before the first step.
+            raise SystemExit(
+                f"corpus max token id {ds.max_token_id} >= model "
+                f"vocab_size {model_cfg.vocab_size}: retokenize or "
+                "grow the vocab"
+            )
+        logger.info(
+            "corpus: %s (%d tokens, %d windows of %d)",
+            own.tokens_file, ds.n_tokens, ds.n_windows,
+            model_cfg.max_seq_len,
+        )
+    else:
+        ds = datasets.TokenStream(
+            vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
+        )
     trainer = Trainer(
         cfg,
         mesh,

@@ -239,6 +239,7 @@ class TestTokenDataset:
         path, tokens = corpus_file
         ds = self.make(path)
         assert ds.n_tokens == 257 and ds.n_windows == 32
+        assert ds.max_token_id == 256  # header-carried vocab bound
         starts = set()
         for step in range(8):  # one epoch: 32 windows / batch 4
             bx, by = ds.batch_at(step, 4)
@@ -332,6 +333,31 @@ class TestTokenDataset:
         # division.
         with pytest.raises(ValueError, match="must be positive"):
             NativeTokenDataset(path, batch_size=4, seq_len=0)
+
+    def test_short_corpus_message_names_the_cause(self, corpus_file):
+        from tpu_hpc.native import NativeTokenDataset
+
+        path, _ = corpus_file  # 257 tokens
+        with pytest.raises(ValueError, match="corpus too short"):
+            NativeTokenDataset(path, batch_size=4, seq_len=512)
+        with pytest.raises(FileNotFoundError):
+            NativeTokenDataset(
+                path + ".missing", batch_size=4, seq_len=8
+            )
+
+    def test_corrupt_header_rejected_not_segfault(self, tmp_path):
+        # A huge n_tokens in a tiny file must be a clean rejection
+        # (the overflow-safe capacity check), not an out-of-bounds
+        # mmap read.
+        bad = tmp_path / "huge.tok"
+        hdr = np.asarray(
+            [0x3154435048555054, 1 << 62, 2, 0], np.uint64
+        )
+        with open(bad, "wb") as f:
+            hdr.tofile(f)
+            np.zeros(8, np.uint16).tofile(f)
+        with pytest.raises(ValueError):
+            self.make(str(bad))
 
     def test_trainer_llama_integration(self, mesh8, corpus_file):
         """Train the tiny Llama from a native token file end-to-end:

@@ -105,6 +105,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.token_dataset_info.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
         ]
         lib.token_dataset_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,
@@ -383,8 +384,11 @@ def write_token_dataset(path: str, tokens: np.ndarray) -> str:
     dtype = np.uint16 if hi <= 0xFFFF else np.uint32
     data = np.ascontiguousarray(tokens, dtype)
     with open(path, "wb") as f:
+        # Header word 3 carries the max id so loaders can validate
+        # the corpus against a model's vocab_size at open time.
         np.asarray(
-            [_TOKEN_MAGIC, data.size, data.dtype.itemsize, 0], np.uint64
+            [_TOKEN_MAGIC, data.size, data.dtype.itemsize, hi],
+            np.uint64,
         ).tofile(f)
         data.tofile(f)
     return path
@@ -426,15 +430,37 @@ class NativeTokenDataset(_PrefetchedStream):
             self.seed, self.prefetch_depth, self.n_threads,
         )
         if not self._handle:
+            # The C++ opener only reports "no": distinguish the three
+            # user-facing causes here so a valid-but-short corpus is
+            # not reported as corrupt.
+            if not os.path.exists(self.path):
+                raise FileNotFoundError(self.path)
+            try:
+                hdr = np.fromfile(self.path, np.uint64, count=2)
+            except OSError:
+                hdr = np.zeros(0, np.uint64)
+            if (
+                len(hdr) == 2 and hdr[0] == _TOKEN_MAGIC
+                and int(hdr[1]) <= self.seq_len
+            ):
+                raise ValueError(
+                    f"corpus too short: {int(hdr[1])} tokens cannot "
+                    f"fill one seq_len={self.seq_len} window "
+                    "(needs seq_len + 1)"
+                )
             raise ValueError(
-                f"not a tpu_hpc token dataset: {self.path}"
+                f"not a tpu_hpc token dataset (corrupt header?): "
+                f"{self.path}"
             )
-        nt, nw = ctypes.c_int64(), ctypes.c_int64()
+        nt, nw, mx = (ctypes.c_int64(), ctypes.c_int64(),
+                      ctypes.c_int64())
         lib.token_dataset_info(
-            self._handle, ctypes.byref(nt), ctypes.byref(nw)
+            self._handle, ctypes.byref(nt), ctypes.byref(nw),
+            ctypes.byref(mx),
         )
         self.n_tokens = nt.value
         self.n_windows = nw.value
+        self.max_token_id = mx.value
         self._init_stream()
 
     def _alloc(self):

@@ -221,6 +221,53 @@ class Prefetcher {
 
 constexpr uint64_t kFileMagic = 0x3144435048555054ULL;  // "TPUHPCD1" LE
 
+// Shared mmap lifecycle for the header-plus-records file formats:
+// open/fstat/mmap once, validate the magic, expose header + payload.
+// Both dataset readers delegate here so corrupt-file handling (and
+// fixes to it) exist exactly once.
+struct MappedFile {
+  int fd = -1;
+  size_t size = 0;
+  const uint8_t* base = nullptr;
+  bool ok = false;
+
+  void Open(const char* path, uint64_t magic, int n_header_words) {
+    fd = open(path, O_RDONLY);
+    if (fd < 0) return;
+    struct stat st;
+    if (fstat(fd, &st) != 0) return;
+    size = static_cast<size_t>(st.st_size);
+    base = static_cast<const uint8_t*>(
+        mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0));
+    if (base == MAP_FAILED) {
+      base = nullptr;
+      return;
+    }
+    const size_t hdr_bytes = n_header_words * sizeof(uint64_t);
+    if (size < hdr_bytes) return;
+    if (reinterpret_cast<const uint64_t*>(base)[0] != magic) return;
+    ok = true;
+  }
+
+  const uint64_t* header() const {
+    return reinterpret_cast<const uint64_t*>(base);
+  }
+  const uint8_t* payload(int n_header_words) const {
+    return base + n_header_words * sizeof(uint64_t);
+  }
+  // Payload bytes actually present after the header.
+  size_t payload_bytes(int n_header_words) const {
+    return size - n_header_words * sizeof(uint64_t);
+  }
+
+  ~MappedFile() {
+    if (base != nullptr && base != MAP_FAILED)
+      munmap(const_cast<uint8_t*>(base), size);
+    if (fd >= 0) close(fd);
+  }
+};
+
+
 // Deterministic epoch shuffle without materialising a permutation:
 // a 4-round Feistel network over [0, 2^(2w)) with cycle-walking back
 // into [0, n). Bijective for every (seed, epoch), so each epoch visits
@@ -258,31 +305,50 @@ struct EpochShuffle {
   }
 };
 
+// Per-epoch Feistel-shuffled batch fill: positions advance forever,
+// reshuffling at each epoch boundary (possibly mid-batch). `copy`
+// receives (shuffled_index, slot_in_batch). Shared by both readers so
+// the epoch/key-schedule subtlety lives once. One key schedule per
+// epoch, not per sample (a batch crosses an epoch boundary at most
+// every n/batch steps).
+template <typename CopyFn>
+void FillShuffled(int64_t step, int64_t batch, int64_t n, uint64_t seed,
+                  CopyFn copy) {
+  uint64_t cur_epoch = static_cast<uint64_t>(step) * batch / n;
+  EpochShuffle shuffle(seed, cur_epoch, n);
+  for (int64_t b = 0; b < batch; ++b) {
+    const uint64_t pos = static_cast<uint64_t>(step) * batch + b;
+    const uint64_t epoch = pos / n;
+    if (epoch != cur_epoch) {
+      cur_epoch = epoch;
+      shuffle = EpochShuffle(seed, cur_epoch, n);
+    }
+    copy(static_cast<int64_t>(shuffle(pos % n)), b);
+  }
+}
+
 class FileDataset {
  public:
   FileDataset(const char* path, int64_t batch, uint64_t seed, int depth,
               int n_threads)
       : batch_(batch), seed_(seed) {
-    fd_ = open(path, O_RDONLY);
-    if (fd_ < 0) return;
-    struct stat st;
-    if (fstat(fd_, &st) != 0) return;
-    size_ = static_cast<size_t>(st.st_size);
-    base_ = static_cast<const uint8_t*>(
-        mmap(nullptr, size_, PROT_READ, MAP_PRIVATE, fd_, 0));
-    if (base_ == MAP_FAILED) {
-      base_ = nullptr;
-      return;
-    }
-    const uint64_t* hdr = reinterpret_cast<const uint64_t*>(base_);
-    if (size_ < 4 * sizeof(uint64_t) || hdr[0] != kFileMagic) return;
+    map_.Open(path, kFileMagic, 4);
+    if (!map_.ok) return;
+    const uint64_t* hdr = map_.header();
     n_samples_ = static_cast<int64_t>(hdr[1]);
     x_elems_ = static_cast<int64_t>(hdr[2]);
     y_elems_ = static_cast<int64_t>(hdr[3]);
-    const size_t need = 4 * sizeof(uint64_t) +
-        static_cast<size_t>(n_samples_) * (x_elems_ + y_elems_) * 4;
-    if (size_ < need || n_samples_ <= 0) return;
-    records_ = reinterpret_cast<const float*>(base_ + 4 * sizeof(uint64_t));
+    if (n_samples_ <= 0 || x_elems_ < 0 || y_elems_ < 0) return;
+    const uint64_t rec_bytes =
+        (static_cast<uint64_t>(x_elems_) + y_elems_) * 4;
+    // Overflow-safe capacity check: divide, never multiply -- a
+    // corrupt header with huge counts must reject, not wrap need
+    // around and SIGSEGV on the first out-of-bounds read.
+    if (rec_bytes == 0 ||
+        static_cast<uint64_t>(n_samples_) >
+            map_.payload_bytes(4) / rec_bytes)
+      return;
+    records_ = reinterpret_cast<const float*>(map_.payload(4));
     ok_ = true;
     prefetcher_.reset(new Prefetcher(
         batch * x_elems_, batch * y_elems_,
@@ -292,9 +358,6 @@ class FileDataset {
 
   ~FileDataset() {
     prefetcher_.reset();  // joins workers before the map goes away
-    if (base_ != nullptr && base_ != MAP_FAILED) munmap(
-        const_cast<uint8_t*>(base_), size_);
-    if (fd_ >= 0) close(fd_);
   }
 
   bool ok() const { return ok_; }
@@ -303,27 +366,16 @@ class FileDataset {
   int64_t y_elems() const { return y_elems_; }
 
   // Batch `step` = samples [step*batch, (step+1)*batch) of the
-  // epoch-shuffled stream; epoch = position / n_samples. Wraps
-  // forever, reshuffling each epoch.
+  // epoch-shuffled stream; wraps forever, reshuffling each epoch.
   void Fill(int64_t step, float* x, float* y) {
     const int64_t rec = x_elems_ + y_elems_;
-    // One key schedule per epoch, not per sample (a batch crosses an
-    // epoch boundary at most every n_samples_/batch_ steps).
-    uint64_t cur_epoch =
-        static_cast<uint64_t>(step) * batch_ / n_samples_;
-    EpochShuffle shuffle(seed_, cur_epoch, n_samples_);
-    for (int64_t b = 0; b < batch_; ++b) {
-      const uint64_t pos = static_cast<uint64_t>(step) * batch_ + b;
-      const uint64_t epoch = pos / n_samples_;
-      if (epoch != cur_epoch) {
-        cur_epoch = epoch;
-        shuffle = EpochShuffle(seed_, cur_epoch, n_samples_);
-      }
-      const uint64_t idx = shuffle(pos % n_samples_);
-      const float* r = records_ + idx * rec;
-      std::memcpy(x + b * x_elems_, r, x_elems_ * 4);
-      std::memcpy(y + b * y_elems_, r + x_elems_, y_elems_ * 4);
-    }
+    FillShuffled(
+        step, batch_, n_samples_, seed_,
+        [&](int64_t idx, int64_t b) {
+          const float* r = records_ + idx * rec;
+          std::memcpy(x + b * x_elems_, r, x_elems_ * 4);
+          std::memcpy(y + b * y_elems_, r + x_elems_, y_elems_ * 4);
+        });
   }
 
   Prefetcher* prefetcher() { return prefetcher_.get(); }
@@ -331,9 +383,7 @@ class FileDataset {
  private:
   int64_t batch_;
   uint64_t seed_;
-  int fd_ = -1;
-  size_t size_ = 0;
-  const uint8_t* base_ = nullptr;
+  MappedFile map_;
   const float* records_ = nullptr;
   int64_t n_samples_ = 0, x_elems_ = 0, y_elems_ = 0;
   bool ok_ = false;
@@ -352,8 +402,11 @@ class FileDataset {
 //
 // Format (tpu_hpc/native/dataloader.py:write_token_dataset):
 //   uint64 magic 'TPUHPCT1'
-//   uint64 n_tokens, uint64 token_bytes (2|4), uint64 reserved
+//   uint64 n_tokens, uint64 token_bytes (2|4), uint64 max_token_id
 //   n_tokens ids, little-endian, token_bytes each.
+// max_token_id lets loaders validate a corpus against a model's
+// vocab_size at open time instead of training silently on all-zero
+// embeddings for out-of-range ids.
 //
 // Outputs are int32 written through the float* ring buffers as raw
 // bit patterns (memcpy punning -- the ring only moves bytes); the
@@ -370,26 +423,19 @@ class TokenDataset {
       : batch_(batch), seq_(seq_len), seed_(seed) {
     if (seq_ <= 0 || batch_ <= 0) return;  // ok_ stays false; a 0
     // seq_len would otherwise SIGFPE the n_windows_ division below.
-    fd_ = open(path, O_RDONLY);
-    if (fd_ < 0) return;
-    struct stat st;
-    if (fstat(fd_, &st) != 0) return;
-    size_ = static_cast<size_t>(st.st_size);
-    base_ = static_cast<const uint8_t*>(
-        mmap(nullptr, size_, PROT_READ, MAP_PRIVATE, fd_, 0));
-    if (base_ == MAP_FAILED) {
-      base_ = nullptr;
-      return;
-    }
-    const uint64_t* hdr = reinterpret_cast<const uint64_t*>(base_);
-    if (size_ < 4 * sizeof(uint64_t) || hdr[0] != kTokenMagic) return;
+    map_.Open(path, kTokenMagic, 4);
+    if (!map_.ok) return;
+    const uint64_t* hdr = map_.header();
     n_tokens_ = static_cast<int64_t>(hdr[1]);
     tok_bytes_ = static_cast<int64_t>(hdr[2]);
+    max_token_id_ = static_cast<int64_t>(hdr[3]);
     if (tok_bytes_ != 2 && tok_bytes_ != 4) return;
-    const size_t need = 4 * sizeof(uint64_t) +
-        static_cast<size_t>(n_tokens_) * tok_bytes_;
-    if (size_ < need) return;
-    data_ = base_ + 4 * sizeof(uint64_t);
+    // Overflow-safe capacity check (divide, never multiply).
+    if (n_tokens_ <= 0 ||
+        static_cast<uint64_t>(n_tokens_) >
+            map_.payload_bytes(4) / tok_bytes_)
+      return;
+    data_ = map_.payload(4);
     // Each window needs seq_len + 1 tokens (the shifted target).
     n_windows_ = (n_tokens_ - 1) / seq_;
     if (n_windows_ <= 0) return;
@@ -400,33 +446,21 @@ class TokenDataset {
         depth, n_threads));
   }
 
-  ~TokenDataset() {
-    prefetcher_.reset();
-    if (base_ != nullptr && base_ != MAP_FAILED) munmap(
-        const_cast<uint8_t*>(base_), size_);
-    if (fd_ >= 0) close(fd_);
-  }
+  ~TokenDataset() { prefetcher_.reset(); }
 
   bool ok() const { return ok_; }
   int64_t n_tokens() const { return n_tokens_; }
   int64_t n_windows() const { return n_windows_; }
+  int64_t max_token_id() const { return max_token_id_; }
 
   void Fill(int64_t step, float* xf, float* yf) {
     int32_t* x = reinterpret_cast<int32_t*>(xf);
     int32_t* y = reinterpret_cast<int32_t*>(yf);
-    uint64_t cur_epoch =
-        static_cast<uint64_t>(step) * batch_ / n_windows_;
-    EpochShuffle shuffle(seed_, cur_epoch, n_windows_);
-    for (int64_t b = 0; b < batch_; ++b) {
-      const uint64_t pos = static_cast<uint64_t>(step) * batch_ + b;
-      const uint64_t epoch = pos / n_windows_;
-      if (epoch != cur_epoch) {
-        cur_epoch = epoch;
-        shuffle = EpochShuffle(seed_, cur_epoch, n_windows_);
-      }
-      const int64_t w = static_cast<int64_t>(shuffle(pos % n_windows_));
-      CopyWindow(w, x + b * seq_, y + b * seq_);
-    }
+    FillShuffled(
+        step, batch_, n_windows_, seed_,
+        [&](int64_t w, int64_t b) {
+          CopyWindow(w, x + b * seq_, y + b * seq_);
+        });
   }
 
  private:
@@ -455,11 +489,10 @@ class TokenDataset {
  private:
   int64_t batch_, seq_;
   uint64_t seed_;
-  int fd_ = -1;
-  size_t size_ = 0;
-  const uint8_t* base_ = nullptr;
+  MappedFile map_;
   const uint8_t* data_ = nullptr;
   int64_t n_tokens_ = 0, tok_bytes_ = 0, n_windows_ = 0;
+  int64_t max_token_id_ = 0;
   bool ok_ = false;
   std::unique_ptr<Prefetcher> prefetcher_;
 };
@@ -542,11 +575,12 @@ void* token_dataset_open(const char* path, int64_t batch,
   return ds;
 }
 
-void token_dataset_info(void* p, int64_t* n_tokens,
-                        int64_t* n_windows) {
+void token_dataset_info(void* p, int64_t* n_tokens, int64_t* n_windows,
+                        int64_t* max_token_id) {
   auto* ds = static_cast<TokenDataset*>(p);
   *n_tokens = ds->n_tokens();
   *n_windows = ds->n_windows();
+  *max_token_id = ds->max_token_id();
 }
 
 // Synchronous random access; outputs are int32 bit patterns in the
