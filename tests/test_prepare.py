@@ -1,0 +1,171 @@
+"""Corpus preparation: text -> token binary -> training batches.
+
+Covers tpu_hpc/native/prepare.py: the streaming writer's header
+patching vs the one-shot writer, the byte tokenizer's reversibility,
+document/EOT layout, CLI, and the end-to-end path a user follows
+(prepare a corpus from text, open it with NativeTokenDataset).
+"""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_hpc.native import write_token_dataset
+from tpu_hpc.native.prepare import (
+    TokenDatasetWriter,
+    byte_tokenizer,
+    iter_documents,
+    main,
+    prepare_corpus,
+    resolve_tokenizer,
+)
+
+
+class TestWriter:
+    def test_streamed_equals_oneshot(self, tmp_path):
+        """Chunked appends produce the identical file to the one-shot
+        writer when the dtype choice agrees."""
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 257, size=10_000, dtype=np.int64)
+        tokens[-1] = 256  # pin max id so both writers agree on it
+        one = str(tmp_path / "one.bin")
+        write_token_dataset(one, tokens)
+        streamed = str(tmp_path / "str.bin")
+        with TokenDatasetWriter(streamed, vocab_size=257) as w:
+            for i in range(0, tokens.size, 997):  # ragged chunks
+                w.append(tokens[i:i + 997])
+        assert open(one, "rb").read() == open(streamed, "rb").read()
+
+    def test_dtype_follows_vocab(self, tmp_path):
+        w16 = TokenDatasetWriter(str(tmp_path / "a"), vocab_size=65536)
+        w32 = TokenDatasetWriter(str(tmp_path / "b"), vocab_size=65537)
+        assert w16.dtype == np.uint16 and w32.dtype == np.uint32
+        for w in (w16, w32):
+            w.append(np.arange(10))
+            w.close()
+
+    def test_out_of_vocab_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="outside vocab_size"):
+            with TokenDatasetWriter(str(tmp_path / "a"), 100) as w:
+                w.append(np.asarray([5, 100]))
+        # the context manager removed the partial file
+        assert not (tmp_path / "a").exists()
+
+    def test_too_short_corpus_rejected(self, tmp_path):
+        w = TokenDatasetWriter(str(tmp_path / "a"), 100)
+        w.append(np.asarray([1]))
+        with pytest.raises(ValueError, match="at least 2 tokens"):
+            w.close()
+        assert not (tmp_path / "a").exists()
+
+    def test_failed_prepare_removes_partial_file(self, tmp_path):
+        path = tmp_path / "a"
+        with pytest.raises(RuntimeError):
+            with TokenDatasetWriter(str(path), 300) as w:
+                w.append(np.arange(100))
+                raise RuntimeError("tokenizer exploded")
+        assert not path.exists()
+
+
+class TestTokenizers:
+    def test_byte_roundtrip(self):
+        encode, vocab, eot = byte_tokenizer()
+        text = "halo exchange über the mesh\n"
+        ids = encode(text)
+        assert vocab == 257 and eot == 256
+        assert bytes(ids.astype(np.uint8)).decode("utf-8") == text
+
+    def test_resolve_rejects_unknown(self):
+        with pytest.raises(ValueError, match="unknown tokenizer"):
+            resolve_tokenizer("sentencepiece")
+
+
+class TestPrepare:
+    def test_end_to_end_text_to_batches(self, tmp_path):
+        """The full user path: two text documents -> corpus file ->
+        NativeTokenDataset windows with the EOT separator in place."""
+        native = pytest.importorskip("tpu_hpc.native.dataloader")
+        if not native.native_available():
+            pytest.skip("native loader unavailable")
+        a, b = tmp_path / "a.txt", tmp_path / "b.txt"
+        a.write_text("first document\n")
+        b.write_text("second one\n")
+        out = str(tmp_path / "corpus.bin")
+        info = prepare_corpus(out, [str(a), str(b)])
+        raw = (a.read_text() + "\x00" + b.read_text()).encode()
+        # EOT id 256 sits where the \x00 placeholder is
+        expect = np.frombuffer(raw, np.uint8).astype(np.int64)
+        expect[expect == 0] = 256
+        expect = np.append(expect, 256)  # trailing doc separator
+        assert info["n_tokens"] == expect.size
+        ds = native.NativeTokenDataset(
+            out, batch_size=2, seq_len=8, seed=0
+        )
+        try:
+            x, y = ds.batch_at(0, 2)
+            # every (input, target) pair is a shifted window of expect
+            flat = expect
+            for row_x, row_y in zip(np.asarray(x), np.asarray(y)):
+                starts = np.flatnonzero(flat[:-8] == row_x[0])
+                assert any(
+                    np.array_equal(flat[s:s + 8], row_x)
+                    and np.array_equal(flat[s + 1:s + 9], row_y)
+                    for s in starts
+                )
+        finally:
+            ds.close()
+
+    def test_no_eot_flag(self, tmp_path):
+        a = tmp_path / "a.txt"
+        a.write_text("ten chars!")
+        out = str(tmp_path / "c.bin")
+        info = prepare_corpus(out, [str(a)], append_eot=False)
+        assert info["n_tokens"] == 10
+
+    def test_custom_encode_requires_vocab(self, tmp_path):
+        with pytest.raises(ValueError, match="requires vocab_size"):
+            prepare_corpus(
+                str(tmp_path / "c.bin"), [], encode=lambda t: [1]
+            )
+
+    def test_custom_documents_iterable(self, tmp_path):
+        out = str(tmp_path / "c.bin")
+        info = prepare_corpus(
+            out, [], documents=["abc", "de"],
+            encode=lambda t: np.frombuffer(t.encode(), np.uint8),
+            vocab_size=257, eot_id=256,
+        )
+        assert info["n_tokens"] == 3 + 1 + 2 + 1
+
+    def test_iter_documents_chunks_on_lines(self, tmp_path):
+        p = tmp_path / "t.txt"
+        lines = [f"line {i}\n" for i in range(100)]
+        p.write_text("".join(lines))
+        chunks = list(iter_documents([str(p)], chunk_bytes=64))
+        assert len(chunks) > 1
+        assert "".join(chunks) == "".join(lines)
+        for c in chunks:  # never tears a line
+            assert c.endswith("\n")
+
+
+class TestCLI:
+    def test_main_writes_corpus(self, tmp_path, capsys):
+        a = tmp_path / "a.txt"
+        a.write_text("hello corpus\n")
+        out = str(tmp_path / "c.bin")
+        assert main([str(a), "--out", out]) == 0
+        hdr = np.fromfile(out, np.uint64, count=4)
+        assert int(hdr[1]) == 14  # 13 bytes + EOT
+
+    def test_module_invocation(self, tmp_path):
+        a = tmp_path / "a.txt"
+        a.write_text("module run\n")
+        out = str(tmp_path / "c.bin")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_hpc.native.prepare",
+             str(a), "--out", out],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "wrote" in r.stderr

@@ -6,3 +6,17 @@ from tpu_hpc.native.dataloader import (  # noqa: F401
     write_dataset,
     write_token_dataset,
 )
+_PREPARE_EXPORTS = ("TokenDatasetWriter", "prepare_corpus")
+
+
+def __getattr__(name):
+    # Lazy: importing prepare eagerly here would make
+    # `python -m tpu_hpc.native.prepare` re-execute the module
+    # (runpy's found-in-sys.modules warning).
+    if name in _PREPARE_EXPORTS:
+        from tpu_hpc.native import prepare
+
+        return getattr(prepare, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
