@@ -558,6 +558,161 @@ def multislice_mesh(nslices=2, nx=2, ny=2):
     _save(fig, "multislice_mesh.svg")
 
 
+def hbm_memory():
+    """Per-chip HBM during a 7B training step, by strategy -- computed
+    from the framework's own fit analyzer (checks/fit.py analyze with
+    do_compile=False: real param pytree via eval_shape, real sharding
+    rules, the analytic activation model). The TPU edition of the
+    reference's gpu_memory_components.png: instead of naming the
+    components of one OOM, it shows how each strategy moves them."""
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+    from tpu_hpc.checks.fit import analyze
+    from tpu_hpc.models import llama2
+
+    GIB = 1 << 30
+    cfg = llama2.LlamaConfig(max_seq_len=4096, remat=True)
+    n = llama2.count_params(cfg)
+    chips, batch = 32, 64
+
+    bars = []  # (label, params, grads, opt, act) in GiB per chip
+    # Pure DP: every chip holds the whole model + opt state;
+    # activations are batch-sharded exactly as under FSDP (same
+    # analytic model, tp=1).
+    from tpu_hpc.checks.fit import activation_model
+
+    dp_act = sum(activation_model(
+        cfg, dp=chips, tp_size=1, global_batch=batch, seq_len=4096
+    ).values()) / GIB
+    dp_statics = [4 * n / GIB, 4 * n / GIB, 8 * n / GIB]
+    bars.append(("DP x32\n(replicated)", *dp_statics, dp_act))
+    for label, kw in [
+        ("FSDP x32", dict(dp=chips, tp_size=1)),
+        ("hybrid 8x4\nFSDP x TP(+SP)", dict(dp=8, tp_size=4)),
+        ("hybrid 8x4\n+ accum 8", dict(dp=8, tp_size=4, grad_accum=8)),
+        ("hybrid 8x4\naccum 8, bf16 mom.",
+         dict(dp=8, tp_size=4, grad_accum=8,
+              moments_dtype="bfloat16")),
+    ]:
+        r = analyze(cfg, global_batch=batch, seq_len=4096,
+                    do_compile=False, **kw)
+        bars.append((
+            label, r.param_bytes / GIB, r.grad_bytes / GIB,
+            r.opt_bytes / GIB, sum(r.act_bytes.values()) / GIB,
+        ))
+
+    comp_colors = ["#0072B2", "#E69F00", "#CC79A7", "#009E73"]
+    comp_names = ["params (fp32 master)", "grads",
+                  "AdamW mu+nu", "activations"]
+    fig, ax = plt.subplots(figsize=(9.2, 4.2))
+    clip = 48  # GiB shown; the DP bar annotates its true height
+    for i, (label, p, g, o, a) in enumerate(bars):
+        y = 0.0
+        total = p + g + o + (a or 0)
+        for val, color in zip((p, g, o, a), comp_colors):
+            if val is None:
+                continue
+            h = min(val, clip - y)
+            if h <= 0:
+                break
+            ax.add_patch(Rectangle((i - 0.32, y), 0.64, h,
+                                   facecolor=color, alpha=0.85,
+                                   edgecolor=EDGE, lw=0.5))
+            y += h
+        note = f"{total:.1f} GiB"
+        if total > clip:
+            note += " (clipped)"
+        ax.text(i, min(total, clip) + 1.1, note, ha="center",
+                fontsize=8.5)
+        ax.text(i, -2.6, label, ha="center", va="top", fontsize=8.5)
+    for hbm, name in ((16, "v5e HBM 16 GiB"), (32, "v4 HBM 32 GiB")):
+        ax.axhline(hbm, color="#D55E00", lw=1.1, ls="--", alpha=0.8)
+        ax.text(len(bars) - 0.45, hbm + 0.5, name, fontsize=8,
+                color="#D55E00", ha="right")
+    handles = [Rectangle((0, 0), 1, 1, facecolor=c, alpha=0.85)
+               for c in comp_colors]
+    ax.legend(handles, comp_names, loc="upper right", fontsize=8,
+              framealpha=0.9)
+    ax.set_xlim(-0.7, len(bars) - 0.3)
+    ax.set_ylim(0, clip + 4)
+    ax.set_xticks([])
+    ax.set_ylabel("GiB per chip", fontsize=9)
+    ax.set_title(
+        f"Where a 7B training step's HBM goes ({chips} chips, batch "
+        f"{batch} x 4096) -- from checks/fit.py's accounting",
+        fontsize=10, loc="left",
+    )
+    _save(fig, "hbm_memory.svg")
+
+
+def parallelism_modes():
+    """Six-panel overview: what each strategy splits across 4 chips.
+    The TPU edition of the reference's modes_of_parallelism /
+    data-vs-model-parallelism overview figures."""
+    fig, axes = plt.subplots(2, 3, figsize=(10.2, 6.0),
+                             gridspec_kw={"wspace": 0.25,
+                                          "hspace": 0.45})
+
+    def chipframe(ax, title, sub):
+        ax.set_xlim(-0.2, 4.2)
+        ax.set_ylim(-1.4, 4.4)
+        ax.axis("off")
+        ax.set_title(title, fontsize=9.5, loc="left")
+        ax.text(2.0, -1.15, sub, ha="center", fontsize=7.8,
+                color="#444")
+
+    def grid(ax, split, labels):
+        """A 4x4 'tensor' split along rows/cols/blocks, one color per
+        owning chip."""
+        for i in range(4):
+            for j in range(4):
+                if split == "rows":
+                    owner = i
+                elif split == "cols":
+                    owner = j
+                elif split == "blocks":
+                    owner = (i // 2) * 2 + (j // 2)
+                else:
+                    owner = -1  # replicated
+                color = (MB_COLORS[owner % len(MB_COLORS)]
+                         if owner >= 0 else "#bbbbbb")
+                ax.add_patch(Rectangle((j, 3 - i), 1, 1,
+                                       facecolor=color, alpha=0.8,
+                                       edgecolor="white", lw=1.2))
+        if labels:
+            ax.text(-0.12, 2.0, labels[0], rotation=90, va="center",
+                    ha="right", fontsize=8)
+            ax.text(2.0, 4.12, labels[1], ha="center", fontsize=8)
+
+    panels = [
+        ("DP / FSDP: split the BATCH", "rows", ("batch", "features"),
+         "each chip trains its own rows; FSDP also\nshards the "
+         "params over the same axis"),
+        ("TP: split the WEIGHTS", "cols", ("d_in", "d_out"),
+         "column/row-parallel matmuls; one psum\nper block over the "
+         "'model' axis"),
+        ("PP: split the LAYERS", "rows", ("layers", ""),
+         "stages own layer ranges; microbatches\nstream through "
+         "ppermute hops"),
+        ("SP / ring: split the SEQUENCE", "cols", ("", "sequence"),
+         "each chip holds S/4 tokens; ring/all_to_all\nmoves KV or "
+         "heads, never the stream"),
+        ("Domain: split SPACE", "blocks", ("lat", "lon"),
+         "2D tiles + halo exchange for conv\nstencils (weather grids)"),
+        ("Hybrid: compose axes", "blocks", ("data", "model"),
+         "mesh axes multiply: FSDP x TP x SP x PP\non one device mesh"),
+    ]
+    for ax, (title, split, labels, sub) in zip(axes.flat, panels):
+        grid(ax, split, labels)
+        chipframe(ax, title, sub)
+    fig.suptitle(
+        "What gets split: every parallelism mode is a sharding of "
+        "some axis over the same chips", fontsize=10.5, x=0.5, y=0.99,
+    )
+    _save(fig, "parallelism_modes.svg")
+
+
 if __name__ == "__main__":
     pipeline_schedules()
     mesh_torus()
@@ -569,3 +724,5 @@ if __name__ == "__main__":
     ring_attention_rotation()
     fsdp_step_flow()
     multislice_mesh()
+    hbm_memory()
+    parallelism_modes()

@@ -138,15 +138,52 @@ class TestPrepare:
         )
         assert info["n_tokens"] == 3 + 1 + 2 + 1
 
-    def test_iter_documents_chunks_on_lines(self, tmp_path):
+    def test_iter_documents_bounded_chunks(self, tmp_path):
+        """Fixed-size reads: bounded memory even with no newlines,
+        exact reassembly, and no UTF-8 tearing at chunk edges."""
         p = tmp_path / "t.txt"
-        lines = [f"line {i}\n" for i in range(100)]
-        p.write_text("".join(lines))
+        text = ("ünïcödé " * 200)  # newline-free, multi-byte chars
+        p.write_text(text, encoding="utf-8")
         chunks = list(iter_documents([str(p)], chunk_bytes=64))
         assert len(chunks) > 1
-        assert "".join(chunks) == "".join(lines)
-        for c in chunks:  # never tears a line
-            assert c.endswith("\n")
+        assert all(len(c) <= 64 for c in chunks)
+        assert "".join(chunks) == text
+
+    def test_chunk_unsafe_encodes_whole_file(self, tmp_path):
+        """BPE-style tokenizers must see each file in one piece --
+        chunk boundaries would change the ids (review finding)."""
+        p = tmp_path / "t.txt"
+        p.write_text("x" * 500)
+        calls = []
+
+        def encode(text):
+            calls.append(len(text))
+            return np.frombuffer(text.encode(), np.uint8)
+
+        prepare_corpus(
+            str(tmp_path / "c.bin"), [str(p)], encode=encode,
+            vocab_size=257, chunk_safe=False,
+        )
+        assert calls == [500]
+
+    def test_byte_tokenizer_streams_in_chunks(self, tmp_path, monkeypatch):
+        """The byte path stays O(chunk): a file bigger than the chunk
+        size is encoded in several pieces with identical output."""
+        import tpu_hpc.native.prepare as prep
+
+        p = tmp_path / "t.txt"
+        p.write_text("abc" * 1000)
+        monkeypatch.setattr(
+            prep, "iter_documents",
+            lambda paths, chunk_bytes=64: iter_documents(
+                paths, chunk_bytes=64
+            ),
+        )
+        out = str(tmp_path / "c.bin")
+        info = prep.prepare_corpus(out, [str(p)])
+        assert info["n_tokens"] == 3001  # 3000 bytes + EOT
+        data = np.fromfile(out, np.uint16, offset=32)
+        assert bytes(data[:-1].astype(np.uint8)).decode() == "abc" * 1000
 
 
 class TestCLI:

@@ -128,7 +128,11 @@ class TokenDatasetWriter:
 
 
 def byte_tokenizer() -> tuple:
-    """(encode, vocab_size, eot_id): UTF-8 bytes as ids, no deps."""
+    """(encode, vocab_size, eot_id): UTF-8 bytes as ids, no deps.
+
+    Chunk-safe: ``encode(a) + encode(b) == encode(a + b)``, so large
+    files can stream through in bounded-size chunks.
+    """
     def encode(text: str) -> np.ndarray:
         return np.frombuffer(text.encode("utf-8"), np.uint8)
 
@@ -164,7 +168,13 @@ def hf_tokenizer(path: str) -> tuple:
 
 
 def resolve_tokenizer(spec: str) -> tuple:
-    """``byte`` or ``hf:<local-dir>`` -> (encode, vocab_size, eot)."""
+    """``byte`` or ``hf:<local-dir>`` -> (encode, vocab_size, eot).
+
+    Byte is *chunk-safe* (splitting text anywhere yields the same
+    ids); BPE-family tokenizers are NOT -- a merge spanning a split
+    point encodes differently -- so ``prepare_corpus`` streams byte
+    corpora in chunks but encodes hf documents whole.
+    """
     if spec == "byte":
         return byte_tokenizer()
     if spec.startswith("hf:"):
@@ -177,26 +187,25 @@ def resolve_tokenizer(spec: str) -> tuple:
 def iter_documents(
     paths: List[str], chunk_bytes: int = 1 << 22
 ) -> Iterator[str]:
-    """Yield text chunks from files ('-' = stdin), bounded memory.
+    """Yield ~chunk_bytes text pieces from files ('-' = stdin) in
+    O(chunk) memory.
 
-    Chunks split at arbitrary byte offsets would tear multi-byte UTF-8
-    sequences, so reads are line-buffered up to ~chunk_bytes.
+    Fixed-size text-mode reads: the codec's incremental decoder
+    handles multi-byte UTF-8 at buffer edges, and chunk boundaries
+    land at arbitrary character offsets -- only safe for chunk-safe
+    tokenizers (see ``resolve_tokenizer``); line-buffered reads would
+    re-introduce unbounded memory on newline-free files.
     """
     for p in paths:
         f = sys.stdin if p == "-" else open(
             p, "r", encoding="utf-8", errors="replace"
         )
         try:
-            buf: List[str] = []
-            size = 0
-            for line in f:
-                buf.append(line)
-                size += len(line)
-                if size >= chunk_bytes:
-                    yield "".join(buf)
-                    buf, size = [], 0
-            if buf:
-                yield "".join(buf)
+            while True:
+                chunk = f.read(chunk_bytes)
+                if not chunk:
+                    break
+                yield chunk
         finally:
             if f is not sys.stdin:
                 f.close()
@@ -211,6 +220,7 @@ def prepare_corpus(
     vocab_size: Optional[int] = None,
     eot_id: Optional[int] = None,
     documents: Optional[Iterable[str]] = None,
+    chunk_safe: Optional[bool] = None,
 ) -> dict:
     """Tokenize ``inputs`` (text files) into the corpus at ``out``.
 
@@ -218,11 +228,20 @@ def prepare_corpus(
     documents when the tokenizer defines one (``append_eot``). Pass
     ``encode``/``vocab_size`` directly to use a custom tokenizer
     callable instead of a spec string. Returns a summary dict.
+
+    Chunk-safe tokenizers (byte) stream each file in O(chunk) memory;
+    others (BPE changes ids when text is split mid-merge) encode each
+    file as one in-memory document. ``chunk_safe`` overrides the
+    per-tokenizer default for custom ``encode`` callables.
     """
     if encode is None:
+        if chunk_safe is None:
+            chunk_safe = tokenizer == "byte"
         encode, vocab_size, eot_id = resolve_tokenizer(tokenizer)
     elif vocab_size is None:
         raise ValueError("custom encode requires vocab_size")
+    if chunk_safe is None:
+        chunk_safe = False
     with TokenDatasetWriter(out, vocab_size) as w:
         if documents is not None:
             for doc in documents:
@@ -231,8 +250,16 @@ def prepare_corpus(
                     w.append(np.asarray([eot_id]))
         else:
             for path in inputs:
-                for chunk in iter_documents([path]):
-                    w.append(encode(chunk))
+                if chunk_safe:
+                    for chunk in iter_documents([path]):
+                        w.append(encode(chunk))
+                elif path == "-":
+                    w.append(encode(sys.stdin.read()))
+                else:
+                    with open(
+                        path, "r", encoding="utf-8", errors="replace"
+                    ) as f:
+                        w.append(encode(f.read()))
                 if append_eot and eot_id is not None:
                     w.append(np.asarray([eot_id]))
         n = w.n_tokens
