@@ -1,0 +1,172 @@
+"""Run observability: the JSONL metrics log and the config snapshot.
+
+Parity anchors: the reference's append-only benchmark_results.log
+(scripts/main.py:381-397) and the metadata-rich CSV headers of its
+comm benchmark (tests/torch_comm_bench.py:137-194) -- here as
+structured per-run records written by the Trainer itself.
+"""
+import json
+import math
+
+import jax
+import pytest
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.models import datasets, losses
+from tpu_hpc.models.unet import UNetConfig, apply_unet, init_unet
+from tpu_hpc.parallel import dp
+from tpu_hpc.train import Trainer
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    cfg_model = UNetConfig(in_channels=4, out_channels=4, base_features=4)
+    params, ms = init_unet(jax.random.key(0), cfg_model, (21, 24, 4))
+    ds = datasets.ERA5Synthetic(n_vars=2, n_levels=2, lat=21, lon=24)
+
+    def forward(params, model_state, batch, step_rng):
+        x, y = batch
+        pred, new_ms = apply_unet(
+            params, model_state, x, cfg_model, train=True
+        )
+        return losses.lat_weighted_mse(pred, y), new_ms, {}
+
+    return forward, params, ms, ds
+
+
+class TestConfigYaml:
+    def test_round_trip(self, tmp_path):
+        cfg = TrainingConfig(
+            epochs=3, global_batch_size=64, learning_rate=5e-4,
+            adam_moments_dtype="bfloat16", metrics_path="m.jsonl",
+        )
+        path = cfg.to_yaml(str(tmp_path / "c.yaml"))
+        assert TrainingConfig.from_yaml(path) == cfg
+
+
+class TestMetricsLog:
+    def test_records_written(self, mesh8, tiny_setup, tmp_path):
+        forward, params, ms, ds = tiny_setup
+        mpath = str(tmp_path / "run.jsonl")
+        cfg = TrainingConfig(
+            epochs=2, global_batch_size=16, steps_per_epoch=2,
+            metrics_path=mpath,
+        )
+        tr = Trainer(
+            cfg, mesh8, forward, params, ms,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+        )
+        tr.fit(ds)
+        records = [
+            json.loads(line) for line in open(mpath)
+        ]
+        assert [r["event"] for r in records] == [
+            "run_start", "epoch", "epoch"
+        ]
+        start = records[0]
+        assert start["total_steps"] == 4
+        assert start["n_devices"] == 8
+        assert start["config"]["global_batch_size"] == 16
+        assert start["jax_version"] == jax.__version__
+        for i, r in enumerate(records[1:]):
+            assert r["epoch"] == i
+            assert r["step"] == (i + 1) * 2
+            assert math.isfinite(r["loss"])
+            assert r["items_per_s"] > 0
+            assert r["s_per_step"] > 0
+
+    def test_appends_across_runs(self, mesh8, tiny_setup, tmp_path):
+        """Two fits append to the same file -- the reference's
+        append-only log behavior, enabling cross-run comparison."""
+        forward, params, ms, ds = tiny_setup
+        mpath = str(tmp_path / "run.jsonl")
+        cfg = TrainingConfig(
+            epochs=1, global_batch_size=16, steps_per_epoch=2,
+            metrics_path=mpath,
+        )
+        for _ in range(2):
+            tr = Trainer(
+                cfg, mesh8, forward, params, ms,
+                param_pspecs=dp.param_pspecs(params),
+                batch_pspec=dp.batch_pspec(),
+            )
+            tr.fit(ds)
+        events = [json.loads(x)["event"] for x in open(mpath)]
+        assert events == ["run_start", "epoch"] * 2
+
+    def test_nested_path_created(self, mesh8, tiny_setup, tmp_path):
+        """A metrics_path in a directory that does not exist yet must
+        not abort the run (review finding)."""
+        forward, params, ms, ds = tiny_setup
+        mpath = str(tmp_path / "logs" / "deep" / "run.jsonl")
+        cfg = TrainingConfig(
+            epochs=1, global_batch_size=16, steps_per_epoch=1,
+            metrics_path=mpath,
+        )
+        tr = Trainer(
+            cfg, mesh8, forward, params, ms,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+        )
+        tr.fit(ds)
+        assert len(open(mpath).readlines()) == 2
+
+    def test_off_by_default(self, mesh8, tiny_setup, tmp_path):
+        forward, params, ms, ds = tiny_setup
+        cfg = TrainingConfig(
+            epochs=1, global_batch_size=16, steps_per_epoch=1,
+        )
+        tr = Trainer(
+            cfg, mesh8, forward, params, ms,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+        )
+        tr.fit(ds)
+        assert list(tmp_path.iterdir()) == []
+
+
+class TestConfigSnapshot:
+    def test_written_next_to_checkpoints(self, mesh8, tiny_setup,
+                                         tmp_path):
+        from tpu_hpc.ckpt import CheckpointManager
+
+        forward, params, ms, ds = tiny_setup
+        ckdir = str(tmp_path / "ckpt")
+        cfg = TrainingConfig(
+            epochs=1, global_batch_size=16, steps_per_epoch=2,
+            save_every=1, checkpoint_dir=ckdir,
+        )
+        tr = Trainer(
+            cfg, mesh8, forward, params, ms,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+            checkpoint_manager=CheckpointManager(ckdir),
+        )
+        tr.fit(ds)
+        snap = TrainingConfig.from_yaml(f"{ckdir}/config.yaml")
+        assert snap == cfg
+
+    def test_snapshot_records_effective_epochs(
+        self, mesh8, tiny_setup, tmp_path
+    ):
+        """fit(epochs=) overrides must be what the snapshot says, or
+        re-running from it trains a different length (review
+        finding)."""
+        from tpu_hpc.ckpt import CheckpointManager
+
+        forward, params, ms, ds = tiny_setup
+        ckdir = str(tmp_path / "ckpt")
+        cfg = TrainingConfig(
+            epochs=1, global_batch_size=16, steps_per_epoch=1,
+            checkpoint_dir=ckdir, resume=False,
+        )
+        tr = Trainer(
+            cfg, mesh8, forward, params, ms,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+            checkpoint_manager=CheckpointManager(ckdir),
+        )
+        tr.fit(ds, epochs=2)
+        snap = TrainingConfig.from_yaml(f"{ckdir}/config.yaml")
+        assert snap.epochs == 2

@@ -77,6 +77,14 @@ class TrainingConfig:
     profile_start_step: int = 3
     profile_num_steps: int = 5
 
+    # Run metrics log: when set, host 0 appends one JSON line per
+    # epoch chunk (loss, throughput, step time) plus a run-start
+    # record with env metadata -- the reference's append-only
+    # benchmark_results.log / metadata-rich CSV discipline
+    # (scripts/main.py:381-397, tests/torch_comm_bench.py:137-194)
+    # as structured JSONL. "" = off.
+    metrics_path: str = ""
+
     @classmethod
     def from_yaml(cls, path: str) -> "TrainingConfig":
         """Load from a YAML mapping; unknown keys rejected.
@@ -90,6 +98,22 @@ class TrainingConfig:
         if unknown:
             raise ValueError(f"unknown config keys in {path}: {sorted(unknown)}")
         return cls(**raw)
+
+    def to_yaml(self, path: str) -> str:
+        """Write the effective config as YAML (round-trips through
+        ``from_yaml``). The Trainer snapshots this into the checkpoint
+        directory so a resumed or audited run knows exactly what
+        hyperparameters produced it -- the recorded-environment
+        discipline of the reference's benchmark CSV headers
+        (tests/torch_comm_bench.py:153-194) applied to training runs.
+        """
+        import yaml
+
+        with open(path, "w") as f:
+            yaml.safe_dump(
+                dataclasses.asdict(self), f, sort_keys=False
+            )
+        return path
 
     @classmethod
     def from_args(

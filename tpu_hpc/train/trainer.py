@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -609,6 +611,20 @@ class Trainer:
             )
         return out
 
+    def _append_metrics(self, record: Dict) -> None:
+        """Host-0 append-only JSONL run log (``cfg.metrics_path``) --
+        the reference's benchmark_results.log discipline
+        (scripts/main.py:381-397) as structured records."""
+        if not self.cfg.metrics_path or jax.process_index() != 0:
+            return
+        import json
+
+        parent = os.path.dirname(self.cfg.metrics_path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(self.cfg.metrics_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
     def maybe_resume(self) -> int:
         """Snapshot auto-resume: continue from the stored step if a
         checkpoint exists (parity: multinode_ddp_basic.py:144-155)."""
@@ -665,6 +681,33 @@ class Trainer:
         total_steps = epochs * steps_per_epoch
         run_summaries = []
         last_metrics: Dict = {}
+        if jax.process_index() == 0:
+            # Serialize the EFFECTIVE run shape: a fit(epochs=)
+            # override must be what the reproducibility record says,
+            # or re-running from it trains a different length.
+            eff_cfg = dataclasses.replace(cfg, epochs=epochs)
+            ckpt_dir = getattr(
+                self.checkpoint_manager, "directory", None
+            )
+            if ckpt_dir is not None:
+                # Reproducibility record: the exact hyperparameters
+                # that produced the checkpoints living next to it.
+                eff_cfg.to_yaml(os.path.join(ckpt_dir, "config.yaml"))
+            if cfg.metrics_path:
+                dev = jax.devices()[0]
+                self._append_metrics({
+                    "event": "run_start",
+                    "time": time.time(),
+                    "start_step": start_step,
+                    "total_steps": total_steps,
+                    "n_devices": jax.device_count(),
+                    "n_processes": jax.process_count(),
+                    "device_kind": getattr(
+                        dev, "device_kind", dev.platform
+                    ),
+                    "jax_version": jax.__version__,
+                    "config": dataclasses.asdict(eff_cfg),
+                })
         # Fast path: datasets with a traceable generator get whole-epoch
         # lax.scan (one dispatch/epoch); host-fed datasets fall back to
         # the per-step loop. A resume landing mid-epoch runs a shorter
@@ -760,15 +803,26 @@ class Trainer:
             summary = self.meter.epoch_summary(skip_first=0)
             run_summaries.append(summary)
             if jax.process_index() == 0:
+                loss = float(jax.device_get(last_metrics["loss"]))
                 self.logger.info(
                     "epoch %d | loss %.5f | %.1f items/s global | "
                     "%.1f items/s/device | %.3fs/step",
-                    epoch,
-                    float(jax.device_get(last_metrics["loss"])),
+                    epoch, loss,
                     summary["items_per_s"],
                     summary["items_per_s_per_device"],
                     summary["total_s"] / max(chunk, 1),
                 )
+                self._append_metrics({
+                    "event": "epoch",
+                    "time": time.time(),
+                    "epoch": epoch,
+                    "step": done,
+                    "loss": loss,
+                    "items_per_s": summary["items_per_s"],
+                    "items_per_s_per_device":
+                        summary["items_per_s_per_device"],
+                    "s_per_step": summary["total_s"] / max(chunk, 1),
+                })
             if (
                 self.checkpoint_manager is not None
                 and cfg.save_every
