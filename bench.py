@@ -264,6 +264,8 @@ def bench_llama_long(
     steps: int = 20, seq_len: int = 8192, batch: int = 1,
     remat: bool = False, grad_accum_steps: int = 1,
     moments_dtype: str = "float32",
+    block_q: int = 512, block_k: int = 512,
+    block_q_bwd: int = None, block_k_bwd: int = None,
 ) -> dict:
     """Long-context Llama: seq 8192 (4x the headline bench) -- the
     long-sequence regime the SP family exists for. Same harness as
@@ -276,8 +278,10 @@ def bench_llama_long(
     (--remat); at 7B scale the fit analysis (checks/fit.py) shows
     where it becomes mandatory."""
     rec = bench_llama(
-        steps, remat, batch, "flash", seq_len=seq_len,
-        grad_accum_steps=grad_accum_steps, moments_dtype=moments_dtype,
+        steps, remat, batch, "flash", block_q, block_k,
+        seq_len=seq_len, grad_accum_steps=grad_accum_steps,
+        moments_dtype=moments_dtype,
+        block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
     )
     rec["metric"] = f"llama2_seq{seq_len}_tokens_per_s_per_chip"
     return rec
@@ -542,7 +546,7 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
     return 0 if all(r.get("value") is not None for r in raw) else 1
 
 
-def main() -> int:
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--workload",
@@ -595,7 +599,7 @@ def main() -> int:
         help="AdamW moment storage dtype (bfloat16 halves optimizer-"
         "state HBM bytes read+written per step)",
     )
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
     devinfo = None
     if os.environ.get("TPU_HPC_BENCH_NO_PROBE") != "1":
         # Children of --all skip this: the parent already probed, and
@@ -642,6 +646,8 @@ def main() -> int:
             batch=batch, remat=args.remat,
             grad_accum_steps=accum,
             moments_dtype=args.moments_dtype,
+            block_q=args.block_q, block_k=args.block_k,
+            block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
         )
     else:
         rec = bench_unet(args.steps)

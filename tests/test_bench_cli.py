@@ -59,3 +59,31 @@ def test_invalid_accum_reaches_trainer_validation(bench):
     assert accum == 0
     _, accum = bench.resolve_batch_accum(8, 0, microbatch=4)
     assert accum == 0
+
+
+def test_llama_long_threads_block_flags(bench, monkeypatch):
+    """--block-q/--block-k(-bwd) must reach the long-context workload
+    too, so autotuned tilings apply to the seq-8192 family (the
+    harness is shared with bench_llama)."""
+    seen = {}
+
+    def fake_bench_llama(steps, remat, batch, attn, block_q=512,
+                         block_k=512, **kw):
+        seen.update(block_q=block_q, block_k=block_k,
+                    block_q_bwd=kw.get("block_q_bwd"),
+                    block_k_bwd=kw.get("block_k_bwd"))
+        return {"metric": "m", "value": 1, "unit": "u",
+                "vs_baseline": 1}
+
+    monkeypatch.setattr(bench, "bench_llama", fake_bench_llama)
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    rc = bench.main([
+        "--workload", "llama-long", "--block-q", "256",
+        "--block-k", "1024", "--block-q-bwd", "128",
+        "--block-k-bwd", "512",
+    ])
+    assert rc == 0
+    assert seen == {
+        "block_q": 256, "block_k": 1024,
+        "block_q_bwd": 128, "block_k_bwd": 512,
+    }

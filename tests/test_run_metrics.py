@@ -147,6 +147,30 @@ class TestConfigSnapshot:
         snap = TrainingConfig.from_yaml(f"{ckdir}/config.yaml")
         assert snap == cfg
 
+    def test_no_snapshot_before_first_save(self, mesh8, tiny_setup,
+                                           tmp_path):
+        """A run that never checkpoints must not write config.yaml --
+        it would relabel shards an earlier run left in the directory
+        (review finding)."""
+        from tpu_hpc.ckpt import CheckpointManager
+
+        forward, params, ms, ds = tiny_setup
+        ckdir = str(tmp_path / "ckpt")
+        cfg = TrainingConfig(
+            epochs=1, global_batch_size=16, steps_per_epoch=1,
+            save_every=0, checkpoint_dir=ckdir, resume=False,
+        )
+        tr = Trainer(
+            cfg, mesh8, forward, params, ms,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+            checkpoint_manager=CheckpointManager(ckdir),
+        )
+        tr.fit(ds)
+        import os
+
+        assert not os.path.exists(f"{ckdir}/config.yaml")
+
     def test_snapshot_records_effective_epochs(
         self, mesh8, tiny_setup, tmp_path
     ):
@@ -159,7 +183,7 @@ class TestConfigSnapshot:
         ckdir = str(tmp_path / "ckpt")
         cfg = TrainingConfig(
             epochs=1, global_batch_size=16, steps_per_epoch=1,
-            checkpoint_dir=ckdir, resume=False,
+            save_every=1, checkpoint_dir=ckdir, resume=False,
         )
         tr = Trainer(
             cfg, mesh8, forward, params, ms,

@@ -107,12 +107,18 @@ class TrainingConfig:
         discipline of the reference's benchmark CSV headers
         (tests/torch_comm_bench.py:153-194) applied to training runs.
         """
+        import os
+
         import yaml
 
-        with open(path, "w") as f:
+        # Atomic: a crash mid-write must not leave a truncated YAML
+        # that from_yaml would silently fill with defaults.
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
             yaml.safe_dump(
                 dataclasses.asdict(self), f, sort_keys=False
             )
+        os.replace(tmp, path)
         return path
 
     @classmethod

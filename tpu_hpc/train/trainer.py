@@ -625,6 +625,16 @@ class Trainer:
         with open(self.cfg.metrics_path, "a") as f:
             f.write(json.dumps(record) + "\n")
 
+    def _snapshot_config(self) -> None:
+        """Write config.yaml next to the checkpoints -- the exact
+        hyperparameters that produced them. Called at save time so a
+        run that never saved cannot relabel another run's shards."""
+        ckpt_dir = getattr(self.checkpoint_manager, "directory", None)
+        if ckpt_dir is None or jax.process_index() != 0:
+            return
+        cfg = getattr(self, "_effective_cfg", self.cfg)
+        cfg.to_yaml(os.path.join(ckpt_dir, "config.yaml"))
+
     def maybe_resume(self) -> int:
         """Snapshot auto-resume: continue from the stored step if a
         checkpoint exists (parity: multinode_ddp_basic.py:144-155)."""
@@ -681,18 +691,14 @@ class Trainer:
         total_steps = epochs * steps_per_epoch
         run_summaries = []
         last_metrics: Dict = {}
+        # The EFFECTIVE run shape: a fit(epochs=) override must be
+        # what the reproducibility records say, or re-running from
+        # them trains a different length. Snapshotted next to the
+        # checkpoints at SAVE time (not here): a run that dies before
+        # its first save must not relabel shards an earlier run left
+        # in the same directory.
+        self._effective_cfg = dataclasses.replace(cfg, epochs=epochs)
         if jax.process_index() == 0:
-            # Serialize the EFFECTIVE run shape: a fit(epochs=)
-            # override must be what the reproducibility record says,
-            # or re-running from it trains a different length.
-            eff_cfg = dataclasses.replace(cfg, epochs=epochs)
-            ckpt_dir = getattr(
-                self.checkpoint_manager, "directory", None
-            )
-            if ckpt_dir is not None:
-                # Reproducibility record: the exact hyperparameters
-                # that produced the checkpoints living next to it.
-                eff_cfg.to_yaml(os.path.join(ckpt_dir, "config.yaml"))
             if cfg.metrics_path:
                 dev = jax.devices()[0]
                 self._append_metrics({
@@ -706,7 +712,7 @@ class Trainer:
                         dev, "device_kind", dev.platform
                     ),
                     "jax_version": jax.__version__,
-                    "config": dataclasses.asdict(eff_cfg),
+                    "config": dataclasses.asdict(self._effective_cfg),
                 })
         # Fast path: datasets with a traceable generator get whole-epoch
         # lax.scan (one dispatch/epoch); host-fed datasets fall back to
@@ -829,6 +835,7 @@ class Trainer:
                 and done % (cfg.save_every * steps_per_epoch) == 0
             ):
                 self.checkpoint_manager.save(self.state)
+                self._snapshot_config()
             if preempted["flag"]:
                 self.logger.warning(
                     "SIGTERM received: snapshotting at step %d and "
@@ -837,6 +844,7 @@ class Trainer:
                 )
                 if done not in (self.checkpoint_manager.all_steps() or []):
                     self.checkpoint_manager.save(self.state, force=True)
+                self._snapshot_config()
                 self.checkpoint_manager.wait()
                 break
         return last_metrics
