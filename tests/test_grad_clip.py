@@ -1,0 +1,121 @@
+"""Global gradient-norm clipping (cfg.max_grad_norm).
+
+The standard LLM-pretraining stabilizer the reference's toy steps
+never needed. The invariants: the clip caps the update-driving
+gradient norm exactly, a generous threshold is a no-op (bit-exact
+trajectory vs clipping off), and the threshold is accum-invariant
+because the clip sees the full accumulated gradient.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.train.trainer import make_optimizer
+
+
+def _grads(scale):
+    return {
+        "w": jnp.full((4, 4), scale, jnp.float32),
+        "b": jnp.full((4,), -scale, jnp.float32),
+    }
+
+
+def _gnorm(tree):
+    return float(optax.global_norm(tree))
+
+
+class TestClip:
+    def test_caps_the_norm(self):
+        cfg = TrainingConfig(max_grad_norm=1.0, weight_decay=0.1)
+        tx = make_optimizer(cfg)
+        params = _grads(0.0)
+        state = tx.init(params)
+        big = _grads(100.0)
+        # Apply the clip alone to check the norm it forwards: compare
+        # the update against the same optimizer fed the pre-clipped
+        # gradient.
+        clipped, _ = optax.clip_by_global_norm(1.0).update(
+            big, optax.clip_by_global_norm(1.0).init(params)
+        )
+        assert _gnorm(clipped) == pytest.approx(1.0, rel=1e-5)
+        u_via_cfg, _ = tx.update(big, state, params)
+        ref = make_optimizer(
+            TrainingConfig(max_grad_norm=0.0, weight_decay=0.1)
+        )
+        u_ref, _ = ref.update(clipped, ref.init(params), params)
+        assert jax.tree.all(
+            jax.tree.map(
+                lambda a, b: jnp.allclose(a, b), u_via_cfg, u_ref
+            )
+        )
+
+    def test_generous_threshold_is_noop(self):
+        g = _grads(0.5)
+        params = _grads(0.0)
+        on = make_optimizer(
+            TrainingConfig(max_grad_norm=1e9, weight_decay=0.1)
+        )
+        off = make_optimizer(
+            TrainingConfig(max_grad_norm=0.0, weight_decay=0.1)
+        )
+        u_on, _ = on.update(g, on.init(params), params)
+        u_off, _ = off.update(g, off.init(params), params)
+        np.testing.assert_array_equal(
+            np.asarray(u_on["w"]), np.asarray(u_off["w"])
+        )
+
+    def test_sgd_path_clips_too(self):
+        cfg = TrainingConfig(max_grad_norm=1.0, weight_decay=0.0)
+        tx = make_optimizer(cfg)
+        params = _grads(0.0)
+        u, _ = tx.update(_grads(100.0), tx.init(params), params)
+        # SGD update = -lr * clipped grad
+        assert _gnorm(u) == pytest.approx(cfg.learning_rate, rel=1e-5)
+
+    def test_negative_rejected(self):
+        with pytest.raises(ValueError, match="max_grad_norm"):
+            make_optimizer(TrainingConfig(max_grad_norm=-1.0))
+
+
+class TestClipTraining:
+    def test_trains_and_is_accum_invariant(self, mesh8):
+        """The clip threshold means the same thing at accum 1 and 4:
+        the jitted step applies it to the full accumulated gradient,
+        so both runs follow the identical trajectory. fp32 + SGD, the
+        same recipe as tests/test_grad_accum.py: bf16 microbatched
+        matmuls reduce in a different order and an adaptive
+        optimizer's first step amplifies last-ulp differences; the
+        clip's norm division is the only nonlinearity exercised."""
+        from tpu_hpc.models import datasets, llama2
+        from tpu_hpc.train import Trainer
+
+        model = llama2.LlamaConfig(
+            dim=64, n_layers=2, n_heads=4, vocab_size=128,
+            multiple_of=32, max_seq_len=32, dtype=jnp.float32,
+        )
+        ds = datasets.TokenStream(vocab_size=128, seq_len=32)
+
+        def run(accum):
+            cfg = TrainingConfig(
+                epochs=1, steps_per_epoch=3, global_batch_size=32,
+                learning_rate=1e-2, weight_decay=0.0,
+                max_grad_norm=0.1,  # tight: actively clips at init
+                grad_accum_steps=accum,
+            )
+            params = llama2.init_llama(jax.random.key(0), model)
+            tr = Trainer(
+                cfg, mesh8, llama2.make_forward(model), params
+            )
+            tr.fit(ds)
+            return jax.device_get(tr.state.params)
+
+        p1, p4 = run(1), run(4)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-4, atol=1e-6
+            ),
+            p1, p4,
+        )

@@ -43,6 +43,11 @@ class TrainingConfig:
     # LLM pretraining shape). warmup_steps applies to both.
     lr_schedule: str = "constant"
     warmup_steps: int = 0
+    # Global gradient-norm clip before the optimizer update (standard
+    # LLM pretraining stabilizer, typically 1.0; the reference never
+    # needed it for its toy steps). Applied to the full accumulated
+    # gradient, so the clip threshold is accum-invariant. 0 = off.
+    max_grad_norm: float = 0.0
     # AdamW moment dtype: "float32" (default; exact parity with the
     # reference's AdamW) or "bfloat16" -- halves optimizer-state HBM
     # (the documented unlock for 70B-class models on 16 GiB chips,

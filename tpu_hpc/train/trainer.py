@@ -126,13 +126,17 @@ def make_optimizer(cfg: TrainingConfig) -> optax.GradientTransformation:
     tensor_parallel_vit.py:372-378; no foreach quirk exists here),
     with the configured LR schedule. ``adam_moments_dtype="bfloat16"``
     halves AdamW state HBM (mu AND nu; optax keeps the update math in
-    fp32 and rounds the stored moments)."""
+    fp32 and rounds the stored moments). ``max_grad_norm > 0``
+    prepends a global-norm clip: under grad accumulation it sees the
+    full accumulated gradient (the clip lives inside the optimizer
+    update, after the accumulation scan), so the threshold means the
+    same thing at every accum setting."""
     lr = make_lr_schedule(cfg)
     if cfg.weight_decay > 0:
-        return make_adamw(
+        base = make_adamw(
             lr, cfg.weight_decay, cfg.adam_moments_dtype
         )
-    if cfg.adam_moments_dtype != "float32":
+    elif cfg.adam_moments_dtype != "float32":
         # The default optimizer is SGD (weight_decay=0); silently
         # ignoring an explicit HBM-halving request would OOM the very
         # run the knob exists for, with no pointer at the cause.
@@ -141,7 +145,17 @@ def make_optimizer(cfg: TrainingConfig) -> optax.GradientTransformation:
             "effect on the SGD path -- set weight_decay > 0 to get "
             "AdamW, or drop the moments override"
         )
-    return optax.sgd(lr, momentum=cfg.momentum)
+    else:
+        base = optax.sgd(lr, momentum=cfg.momentum)
+    if cfg.max_grad_norm < 0:
+        raise ValueError(
+            f"max_grad_norm {cfg.max_grad_norm} must be >= 0 (0 = off)"
+        )
+    if cfg.max_grad_norm > 0:
+        return optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm), base
+        )
+    return base
 
 
 def make_adamw(
@@ -627,10 +641,20 @@ class Trainer:
 
     def _snapshot_config(self) -> None:
         """Write config.yaml next to the checkpoints -- the exact
-        hyperparameters that produced them. Called at save time so a
-        run that never saved cannot relabel another run's shards."""
+        hyperparameters that produced them. Called at save time (a run
+        that never saved cannot relabel another run's shards), AFTER
+        the async save commits (wait()): a crash while the first save
+        is still in flight must not leave this run's label on a
+        previous run's shards. Once per fit -- the config cannot
+        change mid-run, so later saves keep their async overlap."""
+        if getattr(self, "_config_snapshotted", False):
+            return
         ckpt_dir = getattr(self.checkpoint_manager, "directory", None)
-        if ckpt_dir is None or jax.process_index() != 0:
+        if ckpt_dir is None:
+            return
+        self.checkpoint_manager.wait()
+        self._config_snapshotted = True
+        if jax.process_index() != 0:
             return
         cfg = getattr(self, "_effective_cfg", self.cfg)
         cfg.to_yaml(os.path.join(ckpt_dir, "config.yaml"))
@@ -698,6 +722,7 @@ class Trainer:
         # its first save must not relabel shards an earlier run left
         # in the same directory.
         self._effective_cfg = dataclasses.replace(cfg, epochs=epochs)
+        self._config_snapshotted = False  # per-fit: epochs may differ
         if jax.process_index() == 0:
             if cfg.metrics_path:
                 dev = jax.devices()[0]
