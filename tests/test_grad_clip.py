@@ -119,3 +119,87 @@ class TestClipTraining:
             ),
             p1, p4,
         )
+
+
+class TestGradNormMetric:
+    def test_reported_iff_clipping(self, mesh8):
+        """metrics['grad_norm'] = the pre-clip accumulated-gradient
+        norm, present exactly when max_grad_norm > 0 (unclipped
+        configs keep their pinned collective signatures)."""
+        from tpu_hpc.parallel import dp
+        from tpu_hpc.train import Trainer
+
+        def forward(params, ms, batch, rng):
+            x, y = batch
+            pred = x @ params["w"]
+            return jnp.mean((pred - y) ** 2), ms, {}
+
+        def run(clip):
+            cfg = TrainingConfig(
+                epochs=1, steps_per_epoch=1, global_batch_size=8,
+                learning_rate=0.0, max_grad_norm=clip,
+            )
+            params = {"w": jnp.zeros((4, 4))}
+            tr = Trainer(
+                cfg, mesh8, forward, params,
+                param_pspecs=dp.param_pspecs(params),
+                batch_pspec=dp.batch_pspec(),
+            )
+            x = jnp.ones((8, 4))
+            y = jnp.zeros((8, 4))
+            return tr.train_step((x, y)), params
+
+        m, _ = run(0.0)
+        assert "grad_norm" not in m
+        m, _ = run(1e9)  # generous threshold: reports, never clips
+        assert "grad_norm" in m
+
+    def test_value_matches_manual_norm(self, mesh8):
+        from tpu_hpc.parallel import dp
+        from tpu_hpc.train import Trainer
+
+        def forward(params, ms, batch, rng):
+            x, y = batch
+            pred = x @ params["w"]
+            return jnp.mean((pred - y) ** 2), ms, {}
+
+        cfg = TrainingConfig(
+            epochs=1, steps_per_epoch=1, global_batch_size=8,
+            learning_rate=0.0, max_grad_norm=1e9,
+        )
+        params = {"w": jnp.zeros((4, 4), jnp.float32)}
+        tr = Trainer(
+            cfg, mesh8, forward, params,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+        )
+        x = jnp.ones((8, 4), jnp.float32)
+        y = jnp.ones((8, 4), jnp.float32)
+        m = tr.train_step((x, y))
+        g = jax.grad(
+            lambda w: jnp.mean((x @ w - y) ** 2)
+        )(params["w"])
+        assert float(m["grad_norm"]) == pytest.approx(
+            float(jnp.linalg.norm(g)), rel=1e-5
+        )
+
+    def test_explicit_optimizer_with_clip_rejected(self, mesh8):
+        """An explicit optimizer bypasses make_optimizer's clip chain;
+        silently ignoring max_grad_norm would train unclipped while
+        the metric implies otherwise (review finding)."""
+        import optax as _optax
+
+        from tpu_hpc.parallel import dp
+        from tpu_hpc.train import Trainer
+
+        params = {"w": jnp.zeros((4, 4))}
+        with pytest.raises(ValueError, match="explicitly passed"):
+            Trainer(
+                TrainingConfig(max_grad_norm=1.0),
+                mesh8,
+                lambda p, ms, b, r: (jnp.float32(0), ms, {}),
+                params,
+                param_pspecs=dp.param_pspecs(params),
+                batch_pspec=dp.batch_pspec(),
+                optimizer=_optax.adamw(1e-3),
+            )

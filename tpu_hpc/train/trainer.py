@@ -219,6 +219,7 @@ def make_step_fn(
     seed: int,
     grad_accum: int = 1,
     microbatch_constrain: Optional[Callable[[Any], Any]] = None,
+    log_grad_norm: bool = False,
 ) -> Callable[[Any, Any], Tuple[Any, Dict]]:
     """The training-step body as a free function: forward, backward,
     optimizer update. The Trainer jits this; checks/fit.py AOT-lowers
@@ -289,6 +290,15 @@ def make_step_fn(
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, **aux}
+        if log_grad_norm:
+            # The PRE-clip norm of the accumulated-mean gradient --
+            # the number the clip threshold is judged against. Free
+            # when clipping is on: clip_by_global_norm computes the
+            # identical reduction and XLA CSEs the pair (which is why
+            # the Trainer enables this exactly when max_grad_norm > 0
+            # -- unclipped configs keep their pinned collective
+            # signatures byte-identical).
+            metrics["grad_norm"] = optax.global_norm(grads)
         return (
             TrainState(
                 step=state.step + 1,
@@ -330,6 +340,18 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh
         self.forward = forward
+        if optimizer is not None and cfg.max_grad_norm > 0:
+            # The clip lives inside make_optimizer's chain; silently
+            # dropping it here would train unclipped while the
+            # grad_norm metric (keyed off cfg) implies otherwise --
+            # and silently wrapping could double-clip an optimizer
+            # that already chains its own.
+            raise ValueError(
+                f"max_grad_norm={cfg.max_grad_norm} has no effect on "
+                "an explicitly passed optimizer -- chain "
+                "optax.clip_by_global_norm into it yourself, or drop "
+                "one of the two"
+            )
         self.optimizer = optimizer or make_optimizer(cfg)
         self.checkpoint_manager = checkpoint_manager
         self.logger = get_logger()
@@ -452,6 +474,7 @@ class Trainer:
             forward, self.optimizer, cfg.seed,
             grad_accum=grad_accum,
             microbatch_constrain=micro_constrain,
+            log_grad_norm=cfg.max_grad_norm > 0,
         )
         # Pin the output state to the planned layout. Without this the
         # compiler may propagate a *different* layout through the update
@@ -843,7 +866,7 @@ class Trainer:
                     summary["items_per_s_per_device"],
                     summary["total_s"] / max(chunk, 1),
                 )
-                self._append_metrics({
+                rec = {
                     "event": "epoch",
                     "time": time.time(),
                     "epoch": epoch,
@@ -853,7 +876,12 @@ class Trainer:
                     "items_per_s_per_device":
                         summary["items_per_s_per_device"],
                     "s_per_step": summary["total_s"] / max(chunk, 1),
-                })
+                }
+                if "grad_norm" in last_metrics:
+                    rec["grad_norm"] = float(
+                        jax.device_get(last_metrics["grad_norm"])
+                    )
+                self._append_metrics(rec)
             if (
                 self.checkpoint_manager is not None
                 and cfg.save_every
