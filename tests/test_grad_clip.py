@@ -203,3 +203,58 @@ class TestGradNormMetric:
                 batch_pspec=dp.batch_pspec(),
                 optimizer=_optax.adamw(1e-3),
             )
+
+
+    def test_epoch_record_carries_grad_norm(self, mesh8, tmp_path):
+        """The per-epoch JSONL record includes grad_norm when clipping
+        is on (review finding: the record branch had no test)."""
+        import json
+        import math
+
+        from tpu_hpc.models import datasets
+        from tpu_hpc.parallel import dp
+        from tpu_hpc.train import Trainer
+
+        def forward(params, ms, batch, rng):
+            x, y = batch
+            return jnp.mean((x @ params["w"] - y) ** 2), ms, {}
+
+        mpath = str(tmp_path / "run.jsonl")
+        cfg = TrainingConfig(
+            epochs=1, steps_per_epoch=2, global_batch_size=16,
+            learning_rate=1e-2, max_grad_norm=1e9, metrics_path=mpath,
+        )
+        params = {"w": jnp.zeros((20, 1))}
+        tr = Trainer(
+            cfg, mesh8, forward, params,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+        )
+        tr.fit(datasets.ToyRegression())
+        epoch = [json.loads(x) for x in open(mpath)][-1]
+        assert epoch["event"] == "epoch"
+        assert math.isfinite(epoch["grad_norm"])
+
+    def test_forward_grad_norm_aux_collision_rejected(self, mesh8):
+        """A forward aux named grad_norm + clipping on must raise, not
+        silently flip the metric's meaning (review finding)."""
+        from tpu_hpc.parallel import dp
+        from tpu_hpc.train import Trainer
+
+        def forward(params, ms, batch, rng):
+            x, y = batch
+            loss = jnp.mean((x @ params["w"] - y) ** 2)
+            return loss, ms, {"grad_norm": loss}
+
+        cfg = TrainingConfig(
+            epochs=1, steps_per_epoch=1, global_batch_size=8,
+            max_grad_norm=1.0,
+        )
+        params = {"w": jnp.zeros((4, 4))}
+        tr = Trainer(
+            cfg, mesh8, forward, params,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+        )
+        with pytest.raises(ValueError, match="grad_norm"):
+            tr.train_step((jnp.ones((8, 4)), jnp.ones((8, 4))))

@@ -194,3 +194,30 @@ class TestConfigSnapshot:
         tr.fit(ds, epochs=2)
         snap = TrainingConfig.from_yaml(f"{ckdir}/config.yaml")
         assert snap.epochs == 2
+
+
+class TestEvalRecord:
+    def test_eval_appends_record(self, mesh8, tiny_setup, tmp_path):
+        """evaluate() writes an 'eval' record with the step it ran at
+        and every eval metric."""
+        forward, params, ms, ds = tiny_setup
+        mpath = str(tmp_path / "run.jsonl")
+        cfg = TrainingConfig(
+            epochs=1, global_batch_size=16, steps_per_epoch=2,
+            metrics_path=mpath,
+        )
+        tr = Trainer(
+            cfg, mesh8, forward, params, ms,
+            param_pspecs=dp.param_pspecs(params),
+            batch_pspec=dp.batch_pspec(),
+            eval_forward=lambda p, m, b: (
+                jax.numpy.float32(0.5), {"acc": jax.numpy.float32(1.0)}
+            ),
+        )
+        tr.fit(ds)
+        tr.evaluate(ds, n_steps=2)
+        records = [json.loads(x) for x in open(mpath)]
+        ev = [r for r in records if r["event"] == "eval"]
+        assert len(ev) == 1
+        assert ev[0]["step"] == 2 and ev[0]["n_steps"] == 2
+        assert ev[0]["loss"] == 0.5 and ev[0]["acc"] == 1.0

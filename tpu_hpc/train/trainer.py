@@ -291,6 +291,16 @@ def make_step_fn(
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, **aux}
         if log_grad_norm:
+            if "grad_norm" in metrics:
+                # Trace-time guard: silently overwriting a forward's
+                # own 'grad_norm' aux would make the metric mean two
+                # different things depending on max_grad_norm.
+                raise ValueError(
+                    "forward() reports an aux metric named "
+                    "'grad_norm', which collides with the optimizer-"
+                    "level norm logged when max_grad_norm > 0 -- "
+                    "rename the aux metric"
+                )
             # The PRE-clip norm of the accumulated-mean gradient --
             # the number the clip threshold is judged against. Free
             # when clipping is on: clip_by_global_norm computes the
@@ -646,6 +656,16 @@ class Trainer:
                 "eval | %s",
                 " | ".join(f"{k} {v:.5f}" for k, v in sorted(out.items())),
             )
+            # Reserved schema fields win over user metric names: an
+            # eval aux named 'step'/'time' must not clobber the
+            # record's position/timestamp for every consumer.
+            self._append_metrics({
+                **out,
+                "event": "eval",
+                "time": time.time(),
+                "step": int(jax.device_get(self.state.step)),
+                "n_steps": n_steps,
+            })
         return out
 
     def _append_metrics(self, record: Dict) -> None:
