@@ -1,0 +1,135 @@
+"""The doctor: strategy chooser = fit + roofline, ranked.
+
+All analytic (analyze(do_compile=False) is eval_shape-only), so these
+run fast and off-device. The invariants: legality of the candidate
+meshes, accumulation escalation until fit, ranking (fit first, then
+throughput bound, HBM headroom as tie-break), and the honest no-fit
+verdict.
+"""
+import json
+
+import pytest
+
+from tpu_hpc.checks.doctor import (
+    ACCUM_LADDER,
+    diagnose,
+    main,
+    to_markdown,
+)
+
+
+@pytest.fixture(scope="module")
+def plans_7b32():
+    return diagnose("7b", chips=32, chip="v5e", global_batch=256)
+
+
+class TestCandidates:
+    def test_meshes_are_legal(self, plans_7b32):
+        for p in plans_7b32:
+            assert p.dp * p.axis2 == 32
+            assert 256 % p.dp == 0
+            if p.layout == "tp":
+                assert 32 % p.axis2 == 0 and p.axis2 <= 8
+
+    def test_gqa_head_divisibility(self):
+        # 70B: 64 query heads, 8 KV heads -> tp must divide 8.
+        plans = diagnose("70b", chips=64, chip="v4", global_batch=256)
+        assert {p.axis2 for p in plans} <= {1, 2, 4, 8}
+
+    def test_cp_only_with_long_context(self):
+        no_cp = diagnose("7b", chips=16, chip="v4", global_batch=64)
+        assert all(p.layout == "tp" for p in no_cp)
+        with_cp = diagnose(
+            "7b", chips=16, chip="v4", global_batch=64,
+            long_context=True,
+        )
+        assert any(p.layout == "cp" for p in with_cp)
+        for p in with_cp:
+            if p.layout == "cp":
+                assert 4096 % p.axis2 == 0
+
+
+class TestRanking:
+    def test_sorted_best_first(self, plans_7b32):
+        scores = [p.score for p in plans_7b32]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_fitting_plans_rank_above_nonfitting(self):
+        plans = diagnose("70b", chips=16, chip="v5e", global_batch=64)
+        seen_nonfit = False
+        for p in plans:
+            if not p.fits:
+                seen_nonfit = True
+            else:
+                assert not seen_nonfit, "a fitting plan ranked below a non-fitting one"
+
+    def test_speed_ties_break_toward_headroom(self, plans_7b32):
+        best = plans_7b32[0]
+        for p in plans_7b32[1:]:
+            if (
+                p.fits
+                and p.roofline.tokens_per_s_per_chip_bound
+                == best.roofline.tokens_per_s_per_chip_bound
+            ):
+                assert best.hbm_frac <= p.hbm_frac
+
+
+class TestAccumEscalation:
+    def test_accum_raised_until_fit(self):
+        """13B on 16 v4 chips at a 1M-token batch does not fit
+        unaccumulated (REPORT_13b_16chip_1M ran accum 32); the doctor
+        must find a fitting accum on the ladder, and it must divide
+        the batch with microbatches covering dp."""
+        plans = diagnose("13b", chips=16, chip="v4", global_batch=256)
+        best = plans[0]
+        assert best.fits and best.grad_accum > 1
+        assert best.grad_accum in ACCUM_LADDER
+        assert 256 % best.grad_accum == 0
+        assert (256 // best.grad_accum) % best.dp == 0
+
+
+class TestOutput:
+    def test_markdown_recommends_and_reproduces(self, plans_7b32):
+        md = to_markdown(
+            plans_7b32, model="7b", chips=32, chip_name="v5e",
+            global_batch=256, seq_len=4096, moments_dtype="float32",
+        )
+        assert "Recommended:" in md
+        assert "tpu_hpc.checks.fit" in md
+        assert "tpu_hpc.checks.roofline" in md
+        best = plans_7b32[0]
+        assert f"--dp {best.dp}" in md
+
+    def test_no_fit_verdict(self, capsys):
+        # 70B on 8 small chips: nothing can fit.
+        rc = main([
+            "--model", "70b", "--chips", "8", "--chip", "v5e",
+            "--global-batch", "64",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "No plan fits" in out
+
+    def test_json_mode(self, capsys):
+        rc = main([
+            "--model", "7b", "--chips", "8", "--chip", "v4",
+            "--global-batch", "64", "--json",
+        ])
+        assert rc == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert rows and all(
+            {"mesh", "fits", "bound", "grad_accum"} <= set(r)
+            for r in rows
+        )
+
+    def test_tight_marker(self):
+        """Plans above 90% HBM are labeled 'tight', not a bare yes."""
+        plans = diagnose("7b", chips=32, chip="v5e", global_batch=256)
+        md = to_markdown(
+            plans, model="7b", chips=32, chip_name="v5e",
+            global_batch=256, seq_len=4096, moments_dtype="float32",
+        )
+        for p in plans:
+            if p.fits and p.hbm_frac > 0.9:
+                assert "tight" in md
+                break
