@@ -427,23 +427,35 @@ def bench_unet(steps: int = 20) -> dict:
     }
 
 
-def probe_backend(timeout_s: int = 180, retries: int = 1):
+def probe_backend(timeout_s: int = 180, window_s: int = None):
     """Bounded check that the accelerator backend comes up before
     committing to a (long-compiling) workload. A down tunnel otherwise
     hangs jax initialization for ~30 min per attempt (observed during
-    a mid-round pool outage) -- fail fast with a clear message so the
+    a mid-round pool outage) -- fail with a clear message so the
     caller records an actionable error instead of a stall.
+
+    Transient outages are the common failure (two straight rounds of
+    driver benches lost to them), so failed probes RETRY with backoff
+    across a window -- default 30 min, override via
+    ``TPU_HPC_PROBE_WINDOW_S`` (0 = single attempt) -- instead of
+    giving up after two tries.
 
     Returns ``(device_count, device_kind)`` on success (so callers
     never need a second, unbounded jax.devices() of their own), else
     None."""
     import subprocess
+    import time
 
+    if window_s is None:
+        window_s = int(os.environ.get("TPU_HPC_PROBE_WINDOW_S", "1800"))
     code = (
         "import jax; d = jax.devices(); "
         "print('PROBE_OK', len(d), '|', d[0].device_kind)"
     )
-    for attempt in range(retries + 1):
+    deadline = time.monotonic() + window_s
+    backoff, attempt = 30, 0
+    while True:
+        attempt += 1
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code],
@@ -460,11 +472,16 @@ def probe_backend(timeout_s: int = 180, retries: int = 1):
             msg = err[-1] if err else f"rc={proc.returncode}"
         except subprocess.TimeoutExpired:
             msg = f"no backend after {timeout_s}s"
+        remaining = deadline - time.monotonic()
         print(
-            f"backend probe {attempt + 1}/{retries + 1} failed: {msg}",
+            f"backend probe attempt {attempt} failed: {msg} "
+            f"({max(remaining, 0):.0f}s left in retry window)",
             file=sys.stderr,
         )
-    return None
+        if remaining <= backoff:
+            return None
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 240)
 
 
 def run_all(out_path: str, steps: int, devinfo=None) -> int:
