@@ -32,13 +32,18 @@ class TestCandidates:
                 assert 32 % p.axis2 == 0 and p.axis2 <= 8
 
     def test_gqa_head_divisibility(self):
-        # 70B: 64 query heads, 8 KV heads -> tp must divide 8.
+        # 70B: 64 query heads, 8 KV heads -> tp must divide 8 (pp
+        # plans follow the layer count instead, 80 layers).
         plans = diagnose("70b", chips=64, chip="v4", global_batch=256)
-        assert {p.axis2 for p in plans} <= {1, 2, 4, 8}
+        tp_degrees = {p.axis2 for p in plans if p.layout == "tp"}
+        assert tp_degrees <= {1, 2, 4, 8}
+        for p in plans:
+            if p.layout == "pp":
+                assert 80 % p.axis2 == 0
 
     def test_cp_only_with_long_context(self):
         no_cp = diagnose("7b", chips=16, chip="v4", global_batch=64)
-        assert all(p.layout == "tp" for p in no_cp)
+        assert all(p.layout in ("tp", "pp") for p in no_cp)
         with_cp = diagnose(
             "7b", chips=16, chip="v4", global_batch=64,
             long_context=True,
@@ -133,3 +138,47 @@ class TestOutput:
             if p.fits and p.hbm_frac > 0.9:
                 assert "tight" in md
                 break
+
+
+class TestPipelinePlans:
+    """Chapter-11 parity: pipeline is in the decision space
+    (/root/reference/docs/guide/11_choosing_a_strategy.md:109-127)."""
+
+    def test_pp_plans_enumerated(self, plans_7b32):
+        pp = [p for p in plans_7b32 if p.layout == "pp"]
+        assert pp, "doctor must rank pipeline candidates"
+        for p in pp:
+            assert p.axis2 >= 2
+            # 7b has 32 layers; stages must divide them.
+            assert 32 % p.axis2 == 0
+            assert p.roofline.schedule_factor > 1.0
+
+    def test_pp_mfu_ceiling_below_tp(self, plans_7b32):
+        # The bubble+remat schedule factor must depress every pp
+        # plan's MFU ceiling below the pure-compute 100% line.
+        for p in plans_7b32:
+            if p.layout == "pp":
+                assert p.roofline.mfu_upper_bound < 1.0
+
+
+class TestSlices:
+    def test_slices_filter_and_dcn_cost(self):
+        plans = diagnose(
+            "7b", chips=32, chip="v5e", global_batch=256, slices=2
+        )
+        assert plans
+        for p in plans:
+            # The second axis never straddles slices.
+            assert p.dp % 2 == 0
+            assert p.roofline.slices == 2
+
+    def test_markdown_names_slices(self):
+        plans = diagnose(
+            "7b", chips=32, chip="v5e", global_batch=256, slices=2
+        )
+        md = to_markdown(
+            plans, model="7b", chips=32, chip_name="v5e",
+            global_batch=256, seq_len=4096, moments_dtype="float32",
+            slices=2,
+        )
+        assert "across 2 slices" in md

@@ -133,3 +133,76 @@ def test_measured_chip_spec_substitutes_microbench_rates(monkeypatch):
     assert spec.hbm_gbps == pytest.approx(657.0)
     assert spec.ici_gbps == roofline.CHIPS["v5e"].ici_gbps
     assert spec.hbm_gib == roofline.CHIPS["v5e"].hbm_gib
+
+
+class TestPPLayout:
+    """Pipeline roofline: schedule_factor carries bubble + remat."""
+
+    def test_schedule_factor_exact(self):
+        # 4 stages, 8 microbatches: bubble stretch (8+3)/8, remat 4/3.
+        r = roofline.estimate(
+            BENCH, dp=1, axis2=4, layout="pp",
+            global_batch=8, grad_accum=8,
+        )
+        assert r.layout == "pp"
+        assert r.schedule_factor == pytest.approx((11 / 8) * (4 / 3))
+        # MFU ceiling is depressed by exactly the schedule factor when
+        # the schedule term binds.
+        if r.bound == "schedule":
+            assert r.mfu_upper_bound == pytest.approx(
+                1 / r.schedule_factor
+            )
+
+    def test_more_microbatches_shrink_bubble(self):
+        r8 = roofline.estimate(
+            BENCH, dp=1, axis2=4, layout="pp",
+            global_batch=8, grad_accum=8,
+        )
+        r32 = roofline.estimate(
+            BENCH, dp=1, axis2=4, layout="pp",
+            global_batch=32, grad_accum=32,
+        )
+        assert r32.schedule_factor < r8.schedule_factor
+
+    def test_stage_hops_and_ddp_terms(self):
+        r = roofline.estimate(
+            BENCH, dp=2, axis2=4, layout="pp",
+            global_batch=16, grad_accum=8,
+        )
+        assert "pp_stage_hops" in r.comm_breakdown
+        assert "ddp_grad_allreduce" in r.comm_breakdown
+
+    def test_layers_must_divide_stages(self):
+        with pytest.raises(ValueError, match="divisible by"):
+            roofline.estimate(
+                BENCH, dp=1, axis2=3, layout="pp",
+                global_batch=6, grad_accum=6,
+            )
+
+
+class TestSlices:
+    """Multi-slice data axis: the cross-slice phase rides DCN."""
+
+    def test_dcn_binds_when_slow(self):
+        import dataclasses as dc
+
+        # A chip with near-zero DCN share: two slices must slow the
+        # FSDP axis vs one; single-slice result must be unchanged.
+        slow_dcn = dc.replace(
+            roofline.CHIPS["v5e"], name="slow-dcn", dcn_gbps=0.1
+        )
+        one = roofline.estimate(
+            BENCH, chip=slow_dcn, dp=8, global_batch=16, slices=1
+        )
+        two = roofline.estimate(
+            BENCH, chip=slow_dcn, dp=8, global_batch=16, slices=2
+        )
+        assert two.comm_breakdown["fsdp_data_axis"] > \
+            one.comm_breakdown["fsdp_data_axis"]
+        assert two.slices == 2
+
+    def test_slices_must_divide_dp(self):
+        with pytest.raises(ValueError, match="divisible by slices"):
+            roofline.estimate(
+                BENCH, dp=3, global_batch=6, slices=2
+            )

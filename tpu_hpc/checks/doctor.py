@@ -41,10 +41,10 @@ ACCUM_LADDER = (1, 2, 4, 8, 16, 32, 64)
 class Plan:
     """One candidate (mesh, accum) with its fit and speed verdicts."""
 
-    layout: str          # "tp" | "cp"
+    layout: str          # "tp" | "cp" | "pp"
     dp: int
-    axis2: int           # tp or cp degree (1 = pure FSDP/DP)
-    grad_accum: int
+    axis2: int           # tp/cp/pp degree (1 = pure FSDP/DP)
+    grad_accum: int      # pp: the microbatch count
     fits: bool
     hbm_used_gib: float
     hbm_frac: float      # of the chip's capacity
@@ -54,8 +54,7 @@ class Plan:
     def mesh(self) -> str:
         if self.axis2 == 1:
             return f"fsdp {self.dp}"
-        axis = "tp" if self.layout == "tp" else "cp"
-        return f"dp {self.dp} x {axis} {self.axis2}"
+        return f"dp {self.dp} x {self.layout} {self.axis2}"
 
     @property
     def score(self) -> "tuple[float, float]":
@@ -77,14 +76,18 @@ def _axis2_candidates(
     """Legal second-axis degrees: divisors of the chip count that the
     layout's own divisibility rules accept. TP additionally capped at
     8 -- beyond one ICI ring's worth, the per-block reductions
-    dominate (the roofline would show it, but the candidates list
-    stays readable)."""
+    dominate; PP at 16 stages -- deeper pipes need microbatch counts
+    the accum ladder tops out before (the roofline would show both,
+    but the candidates list stays readable)."""
     out = []
     for d in range(1, min(chips, 64) + 1):
         if chips % d:
             continue
         if layout == "tp":
             if d > 8 or cfg.n_heads % d or cfg.kv_heads % d:
+                continue
+        elif layout == "pp":
+            if d == 1 or d > 16 or cfg.n_layers % d:
                 continue
         else:
             if d == 1 or seq_len % d:
@@ -128,11 +131,18 @@ def diagnose(
     long_context: bool = False,
     max_accum: int = 64,
     measured: bool = False,
+    slices: int = 1,
 ) -> List[Plan]:
     """Rank every legal (mesh, accum) plan for the configuration.
 
     ``long_context`` adds the FSDP x ring-attention (cp) layouts to
     the candidate set (they are always added when seq_len >= 32768).
+    Pipeline (pp) layouts are always in the candidate set -- chapter
+    11's decision space includes them (the reference's,
+    /root/reference/docs/guide/11_choosing_a_strategy.md:109-127).
+    ``slices > 1``: the chips span that many TPU slices; the data
+    axis crosses DCN (plans whose dp does not divide by the slice
+    count are dropped -- the model axis must stay inside a slice).
     Returns plans sorted best-first; [0] is the recommendation.
     """
     cfg = llama2.PRESETS[model]
@@ -143,7 +153,7 @@ def diagnose(
     if measured:
         spec = measured_chip_spec(spec)
 
-    layouts = ["tp"]
+    layouts = ["tp", "pp"]
     if long_context or seq_len >= 32768:
         layouts.append("cp")
     plans: List[Plan] = []
@@ -151,6 +161,10 @@ def diagnose(
         for axis2 in _axis2_candidates(cfg, chips, layout, seq_len):
             dp = chips // axis2
             if global_batch % dp:
+                continue
+            if slices > 1 and dp % slices:
+                # The second axis may not straddle slice boundaries;
+                # only the data axis rides DCN.
                 continue
             accum, fitres = _min_fitting_accum(
                 cfg, dp, axis2, layout, global_batch, seq_len,
@@ -162,6 +176,7 @@ def diagnose(
                 cfg, chip=spec, dp=dp, axis2=axis2, layout=layout,
                 global_batch=global_batch, seq_len=seq_len,
                 grad_accum=accum, moments_dtype=moments_dtype,
+                slices=slices,
             )
             plans.append(Plan(
                 layout=layout, dp=dp, axis2=axis2, grad_accum=accum,
@@ -177,11 +192,15 @@ def diagnose(
 def to_markdown(
     plans: List[Plan], *, model: str, chips: int, chip_name: str,
     global_batch: int, seq_len: int, moments_dtype: str,
+    slices: int = 1,
 ) -> str:
     tokens = global_batch * seq_len
     lines = [
-        f"# doctor -- {model} on {chips}x {chip_name}, batch "
-        f"{global_batch} x {seq_len} ({tokens / 1e6:.2f}M tokens/step)",
+        f"# doctor -- {model} on {chips}x {chip_name}"
+        + (f" across {slices} slices (data axis on DCN)"
+           if slices > 1 else "")
+        + f", batch {global_batch} x {seq_len} "
+        f"({tokens / 1e6:.2f}M tokens/step)",
         "",
         "| mesh | accum | HBM/chip | fits | bound | MFU <= | "
         "tok/s/chip <= |",
@@ -208,10 +227,7 @@ def to_markdown(
         ]
         return "\n".join(lines)
     best = plans[0]
-    axis_flag = (
-        f"--tp {best.axis2}" if best.layout == "tp"
-        else f"--cp {best.axis2}"
-    )
+    axis_flag = f"--{best.layout} {best.axis2}"
     lines += [
         f"**Recommended: {best.mesh}, grad accum {best.grad_accum}** "
         f"-- {best.hbm_used_gib:.1f} GiB/chip, "
@@ -223,9 +239,9 @@ def to_markdown(
         "Reproduce / deepen:",
         "```bash",
         f"python -m tpu_hpc.checks.fit --model {model} "
-        f"--dp {best.dp} --tp {best.axis2} "
+        f"--dp {best.dp} {axis_flag} "
         f"--global-batch {global_batch} --seq-len {seq_len} "
-        f"--grad-accum-steps {best.grad_accum}"
+        f"--grad-accum {best.grad_accum}"
         + (f" --moments-dtype {moments_dtype}"
            if moments_dtype != "float32" else "")
         + ("  # add --tpu-topology vXx... for the real lowering"),
@@ -259,13 +275,18 @@ def main(argv=None) -> int:
     p.add_argument("--measured", action="store_true",
                    help="calibrate the roofline against this host's "
                    "chip (runs the env-check microbenchmark)")
+    p.add_argument("--slices", type=int, default=1,
+                   help="TPU slices the chips span (multi-slice over "
+                   "DCN): the data axis crosses slices "
+                   "(MeshSpec.dcn_axes); layouts whose dp cannot "
+                   "divide into the slices are dropped")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
     plans = diagnose(
         args.model, args.chips, args.chip, args.global_batch,
         args.seq_len, args.moments_dtype, args.long_context,
-        measured=args.measured,
+        measured=args.measured, slices=args.slices,
     )
     seq = args.seq_len or llama2.PRESETS[args.model].max_seq_len
     if args.json:
@@ -289,6 +310,7 @@ def main(argv=None) -> int:
             plans, model=args.model, chips=args.chips,
             chip_name=args.chip, global_batch=args.global_batch,
             seq_len=seq, moments_dtype=args.moments_dtype,
+            slices=args.slices,
         ))
     return 0 if plans and plans[0].fits else 1
 

@@ -231,6 +231,46 @@ def activation_model_cp(
     }
 
 
+def activation_model_pp(
+    cfg: llama2.LlamaConfig, dp: int, stages: int,
+    global_batch: int, seq_len: int, microbatches: int,
+) -> Dict[str, int]:
+    """Per-chip activation bytes for the pipeline layout (1F1B,
+    pp.pipelined): each chip holds ONE stage's layers; at the 1F1B
+    steady state up to ``stages`` microbatches are in flight per chip,
+    each contributing its stage's residual checkpoints (the custom-vjp
+    backward recomputes everything else). Sequence is NOT sharded
+    (full seq per chip, flash attention assumed -- no S x S scores).
+    """
+    if global_batch % (dp * microbatches):
+        raise ValueError(
+            f"global_batch {global_batch} must divide into dp {dp} x "
+            f"microbatches {microbatches} rows"
+        )
+    mbr = global_batch // dp // microbatches  # rows per microbatch
+    d, hd = cfg.dim, cfg.head_dim
+    h, kv = cfg.n_heads, cfg.kv_heads
+    bf16, f32 = 2, 4
+    layers_loc = cfg.n_layers // stages
+    in_flight = min(stages, microbatches)
+    checkpoints = (
+        in_flight * (layers_loc + 1) * mbr * seq_len * d * bf16
+    )
+    qkv = mbr * seq_len * (h + 2 * kv) * hd * bf16
+    attn_out = mbr * seq_len * h * hd * bf16
+    lse = mbr * h * seq_len * f32
+    mlp = 2 * mbr * seq_len * cfg.ffn_hidden * bf16
+    block_live = 2 * (
+        mbr * seq_len * d * bf16 + qkv + attn_out + lse + mlp
+    )
+    head = mbr * seq_len * cfg.vocab_size * (2 * bf16 + f32)
+    return {
+        "inflight_stage_checkpoints": checkpoints,
+        "block_recompute_live": block_live,
+        "lm_head_and_loss": head,
+    }
+
+
 def _count_collectives(hlo: str) -> Dict[str, int]:
     """Collective op applications in compiled HLO, across backend
     spellings: plain ``op(``, the async pair form ``op-start(`` (the
@@ -297,15 +337,22 @@ def analyze(
     """
     if cfg is None:
         cfg = llama2.LlamaConfig(max_seq_len=seq_len, remat=True)
-    if layout not in ("tp", "cp"):
-        raise ValueError(f"unknown layout {layout!r} (tp|cp)")
-    axis2 = "model" if layout == "tp" else "context"
+    if layout not in ("tp", "cp", "pp"):
+        raise ValueError(f"unknown layout {layout!r} (tp|cp|pp)")
+    axis2 = "model" if layout == "tp" else (
+        "context" if layout == "cp" else "pipe"
+    )
     if layout == "tp":
         tp.validate_tp_degree(cfg.n_heads, cfg.kv_heads, tp_size)
-    elif seq_len % tp_size:
+    elif layout == "cp" and seq_len % tp_size:
         raise ValueError(
             f"context parallelism needs seq_len {seq_len} divisible "
             f"by the ring degree {tp_size}"
+        )
+    elif layout == "pp" and cfg.n_layers % tp_size:
+        raise ValueError(
+            f"pipeline needs n_layers {cfg.n_layers} divisible by "
+            f"the stage count {tp_size}"
         )
     if grad_accum < 1 or global_batch % grad_accum or (
         (global_batch // grad_accum) % dp
@@ -313,6 +360,38 @@ def analyze(
         raise ValueError(
             f"grad_accum {grad_accum} must divide global_batch "
             f"{global_batch} into microbatches divisible by dp {dp}"
+        )
+
+    if layout == "pp":
+        # Analytic-only: the Llama model is not stage-split in this
+        # repo (pp.pipelined pipelines the homogeneous
+        # PipelineTransformer); the stage-shard byte accounting below
+        # mirrors pp.stage_pspecs (params stage-local, replicated over
+        # data -- the PP x DP composition bench_llama_pp runs).
+        if do_compile:
+            raise ValueError(
+                "layout='pp' is analytic-only (do_compile=False): the "
+                "compile pass certifies the GSPMD tp/cp shardings; the "
+                "pipeline step's compile evidence lives in "
+                "tests/test_pp.py and the bench"
+            )
+        f32 = 4
+        mom = 2 if moments_dtype == "bfloat16" else 4
+        p_stage = llama2.pp_worst_stage_params(cfg, tp_size)
+        return FitResult(
+            cfg=cfg, dp=dp, tp_size=tp_size, global_batch=global_batch,
+            seq_len=seq_len, hbm_gib=hbm_gib,
+            n_params=llama2.count_params(cfg),
+            param_bytes=p_stage * f32,
+            grad_bytes=p_stage * f32,
+            opt_bytes=p_stage * 2 * mom,
+            act_bytes=activation_model_pp(
+                cfg, dp, tp_size, global_batch, seq_len, grad_accum
+            ),
+            grad_accum=grad_accum,
+            moments_dtype=moments_dtype,
+            layout="pp",
+            attn=attn,
         )
 
     abstract_params = jax.eval_shape(
@@ -492,11 +571,12 @@ def to_markdown(r: FitResult) -> str:
     act_total = sum(r.act_bytes.values())
     chips = r.dp * r.tp_size
     size_b = f"{r.n_params/1e9:.0f}B"
-    strategy = (
-        "hybrid FSDPxTP(+SP)" if r.layout == "tp"
-        else "FSDP x ring-attention context parallel"
-    )
-    axis2 = "model" if r.layout == "tp" else "context"
+    strategy = {
+        "tp": "hybrid FSDPxTP(+SP)",
+        "cp": "FSDP x ring-attention context parallel",
+        "pp": "DP x pipeline (1F1B)",
+    }[r.layout]
+    axis2 = {"tp": "model", "cp": "context", "pp": "pipe"}[r.layout]
     lines = [
         f"# {size_b} shard/fit analysis -- Llama-2 {strategy} "
         f"on a {chips}-chip (data={r.dp} x {axis2}={r.tp_size}) mesh",
@@ -518,8 +598,12 @@ def to_markdown(r: FitResult) -> str:
         f"{r.dp*r.tp_size} chips "
         + (
             "(FSDP over `data`, Megatron TP+SP over `model`)"
-            if r.layout == "tp"
-            else "(FSDP over `data`, ring attention over `context`: "
+            if r.layout == "tp" else
+            "(DP over `data`, stage-sharded layers over `pipe`: "
+            f"each chip holds {cfg.n_layers//r.tp_size} of "
+            f"{cfg.n_layers} layers)"
+            if r.layout == "pp" else
+            "(FSDP over `data`, ring attention over `context`: "
             f"each chip holds {r.seq_len//r.tp_size} of "
             f"{r.seq_len} tokens)"
         ),
@@ -537,7 +621,8 @@ def to_markdown(r: FitResult) -> str:
         "| Component | Bytes | GiB |",
         "|---|---|---|",
         f"| params (fp32, "
-        f"{'FSDPxTP-sharded' if r.layout == 'tp' else 'FSDP-sharded'}) "
+        + {"tp": "FSDPxTP-sharded", "cp": "FSDP-sharded",
+           "pp": "stage-sharded, worst stage"}[r.layout] + ") "
         f"| {r.param_bytes:,} | "
         f"{r.param_bytes/GIB:.2f} |",
         f"| gradients (fp32, same layout) | {r.grad_bytes:,} | "
@@ -560,15 +645,17 @@ def to_markdown(r: FitResult) -> str:
         "",
         "Static accounting is exact (eval_shape + the PartitionSpec "
         "plan); the activation rows are the analytic model described "
-        + (
-            "in `tpu_hpc/checks/fit.py:activation_model` "
+        + {
+            "tp": "in `tpu_hpc/checks/fit.py:activation_model` "
             "(remat-per-block, SP-sharded residual checkpoints, flash "
-            "attention)."
-            if r.layout == "tp" else
-            "in `tpu_hpc/checks/fit.py:activation_model_cp` "
+            "attention).",
+            "cp": "in `tpu_hpc/checks/fit.py:activation_model_cp` "
             "(remat-per-block, context-sharded residual stream, "
-            "double-buffered KV ring, full-width FFN/vocab)."
-        ),
+            "double-buffered KV ring, full-width FFN/vocab).",
+            "pp": "in `tpu_hpc/checks/fit.py:activation_model_pp` "
+            "(1F1B: up to `stages` in-flight microbatches of stage "
+            "checkpoints, custom-vjp backward remat, full seq/chip).",
+        }[r.layout],
     ]
     if r.compiled:
         lines += [
@@ -719,6 +806,11 @@ def main(argv=None) -> int:
                         "to the long-context layout (FSDP over data x "
                         "ring attention over context; no TP) and "
                         "replaces --tp as the second mesh axis")
+    parser.add_argument("--pp", type=int, default=0,
+                        help="pipeline stage count: switches to the "
+                        "PP x DP layout (stage-sharded params, "
+                        "--grad-accum = microbatch count); analytic "
+                        "only -- implies --no-compile")
     parser.add_argument("--global-batch", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=4096)
     parser.add_argument("--hbm-gib", type=float, default=32.0)
@@ -772,6 +864,10 @@ def main(argv=None) -> int:
     # else re-exec in a child that comes up simulated. A TPU-topology
     # compile needs no devices at all -- libtpu compiles against the
     # topology description -- so skip provisioning entirely.
+    if args.pp and args.cp:
+        parser.error("--pp and --cp are mutually exclusive")
+    if args.pp:
+        args.no_compile = True  # pp is analytic-only (see analyze())
     if not args.no_compile and args.tpu_topology is None:
         from tpu_hpc.runtime import sim
 
@@ -795,14 +891,14 @@ def main(argv=None) -> int:
     if args.layers is not None:
         cfg = dataclasses.replace(cfg, n_layers=args.layers)
     r = analyze(
-        cfg=cfg, dp=args.dp, tp_size=args.cp or args.tp,
+        cfg=cfg, dp=args.dp, tp_size=args.pp or args.cp or args.tp,
         global_batch=args.global_batch, seq_len=args.seq_len,
         hbm_gib=args.hbm_gib, do_compile=not args.no_compile,
         grad_accum=args.grad_accum, tpu_topology=args.tpu_topology,
         attn=args.attn,
         compiler_options=_parse_xla_opts(args.xla_opt),
         moments_dtype=args.moments_dtype,
-        layout="cp" if args.cp else "tp",
+        layout="pp" if args.pp else ("cp" if args.cp else "tp"),
     )
     md = to_markdown(r)
     if args.markdown:

@@ -46,7 +46,13 @@ GIB = 1024 ** 3
 @dataclasses.dataclass(frozen=True)
 class ChipSpec:
     """Approximate public per-chip numbers (spec sheets / the public
-    scaling literature); ici_gbps is ONE link, one direction."""
+    scaling literature); ici_gbps is ONE link, one direction.
+    ``dcn_gbps`` is the per-chip share of the data-center network
+    between slices (host NIC bandwidth / chips per host) -- an
+    order-of-magnitude planning figure, ~25-50x slower than ICI,
+    which is exactly why only the bandwidth-tolerant FSDP data axis
+    should span slices (the reference's Slingshot doctrine,
+    fsdp_tp/fsdp_tp_example.py:12-26)."""
 
     name: str
     peak_bf16_flops: float
@@ -54,13 +60,14 @@ class ChipSpec:
     #                  owns does-it-fit, this module owns how-fast
     hbm_gbps: float
     ici_gbps: float
+    dcn_gbps: float = 12.5
 
 
 CHIPS: Dict[str, ChipSpec] = {
-    "v4": ChipSpec("v4", 275e12, 32, 1228, 50),
-    "v5e": ChipSpec("v5e", 197e12, 16, 819, 45),
-    "v5p": ChipSpec("v5p", 459e12, 95, 2765, 100),
-    "v6e": ChipSpec("v6e", 918e12, 32, 1640, 90),
+    "v4": ChipSpec("v4", 275e12, 32, 1228, 50, 12.5),
+    "v5e": ChipSpec("v5e", 197e12, 16, 819, 45, 6.25),
+    "v5p": ChipSpec("v5p", 459e12, 95, 2765, 100, 12.5),
+    "v6e": ChipSpec("v6e", 918e12, 32, 1640, 90, 12.5),
 }
 
 
@@ -77,8 +84,8 @@ def _ring_collective_s(bytes_full: int, n: int, bw_gbps: float) -> float:
 class RooflineResult:
     chip: ChipSpec
     dp: int
-    axis2: int                  # tp or cp degree
-    layout: str                 # "tp" | "cp" | "dp" (axis2 == 1)
+    axis2: int                  # tp, cp, or pp degree
+    layout: str                 # "tp" | "cp" | "pp" | "dp" (axis2 == 1)
     global_batch: int
     seq_len: int
     grad_accum: int
@@ -88,6 +95,11 @@ class RooflineResult:
     comm_s: float
     comm_breakdown: Dict[str, float]
     memory_breakdown: Dict[str, float]
+    # Multiplies compute_s in the step bound but NOT in MFU's
+    # numerator: schedule-inherent FLOP overheads (the 1F1B backward's
+    # forward remat) and idle time (pipeline bubble). 1.0 for tp/cp.
+    schedule_factor: float = 1.0
+    slices: int = 1             # DCN slices the data axis spans
 
     @property
     def chips(self) -> int:
@@ -95,13 +107,16 @@ class RooflineResult:
 
     @property
     def step_time_lower_bound_s(self) -> float:
-        return max(self.compute_s, self.memory_s, self.comm_s)
+        return max(
+            self.compute_s * self.schedule_factor,
+            self.memory_s, self.comm_s,
+        )
 
     @property
     def bound(self) -> str:
         t = self.step_time_lower_bound_s
-        if t == self.compute_s:
-            return "compute"
+        if t == self.compute_s * self.schedule_factor:
+            return "compute" if self.schedule_factor == 1.0 else "schedule"
         return "memory" if t == self.memory_s else "comm"
 
     @property
@@ -148,19 +163,29 @@ def estimate(
     seq_len: Optional[int] = None,
     grad_accum: int = 1,
     moments_dtype: str = "float32",
+    slices: int = 1,
 ) -> RooflineResult:
     """Roofline bounds for one training step of the Llama family.
 
     ``layout="tp"``: hybrid FSDP(data) x Megatron-TP+SP(model).
     ``layout="cp"``: FSDP(data) x ring-attention context(axis2).
+    ``layout="pp"``: DP(data) x pipeline(axis2 stages), 1F1B schedule
+    with ``grad_accum`` microbatches -- the schedule's bubble and
+    backward-remat overheads enter the step bound via
+    ``schedule_factor`` (and so depress the MFU ceiling) without
+    inflating MFU's FLOP numerator.
     ``axis2=1`` degenerates to DP/FSDP-only either way.
+    ``slices > 1``: the data axis spans that many TPU slices over DCN
+    (MeshSpec.dcn_axes); its collective's cross-slice phase runs at
+    ``chip.dcn_gbps`` and the axis term takes the slower of the two
+    phases -- the quantitative form of "only FSDP crosses slices".
     ``chip`` is a CHIPS key or a ChipSpec (e.g. measured_chip_spec's
     host-calibrated rates).
     """
     if cfg is None:
         cfg = llama2.LlamaConfig()
-    if layout not in ("tp", "cp"):
-        raise ValueError(f"unknown layout {layout!r} (tp|cp)")
+    if layout not in ("tp", "cp", "pp"):
+        raise ValueError(f"unknown layout {layout!r} (tp|cp|pp)")
     c = CHIPS[chip] if isinstance(chip, str) else chip
     s = seq_len or cfg.max_seq_len
     n_chips = dp * axis2
@@ -173,10 +198,20 @@ def estimate(
             f"global_batch {global_batch} must divide into dp {dp} x "
             f"grad_accum {grad_accum} microbatch rows"
         )
-    if s % max(axis2, 1):
+    if layout != "pp" and s % max(axis2, 1):
         raise ValueError(
             f"seq_len {s} must be divisible by the second mesh axis "
             f"{axis2} (fit.analyze rejects the same configuration)"
+        )
+    if layout == "pp" and cfg.n_layers % max(axis2, 1):
+        raise ValueError(
+            f"pipeline needs n_layers {cfg.n_layers} divisible by "
+            f"the stage count {axis2}"
+        )
+    if slices > 1 and dp % slices:
+        raise ValueError(
+            f"dp {dp} must be divisible by slices {slices} "
+            f"(the DCN component of the data axis)"
         )
     n_params = llama2.count_params(cfg)
 
@@ -184,6 +219,12 @@ def estimate(
     compute_s = (
         tokens * cfg.flops_per_token(s) / (c.peak_bf16_flops * n_chips)
     )
+
+    if layout == "pp":
+        return _estimate_pp(
+            cfg, c, dp, axis2, global_batch, s, grad_accum,
+            moments_dtype, tokens, compute_s, slices,
+        )
 
     # -- memory bound: per-chip HBM bytes each step must move --
     shard = dp * (axis2 if layout == "tp" else 1)  # param shard ways
@@ -214,8 +255,8 @@ def estimate(
             axis2 if layout == "tp" else 1
         ) * bf16
         rs_bytes = n_params / (axis2 if layout == "tp" else 1) * f32
-        comm["fsdp_data_axis"] = _ring_collective_s(
-            int(gather_bytes + rs_bytes), dp, c.ici_gbps
+        comm["fsdp_data_axis"] = _two_tier_collective_s(
+            int(gather_bytes + rs_bytes), dp, slices, c
         )
     if axis2 > 1 and layout == "tp":
         # Megatron-SP: RS+AG pair twice per layer fwd and twice bwd on
@@ -247,6 +288,93 @@ def estimate(
         tokens_per_step=tokens,
         compute_s=compute_s, memory_s=memory_s, comm_s=comm_s,
         comm_breakdown=comm, memory_breakdown=mem,
+        slices=slices,
+    )
+
+
+def _two_tier_collective_s(
+    bytes_full: int, n: int, slices: int, c: ChipSpec
+) -> float:
+    """Data-axis collective time when the axis spans ``slices`` DCN
+    slices: the intra-slice phase rings (n/slices)-wide over ICI, the
+    cross-slice phase moves each chip's 1/n shard (slices-1)/slices
+    of the way over its DCN share. The axis is bound by the slower
+    phase (the phases pipeline in a well-scheduled hierarchical
+    collective)."""
+    if slices <= 1:
+        return _ring_collective_s(bytes_full, n, c.ici_gbps)
+    per_slice = n // slices
+    ici_s = _ring_collective_s(bytes_full, per_slice, c.ici_gbps)
+    dcn_bytes = bytes_full / n * (slices - 1)
+    return max(ici_s, dcn_bytes / (c.dcn_gbps * 1e9))
+
+
+def _estimate_pp(
+    cfg, c: ChipSpec, dp: int, stages: int, global_batch: int,
+    s: int, microbatches: int, moments_dtype: str,
+    tokens: int, compute_s: float, slices: int,
+) -> RooflineResult:
+    """Pipeline layout bounds: stage-sharded params (replicated over
+    ``data`` -- the repo's PP x DP composition, pp.stage_pspecs),
+    1F1B schedule with ``microbatches`` microbatches per step.
+
+    Two schedule-inherent overheads enter ``schedule_factor``:
+      * bubble: wall ticks / work ticks = (M + S - 1) / M
+        (pp.bubble_fraction's exact v=1 form), and
+      * backward remat: the 1f1b custom-vjp recomputes the forward,
+        +1/3 of the 6ND FLOPs.
+    Neither inflates MFU's numerator -- a 4-stage 8-microbatch plan
+    honestly shows its <= 72% ceiling instead of pretending the
+    bubble away.
+    """
+    bf16, f32 = 2, 4
+    mom = 2 if moments_dtype == "bfloat16" else 4
+    M = microbatches
+    # Worst stage: its share of layers plus the embed/head edge
+    # weights -- doctor plans must fit the worst chip.
+    p_stage = llama2.pp_worst_stage_params(cfg, stages)
+    bl = global_batch // dp           # rows per data shard per step
+    mem = {
+        # bf16 stage params re-read fwd+bwd each microbatch tick.
+        "param_reads": M * 2 * p_stage * bf16,
+        "grad_write_and_opt": p_stage * (f32 + 2 * (f32 + mom)),
+        # Per-layer residual checkpoints written fwd / read bwd, all
+        # rows across the step (microbatching splits, not shrinks).
+        "activation_checkpoints": (
+            2 * (cfg.n_layers // stages + 1) * bl * s * cfg.dim * bf16
+        ),
+        # Last stage's logits roundtrip (worst chip again).
+        "logits_roundtrip": 2 * bl * s * cfg.vocab_size * bf16,
+    }
+    memory_s = sum(mem.values()) / (c.hbm_gbps * 1e9)
+
+    comm = {}
+    if stages > 1:
+        # Stage-boundary activation hops: every row crosses each
+        # boundary once fwd (bf16 acts) + once bwd (bf16 grads) on a
+        # neighbor ICI link -- M microbatches of bl/M rows each.
+        comm["pp_stage_hops"] = (
+            2 * bl * s * cfg.dim * bf16 / (c.ici_gbps * 1e9)
+        )
+    if dp > 1:
+        # DDP over data: one fp32 gradient all-reduce of the stage
+        # shard per step (ring all-reduce moves ~2x the buffer).
+        comm["ddp_grad_allreduce"] = _two_tier_collective_s(
+            2 * p_stage * f32, dp, slices, c
+        )
+    comm_s = max(comm.values()) if comm else 0.0
+
+    bubble_stretch = (M + stages - 1) / M
+    remat = 4.0 / 3.0  # 1f1b backward recomputes the forward
+    return RooflineResult(
+        chip=c, dp=dp, axis2=stages,
+        layout="pp" if stages > 1 else "dp",
+        global_batch=global_batch, seq_len=s, grad_accum=M,
+        tokens_per_step=tokens,
+        compute_s=compute_s, memory_s=memory_s, comm_s=comm_s,
+        comm_breakdown=comm, memory_breakdown=mem,
+        schedule_factor=bubble_stretch * remat,
+        slices=slices,
     )
 
 
@@ -264,7 +392,13 @@ def to_markdown(r: RooflineResult, cfg: llama2.LlamaConfig) -> str:
         "| bound | time/step | detail |",
         "|---|---|---|",
         f"| compute | {r.compute_s*ms:.2f} ms | model FLOPs at "
-        f"{r.chip.peak_bf16_flops/1e12:.0f} TF/chip peak |",
+        f"{r.chip.peak_bf16_flops/1e12:.0f} TF/chip peak |"
+        + (
+            f"\n| schedule | {r.compute_s*r.schedule_factor*ms:.2f} ms "
+            f"| compute x {r.schedule_factor:.2f} (pipeline bubble + "
+            f"1f1b backward remat) |"
+            if r.schedule_factor != 1.0 else ""
+        ),
         f"| memory | {r.memory_s*ms:.2f} ms | "
         + ", ".join(
             f"{k} {v/GIB:.2f} GiB" for k, v in r.memory_breakdown.items()
@@ -301,6 +435,12 @@ def main(argv=None) -> int:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--cp", type=int, default=0,
                    help="ring/context degree (switches layout to cp)")
+    p.add_argument("--pp", type=int, default=0,
+                   help="pipeline stage count (switches layout to pp; "
+                   "--grad-accum is the microbatch count)")
+    p.add_argument("--slices", type=int, default=1,
+                   help="DCN slices the data axis spans (MeshSpec."
+                   "dcn_axes); cross-slice phase costed at dcn_gbps")
     p.add_argument("--global-batch", type=int, default=4)
     p.add_argument("--seq-len", type=int, default=None)
     p.add_argument("--grad-accum", type=int, default=1)
@@ -332,14 +472,17 @@ def main(argv=None) -> int:
         measured_chip_spec(CHIPS[args.chip]) if args.measured
         else args.chip
     )
+    if sum(bool(x) for x in (args.cp, args.pp)) > 1:
+        p.error("--cp and --pp are mutually exclusive")
     r = estimate(
         cfg, chip=chip, dp=args.dp,
-        axis2=args.cp or args.tp,
-        layout="cp" if args.cp else "tp",
+        axis2=args.pp or args.cp or args.tp,
+        layout="pp" if args.pp else ("cp" if args.cp else "tp"),
         global_batch=args.global_batch,
         seq_len=args.seq_len or cfg.max_seq_len,
         grad_accum=args.grad_accum,
         moments_dtype=args.moments_dtype,
+        slices=args.slices,
     )
     if args.json:
         print(json.dumps({

@@ -165,6 +165,7 @@ PRESETS: Dict[str, LlamaConfig] = {
 }
 
 
+@functools.lru_cache(maxsize=None)
 def count_params(cfg: "LlamaConfig") -> int:
     """Total trainable parameters for ``cfg``, via eval_shape of the
     real init (no arrays materialized). The single source both
@@ -178,6 +179,59 @@ def count_params(cfg: "LlamaConfig") -> int:
     )
     return sum(
         int(np.prod(l.shape)) for l in jax.tree.leaves(abstract)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def count_params_by_part(cfg: "LlamaConfig") -> "Dict[str, int]":
+    """Param counts split by pipeline role: one transformer layer
+    (``per_layer``), the token embedding (``embed``), the LM head
+    (``head``), and everything else (``other``, the final norm).
+    Source for the pipeline-parallel stage-shard accounting in
+    checks/fit.py and checks/roofline.py -- derived from the same
+    eval_shape tree as count_params, so
+    ``per_layer * n_layers + embed + head + other == count_params``."""
+    import numpy as np
+
+    abstract = jax.eval_shape(
+        lambda: init_llama(jax.random.key(0), cfg)
+    )
+    parts = {"per_layer": 0, "embed": 0, "head": 0, "other": 0}
+    for key, sub in abstract.items():
+        n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(sub))
+        if key == "layers_0":
+            parts["per_layer"] = n
+        elif key.startswith("layers_"):
+            pass  # identical to layers_0 by construction
+        elif key == "tok_embeddings":
+            parts["embed"] = n
+        elif key == "output":
+            parts["head"] = n
+        else:
+            parts["other"] += n
+    return parts
+
+
+def pp_worst_stage_params(cfg: "LlamaConfig", stages: int) -> int:
+    """Params the fullest pipeline stage holds: its share of the
+    layers plus the embed/head edge weights (BOTH on one chip when
+    stages == 1; otherwise the bigger of the two, since embed and
+    head live on opposite ends of the pipe). The single source for
+    the pp byte accounting in checks/fit.py and checks/roofline.py --
+    two copies would silently disagree on per-chip bytes."""
+    if stages < 1 or cfg.n_layers % stages:
+        raise ValueError(
+            f"pipeline needs n_layers {cfg.n_layers} divisible by "
+            f"the stage count {stages}"
+        )
+    parts = count_params_by_part(cfg)
+    edge = (
+        parts["embed"] + parts["head"] if stages == 1
+        else max(parts["embed"], parts["head"])
+    )
+    return (
+        parts["per_layer"] * (cfg.n_layers // stages)
+        + edge + parts["other"]
     )
 
 

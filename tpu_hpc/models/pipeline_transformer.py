@@ -16,11 +16,17 @@ layers, matching the reference's stage contents (:62-88).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+# (q, k, v) [B, L, H, Dh] -> out [B, L, H, Dh]; same contract as
+# llama2.AttnFn, so the Pallas flash kernel drops in for the einsum
+# path (called batch-locally -- inside pp's shard_map each stage owns
+# its full microbatch, so no nested shard_map is needed).
+AttnFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,12 +45,31 @@ class PipeConfig:
     def n_layers(self) -> int:
         return self.n_stages * self.layers_per_stage
 
+    def flops_per_token(self, seq_len: Optional[int] = None) -> int:
+        """Training FLOPs/token (6ND convention, same accounting as
+        LlamaConfig.flops_per_token) -- the MFU denominator. Remat
+        recompute (the 1f1b schedules' backward) is deliberately NOT
+        counted: it is overhead, and counting it would flatter MFU."""
+        s = seq_len if seq_len is not None else self.max_seq_len
+        d = self.dim
+        per_layer = (
+            2 * d * 3 * d          # qkv projection
+            + 2 * d * d            # out projection
+            + 2 * 2 * d * self.mlp_ratio * d  # fc1 + fc2
+            + 2 * s * d            # causal QK^T + AV (halved by mask)
+        )
+        head = 2 * d * self.vocab_size
+        return 3 * (self.n_layers * per_layer + head)
+
 
 class CausalLayer(nn.Module):
     """Pre-LN causal self-attention + GELU MLP (the reference stage
-    block's layer, 03_pipeline_training.py:62-88)."""
+    block's layer, 03_pipeline_training.py:62-88). ``attn_fn``
+    replaces the einsum-softmax core when given (e.g. the Pallas
+    flash kernel: no [L, L] score buffer)."""
 
     cfg: PipeConfig
+    attn_fn: AttnFn = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -57,11 +82,14 @@ class CausalLayer(nn.Module):
         q = q.reshape(B, L, H, D // H)
         k = k.reshape(B, L, H, D // H)
         v = v.reshape(B, L, H, D // H)
-        scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(D // H)
-        mask = jnp.tril(jnp.ones((L, L), bool))
-        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-        out = jnp.einsum("bhlm,bmhd->blhd", attn.astype(x.dtype), v)
+        if self.attn_fn is not None:
+            out = self.attn_fn(q, k, v)
+        else:
+            scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(D // H)
+            mask = jnp.tril(jnp.ones((L, L), bool))
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+            attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            out = jnp.einsum("bhlm,bmhd->blhd", attn.astype(x.dtype), v)
         x = x + nn.Dense(D, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="proj")(
             out.reshape(B, L, D)
         )
@@ -76,11 +104,12 @@ class StageBlock(nn.Module):
     Shape-preserving ([B, L, D] -> [B, L, D]) as pp.pipelined requires."""
 
     cfg: PipeConfig
+    attn_fn: AttnFn = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         for i in range(self.cfg.layers_per_stage):
-            x = CausalLayer(self.cfg, name=f"layer_{i}")(x)
+            x = CausalLayer(self.cfg, self.attn_fn, name=f"layer_{i}")(x)
         return x
 
 
@@ -131,9 +160,9 @@ def head(params: Dict, x: jax.Array, cfg: PipeConfig) -> jax.Array:
     return (x @ h["kernel"]).astype(jnp.float32)
 
 
-def make_stage_fn(cfg: PipeConfig):
+def make_stage_fn(cfg: PipeConfig, attn_fn: AttnFn = None):
     """stage_fn(stage_params, x) for tpu_hpc.parallel.pp.pipelined."""
-    block = StageBlock(cfg)
+    block = StageBlock(cfg, attn_fn)
 
     def stage_fn(stage_params, x):
         return block.apply({"params": stage_params}, x)
