@@ -90,9 +90,6 @@ def main(argv=None) -> int:
     else:
         mesh = build_mesh(MeshSpec(axes={"data": -1}))
     param_dtype, compute_dtype = cfg.jax_dtypes()
-    model_cfg = resnet.ResNetConfig(
-        depth=ns.depth, dtype=compute_dtype, param_dtype=param_dtype,
-    )
     if ns.dataset == "digits":
         from tpu_hpc.native import vision
 
@@ -101,9 +98,19 @@ def main(argv=None) -> int:
             lambda: vision.prepare_digits(prefix),
             [prefix + ".train", prefix + ".test", prefix + ".json"],
         )
-        sample_shape = tuple(vision.read_meta(prefix)["x_shape"])
+        meta0 = vision.read_meta(prefix)
+        sample_shape = tuple(meta0["x_shape"])
+        # The file's class count, not the CIFAR default: an --npz
+        # dataset with more classes would otherwise silently train a
+        # 10-way head (out-of-range labels zero out of the CE mask).
+        num_classes = meta0["n_classes"]
     else:
         sample_shape = datasets.CIFARSynthetic().sample_shape
+        num_classes = 10
+    model_cfg = resnet.ResNetConfig(
+        depth=ns.depth, num_classes=num_classes,
+        dtype=compute_dtype, param_dtype=param_dtype,
+    )
     params, model_state = resnet.init_resnet(
         jax.random.key(cfg.seed), model_cfg, sample_shape
     )

@@ -1,0 +1,169 @@
+"""Real-corpus convergence run: prove the full stack trains.
+
+Everything in one reproducible command: build a REAL text corpus from
+local files (default: the Python standard library source tree -- ~30 MB
+of real code text present on any machine, no download), tokenize it
+byte-level into train/eval token binaries (deterministic split by file
+hash), then train a small Llama through the native C++ loader with a
+held-out eval pass every epoch. Train AND eval loss land in the
+metrics JSONL -- the loss-curve artifact.
+
+The reference's only real-data training is CIFAR-10
+(/root/reference/scripts/main.py:332-397); its Llama examples train on
+random tokens. This run is the LLM-side counterpart: real bytes, real
+next-token loss, falling on data the model has never seen.
+
+Run (real chip or sim):
+  python real_corpus_convergence.py --steps-per-epoch 100 --epochs 5 \
+      --global-batch-size 16 --metrics-path runs/convergence.jsonl
+"""
+import os as _os
+import sys as _sys
+
+# Run directly from a source checkout without installing: put the repo
+# root on sys.path (the reference uses the same pattern, e.g.
+# resnet_fsdp_training.py:27).
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
+import argparse
+import hashlib
+import os
+import sys
+import sysconfig
+
+import jax
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.models import llama2
+from tpu_hpc.native import NativeTokenDataset
+from tpu_hpc.native.prepare import prepare_corpus
+from tpu_hpc.native.dataloader import prepare_on_host0
+from tpu_hpc.parallel import fsdp, hybrid, tp
+from tpu_hpc.runtime import build_mesh, init_distributed
+from tpu_hpc.train import Trainer
+
+
+def split_files(root: str, eval_every: int = 20):
+    """Deterministic train/eval split of the ``.py`` files under
+    ``root``: a file is eval iff md5(relpath) % eval_every == 0 --
+    stable across hosts and runs, no RNG, disjoint by construction."""
+    train, evals = [], []
+    for dirpath, _, names in sorted(os.walk(root)):
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, name)
+            rel = os.path.relpath(p, root)
+            h = int.from_bytes(
+                hashlib.md5(rel.encode()).digest()[:4], "big"
+            )
+            (evals if h % eval_every == 0 else train).append(p)
+    return train, evals
+
+
+def main(argv=None) -> int:
+    extra = argparse.ArgumentParser(add_help=False)
+    extra.add_argument(
+        "--corpus-root", default=sysconfig.get_paths()["stdlib"],
+        help="directory of .py text files (default: the Python "
+        "standard library source)",
+    )
+    extra.add_argument("--corpus-dir", default="data/pycorpus",
+                       help="where the token binaries are written")
+    extra.add_argument("--dim", type=int, default=256)
+    extra.add_argument("--layers", type=int, default=4)
+    extra.add_argument("--heads", type=int, default=8)
+    extra.add_argument("--seq-len", type=int, default=256)
+    extra.add_argument("--eval-steps", type=int, default=20,
+                       help="held-out batches per eval pass")
+    own, rest = extra.parse_known_args(argv)
+    cfg = TrainingConfig.from_args(rest)
+    logger = get_logger()
+    init_distributed()
+
+    train_tok = os.path.join(own.corpus_dir, "train.tok")
+    eval_tok = os.path.join(own.corpus_dir, "eval.tok")
+
+    def prepare():
+        os.makedirs(own.corpus_dir, exist_ok=True)
+        train_files, eval_files = split_files(own.corpus_root)
+        if not train_files or not eval_files:
+            raise SystemExit(
+                f"no .py files under {own.corpus_root!r}"
+            )
+        info_t = prepare_corpus(train_tok, train_files)
+        info_e = prepare_corpus(eval_tok, eval_files)
+        logger.info(
+            "corpus: %d train files -> %s tokens, %d eval files -> "
+            "%s tokens (byte-level, vocab 257)",
+            len(train_files), f"{info_t['n_tokens']:,}",
+            len(eval_files), f"{info_e['n_tokens']:,}",
+        )
+
+    prepare_on_host0(prepare, [train_tok, eval_tok])
+
+    param_dtype, compute_dtype = cfg.jax_dtypes()
+    model_cfg = llama2.LlamaConfig(
+        dim=own.dim, n_layers=own.layers, n_heads=own.heads,
+        # Byte tokenizer needs 257 ids (256 bytes + EOT); round up to
+        # 512 so the TP Colwise vocab shard divides any tp degree <= 8
+        # (the unused tail rows train to zero logits -- harmless).
+        vocab_size=512,
+        multiple_of=32, max_seq_len=own.seq_len,
+        dtype=compute_dtype, param_dtype=param_dtype,
+    )
+    if cfg.model_parallel == 1:
+        cfg.model_parallel = tp.auto_tp_degree(
+            jax.device_count(), model_cfg.n_heads, model_cfg.kv_heads,
+            cap=4,
+        )
+    tp.validate_tp_degree(
+        model_cfg.n_heads, model_cfg.kv_heads, cfg.model_parallel
+    )
+    mesh = build_mesh(cfg.mesh_spec())
+    dp_size = mesh.shape["data"]
+    params = llama2.init_llama(jax.random.key(cfg.seed), model_cfg)
+    if cfg.model_parallel > 1:
+        specs = hybrid.hybrid_pspecs(
+            params, tp.llama_rules(), data_size=dp_size
+        )
+        constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
+    else:
+        specs = fsdp.param_pspecs(params, axis="data", axis_size=dp_size)
+        constrain = lambda x: x  # noqa: E731
+
+    ds = NativeTokenDataset(
+        train_tok, batch_size=cfg.global_batch_size,
+        seq_len=model_cfg.max_seq_len, seed=cfg.seed,
+    )
+    ds_eval = NativeTokenDataset(
+        eval_tok, batch_size=cfg.global_batch_size,
+        seq_len=model_cfg.max_seq_len, seed=cfg.seed + 1,
+    )
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    logger.info(
+        "model: %.1fM params, mesh %s | train %s tokens, eval %s "
+        "tokens (held-out files)",
+        n_params / 1e6, dict(mesh.shape),
+        f"{ds.n_tokens:,}", f"{ds_eval.n_tokens:,}",
+    )
+    trainer = Trainer(
+        cfg, mesh, llama2.make_forward(model_cfg, constrain), params,
+        param_pspecs=specs,
+    )
+    result = trainer.fit(
+        ds, eval_dataset=ds_eval, eval_steps=own.eval_steps
+    )
+    logger.info(
+        "run summary | final train loss %.5f | metrics curve: %s",
+        result["final_loss"], cfg.metrics_path or "(no --metrics-path)",
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -82,3 +82,40 @@ def test_eval_forward_uses_inference_mode(trained):
     # A constant batch has zero variance: train-mode BN output differs
     # from stored-stats BN output unless the stats happen to match.
     assert not jnp.allclose(train_logits, eval_logits)
+
+
+def test_fit_with_eval_dataset_records_curve(tmp_path):
+    """fit(eval_dataset=...) runs a held-out pass after every epoch and
+    appends 'eval' records to the metrics JSONL -- the convergence-run
+    evidence format (train AND eval loss from one call)."""
+    import json
+
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.parallel import dp
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+    from tpu_hpc.train import Trainer
+
+    metrics = tmp_path / "m.jsonl"
+    cfg = TrainingConfig(
+        epochs=2, steps_per_epoch=2, global_batch_size=8,
+        metrics_path=str(metrics),
+    )
+    mesh = build_mesh(MeshSpec(axes={"data": -1}))
+    model_cfg = resnet.ResNetConfig(depth=18)
+    params, ms = resnet.init_resnet(jax.random.key(0), model_cfg)
+    tr = Trainer(
+        cfg, mesh, resnet.make_forward(model_cfg), params, ms,
+        param_pspecs=dp.param_pspecs(params),
+        eval_forward=resnet.make_eval_forward(model_cfg),
+    )
+    tr.fit(
+        datasets.CIFARSynthetic(),
+        eval_dataset=datasets.CIFARSynthetic(seed=1), eval_steps=1,
+    )
+    recs = [json.loads(l) for l in metrics.read_text().splitlines()]
+    evals = [r for r in recs if r["event"] == "eval"]
+    epochs = [r for r in recs if r["event"] == "epoch"]
+    assert len(epochs) == 2
+    assert len(evals) == 2  # one per epoch
+    for r in evals:
+        assert "loss" in r and "accuracy" in r

@@ -13,6 +13,9 @@ import pytest
 from tpu_hpc.native import dataloader as dl
 from tpu_hpc.native import vision
 
+pytest.importorskip(
+    "sklearn", reason="the bundled real dataset needs scikit-learn"
+)
 pytestmark = pytest.mark.skipif(
     not dl.native_available(), reason="native loader unavailable"
 )

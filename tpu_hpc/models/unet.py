@@ -19,6 +19,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tpu_hpc.models.resnet import BN_MOMENTUM
+
 
 @dataclasses.dataclass(frozen=True)
 class UNetConfig:
@@ -42,10 +44,8 @@ class ConvBlock(nn.Module):
             x = nn.Conv(self.features, (3, 3), padding="SAME",
                         dtype=self.dtype,
                         param_dtype=self.param_dtype)(x)
-            # momentum 0.9 = torch's default 0.1 in flax's convention
-            # (see models/resnet.py:BN_MOMENTUM).
             x = nn.BatchNorm(use_running_average=not train,
-                             momentum=0.9,
+                             momentum=BN_MOMENTUM,
                              dtype=self.dtype,
                              param_dtype=self.param_dtype)(x)
             x = nn.relu(x)

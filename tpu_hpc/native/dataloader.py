@@ -259,6 +259,31 @@ class NativeERA5Stream(_PrefetchedStream):
 _FILE_MAGIC = 0x3144435048555054  # "TPUHPCD1" little-endian
 
 
+def prepare_on_host0(prepare_fn, paths) -> None:
+    """Host 0 materializes ``paths`` via ``prepare_fn`` if any is
+    missing; every host then synchronizes before reading them -- the
+    reference's rank-0-download + dist.barrier() pattern
+    (resnet_fsdp_training.py:60-65) without the race. Generic over
+    what is being prepared (image records, token corpora, ...)."""
+    import jax
+
+    if jax.process_index() == 0 and not all(
+        os.path.exists(p) for p in paths
+    ):
+        prepare_fn()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("tpu_hpc_prepare")
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"prepare did not produce {missing} -- is the data "
+            "directory shared across hosts (GCS/NFS)? Each host needs "
+            "to see the same files."
+        )
+
+
 def write_dataset(path: str, x: np.ndarray, y: np.ndarray) -> str:
     """Write (x, y) sample arrays as a tpu_hpc binary dataset.
 

@@ -31,11 +31,15 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Callable, Dict, Optional, Sequence, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from tpu_hpc.native.dataloader import NativeFileDataset, write_dataset
+from tpu_hpc.native.dataloader import (
+    NativeFileDataset,
+    prepare_on_host0,  # noqa: F401 -- re-export (vision callers)
+    write_dataset,
+)
 
 
 def prepare_digits(
@@ -99,32 +103,6 @@ def prepare_digits(
 def read_meta(out_prefix: str) -> Dict:
     with open(out_prefix + ".json") as f:
         return json.load(f)
-
-
-def prepare_on_host0(
-    prepare_fn: Callable[[], Dict], paths: Sequence[str]
-) -> None:
-    """Host 0 materializes ``paths`` via ``prepare_fn`` if any is
-    missing; every host then synchronizes before reading them -- the
-    reference's rank-0-download + dist.barrier() pattern
-    (resnet_fsdp_training.py:60-65) without the race."""
-    import jax
-
-    if jax.process_index() == 0 and not all(
-        os.path.exists(p) for p in paths
-    ):
-        prepare_fn()
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices("tpu_hpc_vision_prepare")
-    missing = [p for p in paths if not os.path.exists(p)]
-    if missing:
-        raise FileNotFoundError(
-            f"prepare did not produce {missing} -- is the data "
-            "directory shared across hosts (GCS/NFS)? Each host needs "
-            "to see the same files."
-        )
 
 
 @dataclasses.dataclass

@@ -715,12 +715,22 @@ class Trainer:
             return step
         return 0
 
-    def fit(self, dataset, epochs: Optional[int] = None) -> Dict:
+    def fit(
+        self, dataset, epochs: Optional[int] = None,
+        eval_dataset=None, eval_steps: Optional[int] = None,
+    ) -> Dict:
         """Epoch loop with throughput instrumentation.
 
         Output format parity: per-batch global items/s, per-epoch and
         run summaries incl. per-device rate (multinode_ddp_unet.py:
         334-398). Dataset contract: ``batch_at(step, global_batch)``.
+
+        ``eval_dataset``: run :meth:`evaluate` on it after every
+        epoch (``eval_steps`` batches; default a full
+        ``steps_per_epoch``) -- each pass logs and appends an
+        ``event: eval`` record to the metrics JSONL, giving a train
+        AND eval loss curve from one fit call (the convergence-run
+        evidence format).
         """
         cfg = self.cfg
         epochs = epochs or cfg.epochs
@@ -800,6 +810,7 @@ class Trainer:
             last_metrics = self._fit_loop(
                 dataset, done, total_steps, steps_per_epoch, scanned,
                 prof, preempted, run_summaries,
+                eval_dataset=eval_dataset, eval_steps=eval_steps,
             )
         finally:
             # Always restore the SIGTERM disposition -- a dataset/OOM
@@ -829,6 +840,7 @@ class Trainer:
     def _fit_loop(
         self, dataset, done, total_steps, steps_per_epoch, scanned,
         prof, preempted, run_summaries,
+        eval_dataset=None, eval_steps=None,
     ):
         cfg = self.cfg
         last_metrics: Dict = {}
@@ -902,6 +914,11 @@ class Trainer:
                         jax.device_get(last_metrics["grad_norm"])
                     )
                 self._append_metrics(rec)
+            if eval_dataset is not None:
+                # evaluate() logs and appends its own 'eval' metrics
+                # record (host 0); runs on every host so any sharded
+                # collectives inside the eval step stay collective.
+                self.evaluate(eval_dataset, n_steps=eval_steps)
             if (
                 self.checkpoint_manager is not None
                 and cfg.save_every
