@@ -87,3 +87,13 @@ def test_llama_long_threads_block_flags(bench, monkeypatch):
         "block_q": 256, "block_k": 1024,
         "block_q_bwd": 128, "block_k_bwd": 512,
     }
+
+
+def test_pp_accum_divisibility_validated(bench):
+    # The PP workload validates --grad-accum-steps against the
+    # pipeline microbatch size up front (a non-divisor would otherwise
+    # raise deep inside tracing, bench.py round-4 parity levers).
+    with pytest.raises(ValueError, match="must divide"):
+        bench.bench_llama_pp(grad_accum_steps=3, microbatch_size=4)
+    with pytest.raises(ValueError, match="must divide"):
+        bench.bench_llama_pp(grad_accum_steps=8, microbatch_size=4)

@@ -3,20 +3,27 @@ from tpu_hpc.native.dataloader import (  # noqa: F401
     NativeFileDataset,
     NativeTokenDataset,
     native_available,
+    prepare_on_host0,
     write_dataset,
     write_token_dataset,
 )
 _PREPARE_EXPORTS = ("TokenDatasetWriter", "prepare_corpus")
+_VISION_EXPORTS = ("NativeImageClassDataset", "prepare_digits")
 
 
 def __getattr__(name):
-    # Lazy: importing prepare eagerly here would make
-    # `python -m tpu_hpc.native.prepare` re-execute the module
-    # (runpy's found-in-sys.modules warning).
+    # Lazy: importing prepare/vision eagerly here would make
+    # `python -m tpu_hpc.native.prepare` (or .vision) re-execute the
+    # module (runpy's found-in-sys.modules warning), and vision pulls
+    # sklearn only when actually used.
     if name in _PREPARE_EXPORTS:
         from tpu_hpc.native import prepare
 
         return getattr(prepare, name)
+    if name in _VISION_EXPORTS:
+        from tpu_hpc.native import vision
+
+        return getattr(vision, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )
