@@ -34,10 +34,12 @@ def setup():
     return mesh, params, tokens, targets
 
 
-def _pipe_loss_fn(mesh, schedule, n_micro=4, batch_spec=None):
+def _pipe_loss_fn(mesh, schedule, n_micro=4, batch_spec=None,
+                  backward="remat"):
     kwargs = {} if batch_spec is None else {"batch_spec": batch_spec}
     pipe = pp.pipelined(
-        ptx.make_stage_fn(CFG), mesh, axis="pipe", schedule=schedule, **kwargs
+        ptx.make_stage_fn(CFG), mesh, axis="pipe", schedule=schedule,
+        backward=backward, **kwargs
     )
 
     def loss(params, tokens, targets):
@@ -80,6 +82,47 @@ def test_grads_match_oracle(setup, schedule):
     )
     g_oracle = jax.jit(jax.grad(_oracle_loss))(params, tokens, targets)
     _tree_allclose(g_pipe, g_oracle, atol=2e-4)
+
+
+class TestStashBackward:
+    """1f1b backward='stash' (the Megatron choice): vjp residuals are
+    saved at forward time instead of rematerialized -- 4/3 of ideal
+    FLOPs instead of remat's 5/3, numerics identical."""
+
+    def test_grads_match_oracle(self, setup):
+        mesh, params, tokens, targets = setup
+        g_pipe = jax.jit(jax.grad(
+            _pipe_loss_fn(mesh, "1f1b", backward="stash")
+        ))(params, tokens, targets)
+        g_oracle = jax.jit(jax.grad(_oracle_loss))(params, tokens, targets)
+        _tree_allclose(g_pipe, g_oracle, atol=2e-4)
+
+    def test_ppxdp_grads_match_oracle(self, setup):
+        from jax.sharding import PartitionSpec as P
+
+        _, params, tokens, targets = setup
+        mesh2 = build_mesh(MeshSpec(axes={"data": 2, "pipe": 4}))
+        g_pipe = jax.jit(jax.grad(_pipe_loss_fn(
+            mesh2, "1f1b", batch_spec=P(None, "data"), backward="stash"
+        )))(params, tokens, targets)
+        g_oracle = jax.jit(jax.grad(_oracle_loss))(params, tokens, targets)
+        _tree_allclose(g_pipe, g_oracle, atol=2e-4)
+
+    def test_stash_rejected_off_1f1b(self, setup):
+        mesh, *_ = setup
+        with pytest.raises(ValueError, match="only applies to the 1f1b"):
+            pp.pipelined(
+                ptx.make_stage_fn(CFG), mesh, axis="pipe",
+                schedule="gpipe", backward="stash",
+            )
+
+    def test_unknown_backward_rejected(self, setup):
+        mesh, *_ = setup
+        with pytest.raises(ValueError, match="remat|stash"):
+            pp.pipelined(
+                ptx.make_stage_fn(CFG), mesh, axis="pipe",
+                schedule="1f1b", backward="checkpointless",
+            )
 
 
 def test_pp_composes_with_dp(setup):

@@ -139,13 +139,21 @@ class TestPPLayout:
     """Pipeline roofline: schedule_factor carries bubble + remat."""
 
     def test_schedule_factor_exact(self):
-        # 4 stages, 8 microbatches: bubble stretch (8+3)/8, remat 4/3.
+        # 4 stages, 8 microbatches: bubble stretch (8+3)/8; the
+        # default remat backward costs 5/3 in fwd-units (loss forward
+        # + combined-program fwd slot + vjp recompute), the stash
+        # backward 4/3 (residuals saved at forward time).
         r = roofline.estimate(
             BENCH, dp=1, axis2=4, layout="pp",
             global_batch=8, grad_accum=8,
         )
         assert r.layout == "pp"
-        assert r.schedule_factor == pytest.approx((11 / 8) * (4 / 3))
+        assert r.schedule_factor == pytest.approx((11 / 8) * (5 / 3))
+        stash = roofline.estimate(
+            BENCH, dp=1, axis2=4, layout="pp",
+            global_batch=8, grad_accum=8, pp_backward="stash",
+        )
+        assert stash.schedule_factor == pytest.approx((11 / 8) * (4 / 3))
         # MFU ceiling is depressed by exactly the schedule factor when
         # the schedule term binds.
         if r.bound == "schedule":
@@ -171,6 +179,22 @@ class TestPPLayout:
         )
         assert "pp_stage_hops" in r.comm_breakdown
         assert "ddp_grad_allreduce" in r.comm_breakdown
+
+    def test_stash_pays_memory_for_its_flops(self):
+        # Stash lowers the schedule factor but adds residual traffic:
+        # the roofline must not present it as strictly free.
+        remat = roofline.estimate(
+            BENCH, dp=1, axis2=4, layout="pp",
+            global_batch=8, grad_accum=8,
+        )
+        stash = roofline.estimate(
+            BENCH, dp=1, axis2=4, layout="pp",
+            global_batch=8, grad_accum=8, pp_backward="stash",
+        )
+        assert stash.schedule_factor < remat.schedule_factor
+        assert stash.memory_s > remat.memory_s
+        assert "stash_residuals" in stash.memory_breakdown
+        assert "stash_residuals" not in remat.memory_breakdown
 
     def test_layers_must_divide_stages(self):
         with pytest.raises(ValueError, match="divisible by"):
@@ -206,3 +230,4 @@ class TestSlices:
             roofline.estimate(
                 BENCH, dp=3, global_batch=6, slices=2
             )
+

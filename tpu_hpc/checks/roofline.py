@@ -164,6 +164,7 @@ def estimate(
     grad_accum: int = 1,
     moments_dtype: str = "float32",
     slices: int = 1,
+    pp_backward: str = "remat",
 ) -> RooflineResult:
     """Roofline bounds for one training step of the Llama family.
 
@@ -224,6 +225,7 @@ def estimate(
         return _estimate_pp(
             cfg, c, dp, axis2, global_batch, s, grad_accum,
             moments_dtype, tokens, compute_s, slices,
+            pp_backward=pp_backward,
         )
 
     # -- memory bound: per-chip HBM bytes each step must move --
@@ -313,6 +315,7 @@ def _estimate_pp(
     cfg, c: ChipSpec, dp: int, stages: int, global_batch: int,
     s: int, microbatches: int, moments_dtype: str,
     tokens: int, compute_s: float, slices: int,
+    pp_backward: str = "remat",
 ) -> RooflineResult:
     """Pipeline layout bounds: stage-sharded params (replicated over
     ``data`` -- the repo's PP x DP composition, pp.stage_pspecs),
@@ -321,11 +324,16 @@ def _estimate_pp(
     Two schedule-inherent overheads enter ``schedule_factor``:
       * bubble: wall ticks / work ticks = (M + S - 1) / M
         (pp.bubble_fraction's exact v=1 form), and
-      * backward remat: the 1f1b custom-vjp recomputes the forward,
-        +1/3 of the 6ND FLOPs.
+      * the custom-vjp backward's extra stage forwards. Counting in
+        fwd-units (fwd 1, bwd 2, ideal total 3): the loss forward +
+        the combined program's own fwd slot already cost one extra
+        unit (4/3); ``pp_backward="remat"`` (pp.pipelined's default)
+        recomputes each stage forward a second time in its backward
+        slot -- 5/3 -- while ``"stash"`` saves the vjp residuals at
+        forward time and stays at 4/3.
     Neither inflates MFU's numerator -- a 4-stage 8-microbatch plan
-    honestly shows its <= 72% ceiling instead of pretending the
-    bubble away.
+    honestly shows its bubble-and-remat-depressed ceiling instead of
+    pretending the overheads away.
     """
     bf16, f32 = 2, 4
     mom = 2 if moments_dtype == "bfloat16" else 4
@@ -346,6 +354,23 @@ def _estimate_pp(
         # Last stage's logits roundtrip (worst chip again).
         "logits_roundtrip": 2 * bl * s * cfg.vocab_size * bf16,
     }
+    if pp_backward == "stash":
+        # Stash is not free: the vjp residuals (every per-layer
+        # intermediate -- qkv, attention out, both SwiGLU hiddens --
+        # plus a compute-dtype copy of the stage params per
+        # microbatch) are written at forward time and read back in
+        # the backward, where remat only moves the 2*dim/layer/token
+        # checkpoints. ~(dim + (h+2kv+h)*hd + 2*ffn) per layer-token.
+        per_tok = (
+            cfg.dim
+            + (cfg.n_heads + 2 * cfg.kv_heads + cfg.n_heads)
+            * cfg.head_dim
+            + 2 * cfg.ffn_hidden
+        )
+        mem["stash_residuals"] = (
+            2 * (cfg.n_layers // stages) * bl * s * per_tok * bf16
+            + 2 * M * p_stage * bf16  # per-microbatch param copies
+        )
     memory_s = sum(mem.values()) / (c.hbm_gbps * 1e9)
 
     comm = {}
@@ -365,7 +390,11 @@ def _estimate_pp(
     comm_s = max(comm.values()) if comm else 0.0
 
     bubble_stretch = (M + stages - 1) / M
-    remat = 4.0 / 3.0  # 1f1b backward recomputes the forward
+    if pp_backward not in ("remat", "stash"):
+        raise ValueError(
+            f"unknown pp_backward {pp_backward!r} (remat|stash)"
+        )
+    extra_fwds = 5.0 / 3.0 if pp_backward == "remat" else 4.0 / 3.0
     return RooflineResult(
         chip=c, dp=dp, axis2=stages,
         layout="pp" if stages > 1 else "dp",
@@ -373,7 +402,7 @@ def _estimate_pp(
         tokens_per_step=tokens,
         compute_s=compute_s, memory_s=memory_s, comm_s=comm_s,
         comm_breakdown=comm, memory_breakdown=mem,
-        schedule_factor=bubble_stretch * remat,
+        schedule_factor=bubble_stretch * extra_fwds,
         slices=slices,
     )
 

@@ -299,6 +299,7 @@ def _spec_axes(spec: P) -> tuple:
 def _fwd_bwd_program_1f1b(
     stage_fn: StageFn, axis: str, n_stages: int,
     grad_reduce_axes: tuple = (),
+    stash: bool = False,
 ):
     """The 1F1B combined forward+backward tick loop (under shard_map).
 
@@ -306,9 +307,22 @@ def _fwd_bwd_program_1f1b(
     ``f + s``; backward of microbatch b at tick ``(2S-1-s) + b``. Each
     tick does at most one forward and one backward -- the steady-state
     "one forward, one backward" interleave of Schedule1F1B
-    (02_pipeline_schedules.py:98-115). Live stage inputs per stage s:
-    ``2(S-s)-1`` <= 2S-1, held in a depth-2S circular buffer; backward
-    recomputes the stage forward from the saved input (remat).
+    (02_pipeline_schedules.py:98-115). Live microbatches per stage s:
+    ``2(S-s)-1`` <= 2S-1, held in depth-2S circular buffers.
+
+    ``stash=False`` (remat): saves only each microbatch's stage INPUT;
+    the backward recomputes the stage forward from it -- minimal
+    memory, but each microbatch pays 2 extra stage-forwards (this
+    program's fwd slot + the vjp recompute) on top of the loss
+    forward: 5/3 of the ideal fwd+bwd FLOPs.
+
+    ``stash=True`` (the Megatron choice): the fwd slot runs jax.vjp
+    and saves the RESIDUALS; the backward applies them directly --
+    4/3 of ideal FLOPs (only this program's fwd slot is extra), at
+    the cost of buffering up to 2S-1 microbatches' full vjp residuals
+    per device (which include a compute-dtype copy of the stage
+    params per slot -- activation-dominated at real microbatch sizes,
+    but check the fit before using stash on param-heavy stages).
 
     Returns (grads_stacked [1,...], gxs [M, mb, ...]) given output
     cotangents ybar.
@@ -322,6 +336,20 @@ def _fwd_bwd_program_1f1b(
         p = _local_stage(stacked)
         sid = jax.lax.axis_index(axis)
         M = xs.shape[0]
+        mbshape = xs.shape[1:]
+        if stash:
+            # Residual-buffer template. The vjp closure's leaf ORDER
+            # is a tracing artifact (it differs between this position
+            # and inside the scan body under shard_map), so buffers
+            # are kept in a canonical order -- sorted by (shape,
+            # dtype) -- and the tick applies its own static
+            # permutation on store/read. The dummy forward below only
+            # contributes shapes; XLA removes the dead ops.
+            _, _vjp0 = jax.vjp(
+                stage_fn, p, jnp.zeros(mbshape, xs.dtype)
+            )
+            _key = lambda a: (str(jnp.shape(a)), str(a.dtype))  # noqa: E731
+            res_template = sorted(jax.tree.leaves(_vjp0), key=_key)
 
         def tick(carry, t):
             buf, fwd_state, bwd_state, grads, gxs = carry
@@ -335,19 +363,66 @@ def _fwd_bwd_program_1f1b(
                 fwd_state,
             )
             slot = jnp.where(do_fwd, f % D, D - 1)
-            old = jax.lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
-            buf = jax.lax.dynamic_update_index_in_dim(
-                buf, jnp.where(do_fwd, inp, old), slot, 0
-            )
-            out = stage_fn(p, inp)
+            if stash:
+                out, vjp_f = jax.vjp(stage_fn, p, inp)
+                new_leaves, treedef = jax.tree.flatten(vjp_f)
+                # Static permutation: canonical (sorted) buffer slot
+                # -> this trace's leaf index. Consistent store/read by
+                # construction; the template check below fails loudly
+                # at trace time if the residual multiset ever drifts.
+                order = sorted(
+                    range(len(new_leaves)),
+                    key=lambda i: _key(new_leaves[i]),
+                )
+                if [
+                    (str(jnp.shape(new_leaves[i])),
+                     str(new_leaves[i].dtype))
+                    for i in order
+                ] != [_key(a) for a in res_template]:
+                    raise ValueError(
+                        "1f1b stash backward: the stage vjp's "
+                        "residual shapes differ between trace "
+                        "contexts -- use backward='remat' for this "
+                        "stage_fn"
+                    )
+                buf = tuple(
+                    jax.lax.dynamic_update_index_in_dim(
+                        bl,
+                        jnp.where(
+                            do_fwd, new_leaves[order[pos]],
+                            jax.lax.dynamic_index_in_dim(
+                                bl, slot, 0, keepdims=False
+                            ),
+                        ),
+                        slot, 0,
+                    )
+                    for pos, bl in enumerate(buf)
+                )
+            else:
+                old = jax.lax.dynamic_index_in_dim(
+                    buf, slot, 0, keepdims=False
+                )
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(do_fwd, inp, old), slot, 0
+                )
+                out = stage_fn(p, inp)
             # -- backward slot: microbatch b = t - (2S-1-s) --
             b = t - (2 * S - 1 - sid)
             do_bwd = (b >= 0) & (b < M)
             bclip = jnp.clip(b, 0, M - 1)
-            binp = jax.lax.dynamic_index_in_dim(
-                buf, bclip % D, 0, keepdims=False
-            )
-            _, vjp = jax.vjp(stage_fn, p, binp)  # remat of the forward
+            if stash:
+                saved = [None] * len(buf)
+                for pos, i in enumerate(order):
+                    saved[i] = jax.lax.dynamic_index_in_dim(
+                        buf[pos], bclip % D, 0, keepdims=False
+                    )
+                vjp = jax.tree.unflatten(treedef, saved)
+            else:
+                binp = jax.lax.dynamic_index_in_dim(
+                    buf, bclip % D, 0, keepdims=False
+                )
+                # remat of the forward
+                _, vjp = jax.vjp(stage_fn, p, binp)
             gin = jnp.where(
                 sid == S - 1,
                 jax.lax.dynamic_index_in_dim(ybar, bclip, 0, keepdims=False),
@@ -368,9 +443,14 @@ def _fwd_bwd_program_1f1b(
                 bwd_state = jax.lax.ppermute(xg, axis, bwd_perm)
             return (buf, fwd_state, bwd_state, grads, gxs), None
 
-        mbshape = xs.shape[1:]
+        if stash:
+            buf0 = tuple(
+                jnp.zeros((D,) + a.shape, a.dtype) for a in res_template
+            )
+        else:
+            buf0 = jnp.zeros((D,) + mbshape, xs.dtype)
         carry0 = (
-            jnp.zeros((D,) + mbshape, xs.dtype),     # buf
+            buf0,                                    # inputs / residuals
             jnp.zeros(mbshape, xs.dtype),            # fwd_state
             jnp.zeros(mbshape, xs.dtype),            # bwd_state
             jax.tree.map(jnp.zeros_like, p),         # grads
@@ -569,6 +649,7 @@ def pipelined(
     batch_spec: P = P(),
     n_chunks: int = 1,
     remat_stage: bool = False,
+    backward: str = "remat",
 ):
     """Build ``fn(stacked_params, xs) -> ys``: the pipelined, jit-able,
     differentiable forward over ``mesh`` axis ``axis``.
@@ -577,7 +658,7 @@ def pipelined(
     P(axis) -- see :func:`stage_pspecs`). ``xs``: [M, mb, ...]
     microbatched activations. ``schedule``: "gpipe" (autodiff backward,
     O(M) live activations), "1f1b" (custom_vjp interleaved backward,
-    O(S) live activations + forward remat), "interleaved" (v virtual
+    O(S) live microbatches), "interleaved" (v virtual
     chunks per device, ``n_chunks``; stack params with
     :func:`stack_interleaved_stage_params`; autodiff backward; bubble
     time / ``n_chunks``), or "interleaved-1f1b" (same virtual-chunk
@@ -585,10 +666,15 @@ def pipelined(
     independent of M, + forward remat). ``remat_stage`` wraps the
     stage in ``jax.checkpoint`` on the autodiff schedules, so the scan
     saves only each tick's stage *input* instead of every
-    intermediate -- the per-block HBM/FLOPs trade the 1f1b schedules
-    already make, now available without the custom backward. The
-    returned function is *not* jitted -- trace it into your training
-    step so XLA schedules the surrounding embed/head/optimizer with it.
+    intermediate -- the per-block HBM/FLOPs trade the 1f1b custom
+    backwards make by default. ``backward`` selects the 1f1b
+    backward's memory/FLOPs point: "remat" (default; inputs only,
+    backward recomputes the stage forward -- 5/3 of ideal FLOPs) or
+    "stash" (the Megatron choice: vjp residuals saved at forward
+    time, 4/3 of ideal FLOPs, O(S) microbatches' residuals of HBM --
+    see _fwd_bwd_program_1f1b). The returned function is *not*
+    jitted -- trace it into your training step so XLA schedules the
+    surrounding embed/head/optimizer with it.
     """
     S = mesh.shape[axis]
     interleaved = schedule in ("interleaved", "interleaved-1f1b")
@@ -597,6 +683,12 @@ def pipelined(
             f"n_chunks={n_chunks} only applies to the interleaved "
             f"schedules, got {schedule!r} -- a multi-chunk param stack "
             "under gpipe/1f1b would silently run wrong stages"
+        )
+    if backward != "remat" and schedule != "1f1b":
+        raise ValueError(
+            f"backward={backward!r} only applies to the 1f1b "
+            f"schedule, got {schedule!r} -- gpipe/interleaved use "
+            "autodiff backward; interleaved-1f1b is remat-only"
         )
     if remat_stage and schedule in ("gpipe", "interleaved"):
         stage_fn = jax.checkpoint(stage_fn)
@@ -675,9 +767,15 @@ def pipelined(
             "(gpipe|1f1b|interleaved|interleaved-1f1b)"
         )
 
+    if backward not in ("remat", "stash"):
+        raise ValueError(
+            f"unknown backward {backward!r} (remat|stash)"
+        )
     reduce_axes = tuple(a for a in _spec_axes(batch_spec) if a != axis)
     bwd = jax.shard_map(
-        _fwd_bwd_program_1f1b(stage_fn, axis, S, reduce_axes),
+        _fwd_bwd_program_1f1b(
+            stage_fn, axis, S, reduce_axes, stash=backward == "stash"
+        ),
         mesh=mesh,
         in_specs=(P(axis), batch_spec, batch_spec),
         out_specs=(P(axis), batch_spec),
