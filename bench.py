@@ -551,6 +551,11 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
         ("llama-sp zigzag ring", ["--workload", "llama-sp", "--sp-mode", "zigzag"]),
         ("llama-sp ulysses", ["--workload", "llama-sp", "--sp-mode", "ulysses"]),
         ("llama-pp 1f1b", ["--workload", "llama-pp", "--pp-schedule", "1f1b"]),
+        ("llama-pp 1f1b-stash",
+         ["--workload", "llama-pp", "--pp-schedule", "1f1b",
+          "--pp-backward", "stash"]),
+        ("llama-pp gpipe",
+         ["--workload", "llama-pp", "--pp-schedule", "gpipe"]),
         ("llama-pp interleaved-1f1b",
          ["--workload", "llama-pp", "--pp-schedule", "interleaved-1f1b"]),
         ("llama-long seq 8192", ["--workload", "llama-long"]),
