@@ -411,8 +411,11 @@ def bench_llama_pp(
     flops_per_token = model_cfg.flops_per_token()
     peak = peak_flops_per_chip(jax.devices()[0])
     mfu = tokens_per_s * flops_per_token / (peak * n_dev)
-    tag = f"-{backward}" if schedule == "1f1b" and backward != "remat" \
-        else ""
+    tag = (
+        f"-{backward}"
+        if schedule in ("1f1b", "interleaved-1f1b")
+        and backward != "remat" else ""
+    )
     print(
         f"llama-pp[{schedule}{tag}] | stages={n_stages} "
         f"mb={microbatches}x{microbatch_size} bubble {bubble:.1%} | "

@@ -377,6 +377,33 @@ class TestInterleaved:
         g_ref = jax.jit(jax.grad(self._oracle))(params, tokens, targets)
         _tree_allclose(g, g_ref, atol=2e-4)
 
+    def test_interleaved_1f1b_stash_grads_match_oracle(self, setup8):
+        # The stash backward on the interleaved schedule: residuals
+        # saved per (chunk, slot) at forward time; grads must match
+        # the oracle like the remat backward does.
+        mesh, params, tokens, targets = setup8
+        cfg = self.CFG8
+        pipe = pp.pipelined(
+            ptx.make_stage_fn(cfg), mesh, axis="pipe",
+            schedule="interleaved-1f1b", n_chunks=2, backward="stash",
+        )
+
+        def loss(params, tokens, targets):
+            xs = ptx.embed(params, pp.microbatch(tokens, 4), cfg)
+            per = [
+                jax.tree.map(lambda a: a[g], params["stages"])
+                for g in range(cfg.n_stages)
+            ]
+            ys = pipe(pp.stack_interleaved_stage_params(per, 4), xs)
+            logits = ptx.head(params, ys, cfg)
+            return losses.cross_entropy(
+                logits, pp.microbatch(targets, 4)
+            )
+
+        g = jax.jit(jax.grad(loss))(params, tokens, targets)
+        g_ref = jax.jit(jax.grad(self._oracle))(params, tokens, targets)
+        _tree_allclose(g, g_ref, atol=2e-4)
+
     def test_chunk_mismatch_rejected(self, setup8):
         mesh, params, tokens, targets = setup8
         cfg = self.CFG8

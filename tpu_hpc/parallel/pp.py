@@ -138,6 +138,39 @@ def _local_stage(stacked: Any) -> Any:
     return jax.tree.map(lambda a: a[0], stacked)
 
 
+def _res_key(a) -> tuple:
+    """Canonical sort key for stash residual leaves. The vjp closure's
+    leaf ORDER is a tracing artifact (it differs between trace
+    contexts under shard_map), so the stash buffers live in this
+    sorted order and each tick applies its own static permutation."""
+    return (str(jnp.shape(a)), str(a.dtype))
+
+
+def _res_template(stage_fn: StageFn, p: Any, mbshape, dtype) -> list:
+    """Sorted residual template from a dummy vjp in the CALLING trace
+    context. Only the leaves' shapes/dtypes are used, so the dummy
+    forward is dead code XLA removes."""
+    _, vjp0 = jax.vjp(stage_fn, p, jnp.zeros(mbshape, dtype))
+    return sorted(jax.tree.leaves(vjp0), key=_res_key)
+
+
+def _res_order(new_leaves: list, template: list, where: str) -> list:
+    """Static permutation: canonical buffer position -> this trace's
+    leaf index; fails loudly at trace time if the residual multiset
+    ever drifts from the template."""
+    order = sorted(range(len(new_leaves)),
+                   key=lambda i: _res_key(new_leaves[i]))
+    if [_res_key(new_leaves[i]) for i in order] != [
+        _res_key(a) for a in template
+    ]:
+        raise ValueError(
+            f"{where} stash backward: the stage vjp's residual "
+            "shapes differ between trace contexts -- use "
+            "backward='remat' for this stage_fn"
+        )
+    return order
+
+
 def _fwd_program(stage_fn: StageFn, axis: str, n_stages: int):
     """The GPipe forward tick loop (runs under shard_map).
 
@@ -338,18 +371,7 @@ def _fwd_bwd_program_1f1b(
         M = xs.shape[0]
         mbshape = xs.shape[1:]
         if stash:
-            # Residual-buffer template. The vjp closure's leaf ORDER
-            # is a tracing artifact (it differs between this position
-            # and inside the scan body under shard_map), so buffers
-            # are kept in a canonical order -- sorted by (shape,
-            # dtype) -- and the tick applies its own static
-            # permutation on store/read. The dummy forward below only
-            # contributes shapes; XLA removes the dead ops.
-            _, _vjp0 = jax.vjp(
-                stage_fn, p, jnp.zeros(mbshape, xs.dtype)
-            )
-            _key = lambda a: (str(jnp.shape(a)), str(a.dtype))  # noqa: E731
-            res_template = sorted(jax.tree.leaves(_vjp0), key=_key)
+            res_template = _res_template(stage_fn, p, mbshape, xs.dtype)
 
         def tick(carry, t):
             buf, fwd_state, bwd_state, grads, gxs = carry
@@ -366,25 +388,7 @@ def _fwd_bwd_program_1f1b(
             if stash:
                 out, vjp_f = jax.vjp(stage_fn, p, inp)
                 new_leaves, treedef = jax.tree.flatten(vjp_f)
-                # Static permutation: canonical (sorted) buffer slot
-                # -> this trace's leaf index. Consistent store/read by
-                # construction; the template check below fails loudly
-                # at trace time if the residual multiset ever drifts.
-                order = sorted(
-                    range(len(new_leaves)),
-                    key=lambda i: _key(new_leaves[i]),
-                )
-                if [
-                    (str(jnp.shape(new_leaves[i])),
-                     str(new_leaves[i].dtype))
-                    for i in order
-                ] != [_key(a) for a in res_template]:
-                    raise ValueError(
-                        "1f1b stash backward: the stage vjp's "
-                        "residual shapes differ between trace "
-                        "contexts -- use backward='remat' for this "
-                        "stage_fn"
-                    )
+                order = _res_order(new_leaves, res_template, "1f1b")
                 buf = tuple(
                     jax.lax.dynamic_update_index_in_dim(
                         bl,
@@ -484,6 +488,7 @@ def _fwd_bwd_program_1f1b(
 def _fwd_bwd_program_interleaved_1f1b(
     stage_fn: StageFn, axis: str, n_stages: int, n_chunks: int,
     grad_reduce_axes: tuple = (),
+    stash: bool = False,
 ):
     """Interleaved 1F1B: the combined forward+backward tick loop for
     the virtual-chunk placement (under shard_map).
@@ -508,12 +513,17 @@ def _fwd_bwd_program_interleaved_1f1b(
     0 -> S-1). At V=1 both formulas collapse to the plain 1F1B ticks
     ``f + s`` and ``2S-1-s + b`` exactly.
 
-    Memory. Stage inputs are saved in a per-chunk ring buffer of depth
-    3S: the forward-to-backward lag of (j, s) is
+    Memory. The per-chunk ring buffers have depth 3S: the
+    forward-to-backward lag of (j, s) is
     ``VS + (V-1-2j)S + (S-1-2s) < 2VS`` ticks, and a chunk's forwards
-    recur every VS ticks in groups of S, so at most ~3S microbatch
-    inputs per chunk are ever in flight (depth is static -- no
-    data-dependent shapes under jit).
+    recur every VS ticks in groups of S, so at most ~3S microbatches
+    per chunk are ever in flight (depth is static -- no
+    data-dependent shapes under jit). ``stash=False`` buffers each
+    microbatch's stage INPUT and remats the forward in the backward;
+    ``stash=True`` buffers the full vjp RESIDUALS instead -- every
+    per-layer intermediate plus a compute-dtype copy of the chunk's
+    params per slot, at depth 3S per chunk (vs the plain 1F1B's 2S)
+    -- check the fit before using stash on param-heavy stages.
 
     Returns (grads_stacked [V, ...] local, gxs [M, mb, ...]).
     """
@@ -537,10 +547,15 @@ def _fwd_bwd_program_interleaved_1f1b(
     def program(stacked, xs, ybar):
         sid = jax.lax.axis_index(axis)
         M = xs.shape[0]
+        mbshape = xs.shape[1:]
         qmax, rmax = (M - 1) // S, (M - 1) % S
         # Last backward op: microbatch M-1 at global stage 0
         # (j=0, s=0). Exact for any M, M % S == 0 or not.
         n_ticks = C + qmax * G + (V - 1) * S + (S - 1) + rmax + 1
+        if stash:
+            res_template = _res_template(
+                stage_fn, chunk(stacked, 0), mbshape, xs.dtype
+            )
 
         def tick(carry, t):
             buf, fwd_state, bwd_state, grads, gxs = carry
@@ -559,15 +574,46 @@ def _fwd_bwd_program_interleaved_1f1b(
                 jax.lax.dynamic_index_in_dim(xs, fclip, 0, keepdims=False),
                 fwd_state,
             )
-            # Save this stage input for the backward's remat.
             slot = jnp.where(do_fwd, fclip % DB, DB - 1)
-            row = jax.lax.dynamic_index_in_dim(buf, j, 0, keepdims=False)
-            old = jax.lax.dynamic_index_in_dim(row, slot, 0, keepdims=False)
-            row = jax.lax.dynamic_update_index_in_dim(
-                row, jnp.where(do_fwd, inp, old), slot, 0
-            )
-            buf = jax.lax.dynamic_update_index_in_dim(buf, row, j, 0)
-            out = stage_fn(chunk(stacked, j), inp)
+            if stash:
+                # Save this stage's vjp residuals for the backward.
+                out, vjp_f = jax.vjp(stage_fn, chunk(stacked, j), inp)
+                new_leaves, treedef = jax.tree.flatten(vjp_f)
+                order = _res_order(
+                    new_leaves, res_template, "interleaved-1f1b"
+                )
+
+                def store(bl, leaf):
+                    rowl = jax.lax.dynamic_index_in_dim(
+                        bl, j, 0, keepdims=False
+                    )
+                    oldl = jax.lax.dynamic_index_in_dim(
+                        rowl, slot, 0, keepdims=False
+                    )
+                    rowl = jax.lax.dynamic_update_index_in_dim(
+                        rowl, jnp.where(do_fwd, leaf, oldl), slot, 0
+                    )
+                    return jax.lax.dynamic_update_index_in_dim(
+                        bl, rowl, j, 0
+                    )
+
+                buf = tuple(
+                    store(bl, new_leaves[order[pos]])
+                    for pos, bl in enumerate(buf)
+                )
+            else:
+                # Save this stage input for the backward's remat.
+                row = jax.lax.dynamic_index_in_dim(
+                    buf, j, 0, keepdims=False
+                )
+                old = jax.lax.dynamic_index_in_dim(
+                    row, slot, 0, keepdims=False
+                )
+                row = jax.lax.dynamic_update_index_in_dim(
+                    row, jnp.where(do_fwd, inp, old), slot, 0
+                )
+                buf = jax.lax.dynamic_update_index_in_dim(buf, row, j, 0)
+                out = stage_fn(chunk(stacked, j), inp)
             out = jnp.where(do_fwd, out, jnp.zeros_like(out))
             # ---- backward op: mirrored dilated decomposition
             d2 = t - C - (S - 1 - sid)
@@ -578,11 +624,24 @@ def _fwd_bwd_program_interleaved_1f1b(
             b = q2 * S + r2
             do_bwd = (d2 >= 0) & (b < M)
             bclip = jnp.clip(b, 0, M - 1)
-            brow = jax.lax.dynamic_index_in_dim(buf, j2, 0, keepdims=False)
-            binp = jax.lax.dynamic_index_in_dim(
-                brow, bclip % DB, 0, keepdims=False
-            )
-            _, vjp = jax.vjp(stage_fn, chunk(stacked, j2), binp)
+            if stash:
+                saved = [None] * len(buf)
+                for pos, i in enumerate(order):
+                    browl = jax.lax.dynamic_index_in_dim(
+                        buf[pos], j2, 0, keepdims=False
+                    )
+                    saved[i] = jax.lax.dynamic_index_in_dim(
+                        browl, bclip % DB, 0, keepdims=False
+                    )
+                vjp = jax.tree.unflatten(treedef, saved)
+            else:
+                brow = jax.lax.dynamic_index_in_dim(
+                    buf, j2, 0, keepdims=False
+                )
+                binp = jax.lax.dynamic_index_in_dim(
+                    brow, bclip % DB, 0, keepdims=False
+                )
+                _, vjp = jax.vjp(stage_fn, chunk(stacked, j2), binp)
             last = (sid == S - 1) & (j2 == V - 1)
             gin = jnp.where(
                 last,
@@ -613,9 +672,15 @@ def _fwd_bwd_program_interleaved_1f1b(
                 fwd_state, bwd_state = out, xg
             return (buf, fwd_state, bwd_state, grads, gxs), None
 
-        mbshape = xs.shape[1:]
+        if stash:
+            buf0 = tuple(
+                jnp.zeros((V, DB) + a.shape, a.dtype)
+                for a in res_template
+            )
+        else:
+            buf0 = jnp.zeros((V, DB) + mbshape, xs.dtype)
         carry0 = (
-            jnp.zeros((V, DB) + mbshape, xs.dtype),  # buf
+            buf0,                                    # inputs / residuals
             jnp.zeros(mbshape, xs.dtype),            # fwd_state
             jnp.zeros(mbshape, xs.dtype),            # bwd_state
             jax.tree.map(jnp.zeros_like, stacked),   # grads [V, ...]
@@ -662,13 +727,13 @@ def pipelined(
     chunks per device, ``n_chunks``; stack params with
     :func:`stack_interleaved_stage_params`; autodiff backward; bubble
     time / ``n_chunks``), or "interleaved-1f1b" (same virtual-chunk
-    placement and bubble, custom_vjp backward: O(S*v) live activations
-    independent of M, + forward remat). ``remat_stage`` wraps the
+    placement and bubble, custom_vjp backward: O(S*v) live
+    microbatches independent of M). ``remat_stage`` wraps the
     stage in ``jax.checkpoint`` on the autodiff schedules, so the scan
     saves only each tick's stage *input* instead of every
     intermediate -- the per-block HBM/FLOPs trade the 1f1b custom
     backwards make by default. ``backward`` selects the 1f1b
-    backward's memory/FLOPs point: "remat" (default; inputs only,
+    schedules' backward memory/FLOPs point (plain and interleaved): "remat" (default; inputs only,
     backward recomputes the stage forward -- 5/3 of ideal FLOPs) or
     "stash" (the Megatron choice: vjp residuals saved at forward
     time, 4/3 of ideal FLOPs, O(S) microbatches' residuals of HBM --
@@ -684,11 +749,17 @@ def pipelined(
             f"schedules, got {schedule!r} -- a multi-chunk param stack "
             "under gpipe/1f1b would silently run wrong stages"
         )
-    if backward != "remat" and schedule != "1f1b":
+    if backward not in ("remat", "stash"):
+        raise ValueError(
+            f"unknown backward {backward!r} (remat|stash)"
+        )
+    if backward != "remat" and schedule not in (
+        "1f1b", "interleaved-1f1b"
+    ):
         raise ValueError(
             f"backward={backward!r} only applies to the 1f1b "
-            f"schedule, got {schedule!r} -- gpipe/interleaved use "
-            "autodiff backward; interleaved-1f1b is remat-only"
+            f"schedules, got {schedule!r} -- gpipe/interleaved use "
+            "autodiff backward"
         )
     if remat_stage and schedule in ("gpipe", "interleaved"):
         stage_fn = jax.checkpoint(stage_fn)
@@ -731,7 +802,8 @@ def pipelined(
         )
         ibwd = jax.shard_map(
             _fwd_bwd_program_interleaved_1f1b(
-                stage_fn, axis, S, n_chunks, reduce_axes
+                stage_fn, axis, S, n_chunks, reduce_axes,
+                stash=backward == "stash",
             ),
             mesh=mesh,
             in_specs=(P(axis), batch_spec, batch_spec),
@@ -767,10 +839,6 @@ def pipelined(
             "(gpipe|1f1b|interleaved|interleaved-1f1b)"
         )
 
-    if backward not in ("remat", "stash"):
-        raise ValueError(
-            f"unknown backward {backward!r} (remat|stash)"
-        )
     reduce_axes = tuple(a for a in _spec_axes(batch_spec) if a != axis)
     bwd = jax.shard_map(
         _fwd_bwd_program_1f1b(
