@@ -306,3 +306,22 @@ class TestPPLayout:
                 seq_len=4096, do_compile=False, grad_accum=5,
                 layout="pp",
             )
+
+    def test_stash_backward_costs_memory(self):
+        from tpu_hpc.models import llama2 as l2
+
+        cfg = l2.PRESETS["7b"]
+        remat = fit.analyze(
+            cfg, dp=2, tp_size=4, global_batch=64, seq_len=4096,
+            do_compile=False, grad_accum=8, layout="pp",
+        )
+        stash = fit.analyze(
+            cfg, dp=2, tp_size=4, global_batch=64, seq_len=4096,
+            do_compile=False, grad_accum=8, layout="pp",
+            pp_backward="stash",
+        )
+        # Stash buffers full residuals (incl. a bf16 param copy per
+        # in-flight microbatch) instead of input checkpoints only.
+        assert sum(stash.act_bytes.values()) > \
+            sum(remat.act_bytes.values())
+        assert stash.static_bytes == remat.static_bytes

@@ -108,6 +108,22 @@ class TestStashBackward:
         g_oracle = jax.jit(jax.grad(_oracle_loss))(params, tokens, targets)
         _tree_allclose(g_pipe, g_oracle, atol=2e-4)
 
+    def test_stash_ring_wraparound(self, setup):
+        # M=16 microbatches through the depth-2S=8 ring: every slot is
+        # reused twice -- a slot-collision bug (residuals overwritten
+        # before their backward reads them) would corrupt gradients
+        # here and nowhere in the smaller oracle tests.
+        mesh, params, tokens16, targets16 = setup
+        tokens = jnp.tile(tokens16, (2, 1))
+        targets = jnp.tile(targets16, (2, 1))
+        g_stash = jax.jit(jax.grad(_pipe_loss_fn(
+            mesh, "1f1b", n_micro=16, backward="stash"
+        )))(params, tokens, targets)
+        g_remat = jax.jit(jax.grad(_pipe_loss_fn(
+            mesh, "1f1b", n_micro=16, backward="remat"
+        )))(params, tokens, targets)
+        _tree_allclose(g_stash, g_remat, atol=1e-5)
+
     def test_stash_rejected_off_1f1b(self, setup):
         mesh, *_ = setup
         with pytest.raises(ValueError, match="only applies to the 1f1b"):
@@ -403,6 +419,38 @@ class TestInterleaved:
         g = jax.jit(jax.grad(loss))(params, tokens, targets)
         g_ref = jax.jit(jax.grad(self._oracle))(params, tokens, targets)
         _tree_allclose(g, g_ref, atol=2e-4)
+
+    def test_interleaved_stash_wraparound_and_partial_group(self, setup8):
+        # M=14 with S=4, V=2 (DB=3S=12): ring slots wrap AND
+        # M % S != 0 exercises the dilated partial-group tail on the
+        # stash path.
+        mesh, params, tokens, targets = setup8
+        cfg = self.CFG8
+        tokens14 = jnp.tile(tokens, (2, 1))[:14]
+        targets14 = jnp.tile(targets, (2, 1))[:14]
+        grads = {}
+        for bwd in ("remat", "stash"):
+            pipe = pp.pipelined(
+                ptx.make_stage_fn(cfg), mesh, axis="pipe",
+                schedule="interleaved-1f1b", n_chunks=2, backward=bwd,
+            )
+
+            def loss(params, tokens, targets):
+                xs = ptx.embed(params, pp.microbatch(tokens, 14), cfg)
+                per = [
+                    jax.tree.map(lambda a: a[g], params["stages"])
+                    for g in range(cfg.n_stages)
+                ]
+                ys = pipe(pp.stack_interleaved_stage_params(per, 4), xs)
+                logits = ptx.head(params, ys, cfg)
+                return losses.cross_entropy(
+                    logits, pp.microbatch(targets, 14)
+                )
+
+            grads[bwd] = jax.jit(jax.grad(loss))(
+                params, tokens14, targets14
+            )
+        _tree_allclose(grads["stash"], grads["remat"], atol=1e-5)
 
     def test_chunk_mismatch_rejected(self, setup8):
         mesh, params, tokens, targets = setup8

@@ -98,7 +98,7 @@ def _axis2_candidates(
 
 def _min_fitting_accum(
     cfg, dp, axis2, layout, global_batch, seq_len, hbm_gib,
-    moments_dtype, max_accum,
+    moments_dtype, max_accum, pp_backward="remat",
 ) -> "tuple[int, Optional[fit_mod.FitResult]]":
     """Smallest grad-accum on the ladder whose microbatch still covers
     the dp axis and whose analyzed footprint fits; (accum, None) with
@@ -113,7 +113,7 @@ def _min_fitting_accum(
             cfg, dp=dp, tp_size=axis2, global_batch=global_batch,
             seq_len=seq_len, hbm_gib=hbm_gib, do_compile=False,
             grad_accum=accum, moments_dtype=moments_dtype,
-            layout=layout,
+            layout=layout, pp_backward=pp_backward,
         )
         last = (accum, r)
         if r.total_bytes <= hbm_gib * GIB:
@@ -132,6 +132,7 @@ def diagnose(
     max_accum: int = 64,
     measured: bool = False,
     slices: int = 1,
+    pp_backward: str = "remat",
 ) -> List[Plan]:
     """Rank every legal (mesh, accum) plan for the configuration.
 
@@ -169,6 +170,7 @@ def diagnose(
             accum, fitres = _min_fitting_accum(
                 cfg, dp, axis2, layout, global_batch, seq_len,
                 spec.hbm_gib, moments_dtype, max_accum,
+                pp_backward=pp_backward,
             )
             if fitres is None:
                 continue
@@ -176,7 +178,7 @@ def diagnose(
                 cfg, chip=spec, dp=dp, axis2=axis2, layout=layout,
                 global_batch=global_batch, seq_len=seq_len,
                 grad_accum=accum, moments_dtype=moments_dtype,
-                slices=slices,
+                slices=slices, pp_backward=pp_backward,
             )
             plans.append(Plan(
                 layout=layout, dp=dp, axis2=axis2, grad_accum=accum,
@@ -280,6 +282,11 @@ def main(argv=None) -> int:
                    "DCN): the data axis crosses slices "
                    "(MeshSpec.dcn_axes); layouts whose dp cannot "
                    "divide into the slices are dropped")
+    p.add_argument("--pp-backward", choices=("remat", "stash"),
+                   default="remat",
+                   help="1f1b backward for the pipeline plans: remat "
+                   "(5/3 FLOPs, minimal memory) or stash (4/3, "
+                   "Megatron-style, O(S) microbatches of residuals)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -287,6 +294,7 @@ def main(argv=None) -> int:
         args.model, args.chips, args.chip, args.global_batch,
         args.seq_len, args.moments_dtype, args.long_context,
         measured=args.measured, slices=args.slices,
+        pp_backward=args.pp_backward,
     )
     seq = args.seq_len or llama2.PRESETS[args.model].max_seq_len
     if args.json:

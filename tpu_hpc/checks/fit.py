@@ -234,12 +234,17 @@ def activation_model_cp(
 def activation_model_pp(
     cfg: llama2.LlamaConfig, dp: int, stages: int,
     global_batch: int, seq_len: int, microbatches: int,
+    pp_backward: str = "remat",
 ) -> Dict[str, int]:
     """Per-chip activation bytes for the pipeline layout (1F1B,
     pp.pipelined): each chip holds ONE stage's layers; at the 1F1B
-    steady state up to ``stages`` microbatches are in flight per chip,
-    each contributing its stage's residual checkpoints (the custom-vjp
-    backward recomputes everything else). Sequence is NOT sharded
+    steady state up to ``stages`` microbatches are in flight per chip.
+    ``pp_backward="remat"`` (the default): each in-flight microbatch
+    contributes its stage's residual checkpoints only (the custom-vjp
+    backward recomputes everything else). ``"stash"``: each in-flight
+    slot instead holds the full vjp residuals -- every per-layer
+    intermediate plus a compute-dtype copy of the stage params
+    (pp.pipelined(backward="stash")). Sequence is NOT sharded
     (full seq per chip, flash attention assumed -- no S x S scores).
     """
     if global_batch % (dp * microbatches):
@@ -252,10 +257,30 @@ def activation_model_pp(
     h, kv = cfg.n_heads, cfg.kv_heads
     bf16, f32 = 2, 4
     layers_loc = cfg.n_layers // stages
-    in_flight = min(stages, microbatches)
-    checkpoints = (
-        in_flight * (layers_loc + 1) * mbr * seq_len * d * bf16
-    )
+    # The tick programs allocate their ring buffers at FIXED depth
+    # 2S as scan carries (pp.py: D = 2 * n_stages), and XLA keeps a
+    # scan carry resident for the whole scan -- capacity follows the
+    # allocation, not the in-flight high-water mark.
+    ring_depth = 2 * stages
+    # Residuals per microbatch per layer-token: dim (input) +
+    # q/k/v/attn-out + both SwiGLU hiddens (matches the roofline's
+    # stash_residuals traffic term, checks/roofline.py).
+    per_tok = d + (h + 2 * kv + h) * hd + 2 * cfg.ffn_hidden
+    if pp_backward == "stash":
+        # Every ring slot holds a full vjp residual set, including a
+        # bf16 stage-param copy.
+        checkpoints = ring_depth * (
+            layers_loc * mbr * seq_len * per_tok * bf16
+            + llama2.pp_worst_stage_params(cfg, stages) * bf16
+        )
+    else:
+        # Remat: ring slots hold stage INPUTS only; the backward's
+        # vjp materializes ONE microbatch's full stage residuals
+        # transiently each tick.
+        checkpoints = (
+            ring_depth * mbr * seq_len * d * bf16
+            + layers_loc * mbr * seq_len * per_tok * bf16
+        )
     qkv = mbr * seq_len * (h + 2 * kv) * hd * bf16
     attn_out = mbr * seq_len * h * hd * bf16
     lse = mbr * h * seq_len * f32
@@ -311,6 +336,7 @@ def analyze(
     compiler_options: Optional[Dict[str, str]] = None,
     moments_dtype: str = "float32",
     layout: str = "tp",
+    pp_backward: str = "remat",
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
 
@@ -386,7 +412,8 @@ def analyze(
             grad_bytes=p_stage * f32,
             opt_bytes=p_stage * 2 * mom,
             act_bytes=activation_model_pp(
-                cfg, dp, tp_size, global_batch, seq_len, grad_accum
+                cfg, dp, tp_size, global_batch, seq_len, grad_accum,
+                pp_backward=pp_backward,
             ),
             grad_accum=grad_accum,
             moments_dtype=moments_dtype,
