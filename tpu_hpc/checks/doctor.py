@@ -194,13 +194,15 @@ def diagnose(
 def to_markdown(
     plans: List[Plan], *, model: str, chips: int, chip_name: str,
     global_batch: int, seq_len: int, moments_dtype: str,
-    slices: int = 1,
+    slices: int = 1, pp_backward: str = "remat",
 ) -> str:
     tokens = global_batch * seq_len
     lines = [
         f"# doctor -- {model} on {chips}x {chip_name}"
         + (f" across {slices} slices (data axis on DCN)"
            if slices > 1 else "")
+        + (f" [pp plans: {pp_backward} backward]"
+           if pp_backward != "remat" else "")
         + f", batch {global_batch} x {seq_len} "
         f"({tokens / 1e6:.2f}M tokens/step)",
         "",
@@ -318,7 +320,7 @@ def main(argv=None) -> int:
             plans, model=args.model, chips=args.chips,
             chip_name=args.chip, global_batch=args.global_batch,
             seq_len=seq, moments_dtype=args.moments_dtype,
-            slices=args.slices,
+            slices=args.slices, pp_backward=args.pp_backward,
         ))
     return 0 if plans and plans[0].fits else 1
 
