@@ -1,0 +1,121 @@
+"""Pipeline-parallel training of the FLAGSHIP model: Llama-2 stage-split.
+
+The reference's pipeline example trains a dedicated PipelineTransformer
+(/root/reference/scripts/04_pipeline_parallel_pp/
+03_pipeline_training.py:198-252); here the same schedules run Llama-2
+itself. Llama's transformer blocks are homogeneous at apply time (the
+depth-scaled init only shapes parameter VALUES), so ``n_layers/S``
+consecutive blocks form one shape-preserving stage and the whole body
+pipelines as a single shard_map tick program
+(tpu_hpc/models/llama_pp.py + tpu_hpc/parallel/pp.py). Embedding and
+LM head run outside the pipelined body, replicated over the pipe axis.
+
+The split/merge round-trip is exact, so the sequential oracle for this
+script's program is ``llama2.apply_llama`` on the same values
+(tests/test_pp_llama.py pins forwards and grads for gpipe, 1f1b-remat
+and 1f1b-stash).
+
+Run: python train_llama_pipeline.py --pipe-parallel 4 --schedule 1f1b
+     python train_llama_pipeline.py --pipe-parallel 4 --schedule 1f1b \
+         --pp-backward stash   # Megatron residual-stash backward
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
+import argparse
+import dataclasses
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.models import datasets, llama2, llama_pp
+from tpu_hpc.parallel import pp
+from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+from tpu_hpc.train import Trainer
+
+
+def main(argv=None) -> int:
+    cfg = TrainingConfig.from_args(argv)
+    extra = argparse.ArgumentParser(add_help=False)
+    extra.add_argument(
+        "--schedule", choices=["gpipe", "1f1b"], default="1f1b",
+    )
+    extra.add_argument("--num-microbatches", type=int, default=8)
+    extra.add_argument(
+        "--pp-backward", choices=["remat", "stash"], default="remat",
+        help="1f1b backward: remat recomputes each stage forward "
+        "(minimal HBM); stash saves the vjp residuals "
+        "(Megatron-style, 4/3 instead of 5/3 of ideal FLOPs)",
+    )
+    args, _ = extra.parse_known_args(argv)
+
+    logger = get_logger()
+    init_distributed()
+    if cfg.pipe_parallel == 1:
+        dp = cfg.data_parallel if cfg.data_parallel > 0 else 1
+        cfg.pipe_parallel = jax.device_count() // dp
+    mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
+    n_stages = mesh.shape.get("pipe", 1)
+    M = args.num_microbatches
+    logger.info(
+        "mesh: %s | llama-2 over %d stages | schedule %s | "
+        "%d microbatches | bubble %.1f%%",
+        dict(mesh.shape), n_stages, args.schedule, M,
+        100 * pp.bubble_fraction(max(n_stages, 1), M),
+    )
+
+    param_dtype, compute_dtype = cfg.jax_dtypes()
+    model_cfg = llama2.LlamaConfig(
+        dim=256, n_layers=max(2 * n_stages, 2), n_heads=8,
+        vocab_size=4096, multiple_of=64, max_seq_len=256,
+        dtype=compute_dtype, param_dtype=param_dtype,
+    )
+    params = llama2.init_llama(jax.random.key(cfg.seed), model_cfg)
+
+    dp_size = mesh.shape.get("data", 1)
+    batch_spec = P(None, "data") if dp_size > 1 else P()
+    if n_stages > 1:
+        split = llama_pp.split_params(params, model_cfg, n_stages)
+        forward = llama_pp.make_forward(
+            model_cfg, mesh, n_microbatches=M,
+            schedule=args.schedule, backward=args.pp_backward,
+            batch_spec=batch_spec,
+        )
+        train_params = split
+        specs = llama_pp.pp_pspecs(split)
+    else:
+        # One device: train unpipelined (the reference's world_size==1
+        # fallback pattern) -- same model, same loss.
+        train_params = params
+        specs = None
+        forward = llama2.make_forward(model_cfg)
+
+    ds = datasets.TokenStream(
+        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
+    )
+    trainer = Trainer(
+        cfg, mesh, forward, train_params, param_pspecs=specs,
+        batch_pspec=P("data") if dp_size > 1 else P(),
+    )
+    result = trainer.fit(ds)
+    summary = result["epochs"][-1]
+    tokens_per_s = summary["items_per_s"] * model_cfg.max_seq_len
+    logger.info(
+        "run summary | final loss %.5f | %.0f tokens/s | "
+        "%d-layer llama over %d stages (%s%s)",
+        result["final_loss"], tokens_per_s, model_cfg.n_layers, n_stages,
+        args.schedule,
+        f"-{args.pp_backward}" if args.schedule == "1f1b" else "",
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    _sys.exit(main())

@@ -287,15 +287,25 @@ class TestPPLayout:
         # microbatch shrinks -> strictly less activation memory.
         assert sum(r32.act_bytes.values()) < sum(r8.act_bytes.values())
 
-    def test_compile_pass_refused(self):
+    def test_compile_pass_runs_real_stage_program(self):
+        """layout='pp' + do_compile AOT-compiles the real stage-split
+        Llama 1F1B step (models/llama_pp.py) -- the collective table
+        must show the pipeline's ring ppermutes."""
         from tpu_hpc.models import llama2 as l2
 
-        with pytest.raises(ValueError, match="analytic-only"):
-            fit.analyze(
-                l2.PRESETS["7b"], dp=1, tp_size=4, global_batch=8,
-                seq_len=4096, do_compile=True, grad_accum=8,
-                layout="pp",
-            )
+        cfg = l2.LlamaConfig(
+            dim=64, n_layers=4, n_heads=4, vocab_size=97,
+            multiple_of=32, max_seq_len=32,
+        )
+        r = fit.analyze(
+            cfg, dp=2, tp_size=4, global_batch=8,
+            seq_len=32, do_compile=True, grad_accum=4,
+            layout="pp",
+        )
+        assert r.compiled
+        assert r.collectives.get("collective-permute", 0) >= 2
+        # DP grad reduction across the data axis must appear too.
+        assert r.collectives.get("all-reduce", 0) >= 1
 
     def test_layers_divisibility_enforced(self):
         from tpu_hpc.models import llama2 as l2

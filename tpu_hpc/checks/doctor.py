@@ -248,11 +248,15 @@ def to_markdown(
         f"--grad-accum {best.grad_accum}"
         + (f" --moments-dtype {moments_dtype}"
            if moments_dtype != "float32" else "")
+        + (f" --pp-backward {pp_backward}"
+           if best.layout == "pp" and pp_backward != "remat" else "")
         + ("  # add --tpu-topology vXx... for the real lowering"),
         f"python -m tpu_hpc.checks.roofline --model {model} "
         f"--dp {best.dp} {axis_flag} "
         f"--global-batch {global_batch} --seq-len {seq_len} "
-        f"--grad-accum {best.grad_accum}",
+        f"--grad-accum {best.grad_accum}"
+        + (f" --pp-backward {pp_backward}"
+           if best.layout == "pp" and pp_backward != "remat" else ""),
         "```",
         "",
         "The fit row is the analytic footprint; compile it against a "

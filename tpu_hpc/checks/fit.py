@@ -322,6 +322,156 @@ def _count_collectives(hlo: str) -> Dict[str, int]:
     return counts
 
 
+def _resolve_devices(
+    tpu_topology: Optional[str], n_dev: int, result: "FitResult"
+) -> list:
+    """Device list for the AOT-compile pass: the chips of a virtual
+    TPU topology (no hardware needed -- libtpu compiles against the
+    description) or this process's real/simulated devices."""
+    if tpu_topology is not None:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name=tpu_topology
+        )
+        devices = list(topo.devices)
+        if len(devices) != n_dev:
+            raise RuntimeError(
+                f"topology {tpu_topology!r} has {len(devices)} chips, "
+                f"mesh needs {n_dev}"
+            )
+        result.compile_backend = f"tpu-topology:{tpu_topology}"
+    else:
+        devices = jax.devices()
+        if len(devices) < n_dev:
+            raise RuntimeError(
+                f"need {n_dev} devices for the compile pass, have "
+                f"{len(devices)}; run under TPU_HPC_SIM_DEVICES={n_dev} "
+                "or pass do_compile=False"
+            )
+    return devices
+
+
+def _compile_and_record(
+    result: "FitResult",
+    step,
+    state_abstract,
+    state_shardings,
+    batch_abstract,
+    batch_shardings,
+    compiler_options: Optional[Dict[str, str]],
+) -> "FitResult":
+    """The shared compile-and-record tail of every layout's AOT pass:
+    jit/lower/compile the step, time it, and attach the collective
+    table + the compiler's memory analysis to ``result``. One copy so
+    the pp report can never drift from the tp/cp reports."""
+    t0 = time.time()
+    compiled = (
+        jax.jit(
+            step,
+            in_shardings=(state_shardings, batch_shardings),
+            donate_argnums=(0,),
+        )
+        .lower(state_abstract, batch_abstract)
+        .compile(compiler_options=compiler_options or None)
+    )
+    result.compile_seconds = time.time() - t0
+    result.compiled = True
+    hlo = compiled.as_text()
+    result.collectives = _count_collectives(hlo)
+    mem = compiled.memory_analysis()
+    if mem is not None:
+        result.xla_argument_bytes = int(mem.argument_size_in_bytes)
+        result.xla_temp_bytes = int(
+            getattr(mem, "temp_size_in_bytes", 0) or 0
+        )
+    return result
+
+
+def _compile_pp(
+    result: "FitResult",
+    cfg: llama2.LlamaConfig,
+    dp: int,
+    stages: int,
+    global_batch: int,
+    seq_len: int,
+    microbatches: int,
+    tpu_topology: Optional[str],
+    attn: str,
+    compiler_options: Optional[Dict[str, str]],
+    moments_dtype: str,
+    pp_backward: str,
+) -> "FitResult":
+    """AOT-compile the REAL stage-split Llama pipeline step (the 1F1B
+    tick program of models/llama_pp.py + parallel/pp.py) over a
+    {data: dp, pipe: stages} mesh, and attach the compiler's
+    collective table + memory analysis to ``result`` -- the same
+    evidence class the tp/cp layouts have always had."""
+    from tpu_hpc.models import llama_pp
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+    from tpu_hpc.train.trainer import TrainState, make_adamw, make_step_fn
+
+    n_dev = dp * stages
+    devices = _resolve_devices(tpu_topology, n_dev, result)
+    mesh = build_mesh(
+        MeshSpec(axes={"data": dp, "pipe": stages}),
+        devices=devices[:n_dev],
+    )
+    attn_fn = None
+    if attn == "flash":
+        from tpu_hpc.kernels.attention import blockwise_attention
+
+        # Batch-local flash call (each stage owns its microbatch inside
+        # pp's shard_map); impl pinned to "pallas" for topology
+        # compiles, where "auto" would silently pick the XLA path.
+        impl = "pallas" if tpu_topology else "auto"
+
+        def attn_fn(q, k, v):
+            out, _ = blockwise_attention(q, k, v, causal=True, impl=impl)
+            return out
+
+    abstract_split = jax.eval_shape(
+        lambda: llama_pp.split_params(
+            llama2.init_llama(jax.random.key(0), cfg), cfg, stages
+        )
+    )
+    specs = llama_pp.pp_pspecs(abstract_split)
+    forward = llama_pp.make_forward(
+        cfg, mesh, n_microbatches=microbatches, schedule="1f1b",
+        backward=pp_backward,
+        batch_spec=P(None, "data") if dp > 1 else P(),
+        attn_fn=attn_fn,
+    )
+    optimizer = make_adamw(3e-4, 0.1, moments_dtype)
+    opt_abstract = jax.eval_shape(optimizer.init, abstract_split)
+    opt_specs = derived_pspecs(opt_abstract, abstract_split, specs)
+    step = make_step_fn(forward, optimizer, seed=0)
+
+    state_abstract = TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        params=abstract_split,
+        opt_state=opt_abstract,
+        model_state={},
+    )
+    state_shardings = TrainState(
+        step=NamedSharding(mesh, P()),
+        params=shardings_for(mesh, specs),
+        opt_state=shardings_for(mesh, opt_specs),
+        model_state={},
+    )
+    batch_abstract = tuple(
+        jax.ShapeDtypeStruct((global_batch, seq_len), jnp.int32)
+        for _ in range(2)
+    )
+    # Batch replicated at the step boundary (the Trainer's pp
+    # batch_pspec); pp's shard_map chops microbatch rows over data.
+    batch_shardings = tuple(NamedSharding(mesh, P()) for _ in range(2))
+    return _compile_and_record(
+        result, step, state_abstract, state_shardings,
+        batch_abstract, batch_shardings, compiler_options,
+    )
+
+
 def analyze(
     cfg: Optional[llama2.LlamaConfig] = None,
     dp: int = 4,
@@ -389,22 +539,17 @@ def analyze(
         )
 
     if layout == "pp":
-        # Analytic-only: the Llama model is not stage-split in this
-        # repo (pp.pipelined pipelines the homogeneous
-        # PipelineTransformer); the stage-shard byte accounting below
-        # mirrors pp.stage_pspecs (params stage-local, replicated over
-        # data -- the PP x DP composition bench_llama_pp runs).
-        if do_compile:
-            raise ValueError(
-                "layout='pp' is analytic-only (do_compile=False): the "
-                "compile pass certifies the GSPMD tp/cp shardings; the "
-                "pipeline step's compile evidence lives in "
-                "tests/test_pp.py and the bench"
-            )
+        # The stage-shard byte accounting mirrors pp.stage_pspecs
+        # (params stage-local, replicated over data -- the PP x DP
+        # composition bench_llama_pp runs). With ``do_compile`` the
+        # REAL stage-split Llama step (models/llama_pp.py through
+        # pp.pipelined) is AOT-compiled on top, so the report carries
+        # the compiler's own collective table and memory analysis like
+        # the tp/cp layouts.
         f32 = 4
         mom = 2 if moments_dtype == "bfloat16" else 4
         p_stage = llama2.pp_worst_stage_params(cfg, tp_size)
-        return FitResult(
+        result = FitResult(
             cfg=cfg, dp=dp, tp_size=tp_size, global_batch=global_batch,
             seq_len=seq_len, hbm_gib=hbm_gib,
             n_params=llama2.count_params(cfg),
@@ -419,6 +564,15 @@ def analyze(
             moments_dtype=moments_dtype,
             layout="pp",
             attn=attn,
+        )
+        result.compiler_options = dict(compiler_options or {})
+        if not do_compile:
+            return result
+        return _compile_pp(
+            result, cfg, dp, tp_size, global_batch, seq_len,
+            microbatches=grad_accum, tpu_topology=tpu_topology,
+            attn=attn, compiler_options=compiler_options,
+            moments_dtype=moments_dtype, pp_backward=pp_backward,
         )
 
     abstract_params = jax.eval_shape(
@@ -484,27 +638,7 @@ def analyze(
     from tpu_hpc.train.trainer import TrainState, make_step_fn
 
     n_dev = dp * tp_size
-    if tpu_topology is not None:
-        from jax.experimental import topologies
-
-        topo = topologies.get_topology_desc(
-            platform="tpu", topology_name=tpu_topology
-        )
-        devices = list(topo.devices)
-        if len(devices) != n_dev:
-            raise RuntimeError(
-                f"topology {tpu_topology!r} has {len(devices)} chips, "
-                f"mesh needs dp*tp = {n_dev}"
-            )
-        result.compile_backend = f"tpu-topology:{tpu_topology}"
-    else:
-        devices = jax.devices()
-        if len(devices) < n_dev:
-            raise RuntimeError(
-                f"need {n_dev} devices for the compile pass, have "
-                f"{len(devices)}; run under TPU_HPC_SIM_DEVICES={n_dev} "
-                "or pass do_compile=False"
-            )
+    devices = _resolve_devices(tpu_topology, n_dev, result)
     # build_mesh gives TPU device subsets (real or topology) ICI-aware
     # placement -- a flat reshape makes ring neighbors physically
     # distant, which v5e's limited ICI routing rejects outright for
@@ -570,27 +704,10 @@ def analyze(
     batch_shardings = tuple(
         NamedSharding(mesh, batch_spec) for _ in range(2)
     )
-    t0 = time.time()
-    compiled = (
-        jax.jit(
-            step,
-            in_shardings=(state_shardings, batch_shardings),
-            donate_argnums=(0,),
-        )
-        .lower(state_abstract, batch_abstract)
-        .compile(compiler_options=compiler_options or None)
+    return _compile_and_record(
+        result, step, state_abstract, state_shardings,
+        batch_abstract, batch_shardings, compiler_options,
     )
-    result.compile_seconds = time.time() - t0
-    result.compiled = True
-    hlo = compiled.as_text()
-    result.collectives = _count_collectives(hlo)
-    mem = compiled.memory_analysis()
-    if mem is not None:
-        result.xla_argument_bytes = int(mem.argument_size_in_bytes)
-        result.xla_temp_bytes = int(
-            getattr(mem, "temp_size_in_bytes", 0) or 0
-        )
-    return result
 
 
 def to_markdown(r: FitResult) -> str:
@@ -836,8 +953,9 @@ def main(argv=None) -> int:
     parser.add_argument("--pp", type=int, default=0,
                         help="pipeline stage count: switches to the "
                         "PP x DP layout (stage-sharded params, "
-                        "--grad-accum = microbatch count); analytic "
-                        "only -- implies --no-compile")
+                        "--grad-accum = microbatch count); the compile "
+                        "pass AOT-compiles the real stage-split Llama "
+                        "1F1B step (models/llama_pp.py)")
     parser.add_argument("--global-batch", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=4096)
     parser.add_argument("--hbm-gib", type=float, default=32.0)
@@ -873,6 +991,12 @@ def main(argv=None) -> int:
                         default="float32",
                         help="AdamW moment storage dtype; bfloat16 "
                         "halves optimizer-state HBM")
+    parser.add_argument("--pp-backward", choices=("remat", "stash"),
+                        default="remat",
+                        help="1f1b backward for --pp accounting: remat "
+                        "saves stage inputs only; stash adds the vjp-"
+                        "residual buffers (Megatron-style) to the HBM "
+                        "model")
     parser.add_argument("--xla-opt", action="append", default=[],
                         metavar="KEY=VALUE",
                         help="extra XLA compiler option for the "
@@ -893,12 +1017,10 @@ def main(argv=None) -> int:
     # topology description -- so skip provisioning entirely.
     if args.pp and args.cp:
         parser.error("--pp and --cp are mutually exclusive")
-    if args.pp:
-        args.no_compile = True  # pp is analytic-only (see analyze())
     if not args.no_compile and args.tpu_topology is None:
         from tpu_hpc.runtime import sim
 
-        n_dev = args.dp * (args.cp or args.tp)
+        n_dev = args.dp * (args.pp or args.cp or args.tp)
         if not sim.backends_initialized():
             sim.force_sim_devices(n_dev)
         elif len(jax.devices()) < n_dev:
@@ -926,6 +1048,7 @@ def main(argv=None) -> int:
         compiler_options=_parse_xla_opts(args.xla_opt),
         moments_dtype=args.moments_dtype,
         layout="pp" if args.pp else ("cp" if args.cp else "tp"),
+        pp_backward=args.pp_backward,
     )
     md = to_markdown(r)
     if args.markdown:

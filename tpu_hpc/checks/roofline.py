@@ -475,6 +475,11 @@ def main(argv=None) -> int:
     p.add_argument("--grad-accum", type=int, default=1)
     p.add_argument("--moments-dtype", default="float32",
                    choices=("float32", "bfloat16"))
+    p.add_argument("--pp-backward", choices=("remat", "stash"),
+                   default="remat",
+                   help="1f1b backward the --pp bound models: remat = "
+                   "5/3 extra-forward factor, stash = 4/3 plus the "
+                   "stash_residuals memory term")
     p.add_argument(
         "--measured", action="store_true",
         help="calibrate --chip against this host's chip: run the "
@@ -512,6 +517,7 @@ def main(argv=None) -> int:
         grad_accum=args.grad_accum,
         moments_dtype=args.moments_dtype,
         slices=args.slices,
+        pp_backward=args.pp_backward,
     )
     if args.json:
         print(json.dumps({

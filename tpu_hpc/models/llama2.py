@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -183,14 +183,19 @@ def count_params(cfg: "LlamaConfig") -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def count_params_by_part(cfg: "LlamaConfig") -> "Dict[str, int]":
+def count_params_by_part(cfg: "LlamaConfig") -> "Mapping[str, int]":
     """Param counts split by pipeline role: one transformer layer
     (``per_layer``), the token embedding (``embed``), the LM head
     (``head``), and everything else (``other``, the final norm).
     Source for the pipeline-parallel stage-shard accounting in
     checks/fit.py and checks/roofline.py -- derived from the same
     eval_shape tree as count_params, so
-    ``per_layer * n_layers + embed + head + other == count_params``."""
+    ``per_layer * n_layers + embed + head + other == count_params``.
+    Returns an immutable view: the lru_cache hands every caller the
+    same object, so a mutable dict would let one caller poison
+    pp_worst_stage_params for all later calls."""
+    import types
+
     import numpy as np
 
     abstract = jax.eval_shape(
@@ -209,7 +214,7 @@ def count_params_by_part(cfg: "LlamaConfig") -> "Dict[str, int]":
             parts["head"] = n
         else:
             parts["other"] += n
-    return parts
+    return types.MappingProxyType(parts)
 
 
 def pp_worst_stage_params(cfg: "LlamaConfig", stages: int) -> int:

@@ -69,7 +69,7 @@ class CausalLayer(nn.Module):
     flash kernel: no [L, L] score buffer)."""
 
     cfg: PipeConfig
-    attn_fn: AttnFn = None
+    attn_fn: Optional[AttnFn] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -104,7 +104,7 @@ class StageBlock(nn.Module):
     Shape-preserving ([B, L, D] -> [B, L, D]) as pp.pipelined requires."""
 
     cfg: PipeConfig
-    attn_fn: AttnFn = None
+    attn_fn: Optional[AttnFn] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -160,7 +160,7 @@ def head(params: Dict, x: jax.Array, cfg: PipeConfig) -> jax.Array:
     return (x @ h["kernel"]).astype(jnp.float32)
 
 
-def make_stage_fn(cfg: PipeConfig, attn_fn: AttnFn = None):
+def make_stage_fn(cfg: PipeConfig, attn_fn: Optional[AttnFn] = None):
     """stage_fn(stage_params, x) for tpu_hpc.parallel.pp.pipelined."""
     block = StageBlock(cfg, attn_fn)
 

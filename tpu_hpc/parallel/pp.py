@@ -142,7 +142,17 @@ def _res_key(a) -> tuple:
     """Canonical sort key for stash residual leaves. The vjp closure's
     leaf ORDER is a tracing artifact (it differs between trace
     contexts under shard_map), so the stash buffers live in this
-    sorted order and each tick applies its own static permutation."""
+    sorted order and each tick applies its own static permutation.
+
+    The key is (shape, dtype) only, so leaves that tie under it are
+    mutually interchangeable as far as _res_order's cross-trace
+    validation can see. That is safe by construction -- within ONE
+    trace the store and the load both use that trace's own ``order``,
+    so each buffer round-trips the same leaf -- but it does mean the
+    validation detects multiset drift (a shape/dtype appearing or
+    vanishing between traces), not a permutation among identically-
+    shaped leaves. If jax ever exposes a stable per-leaf identity for
+    vjp residuals, fold it into this key."""
     return (str(jnp.shape(a)), str(a.dtype))
 
 
@@ -165,8 +175,8 @@ def _res_order(new_leaves: list, template: list, where: str) -> list:
     ]:
         raise ValueError(
             f"{where} stash backward: the stage vjp's residual "
-            "shapes differ between trace contexts -- use "
-            "backward='remat' for this stage_fn"
+            "shape/dtype multiset differs between trace contexts -- "
+            "use backward='remat' for this stage_fn"
         )
     return order
 
