@@ -61,14 +61,18 @@ def main(argv=None) -> int:
     )
     extra.add_argument("--log-file", default="resnet_benchmark.log")
     extra.add_argument(
-        "--dataset", choices=("synthetic", "digits"), default="synthetic",
+        "--dataset", choices=("synthetic", "digits", "digits50k"),
+        default="synthetic",
         help="synthetic: on-device CIFAR-shaped random batches "
         "(throughput runs, no files); digits: REAL images from disk "
         "through the native C++ loader -- host 0 prepares the record "
         "files on first run, every host barriers, then trains from "
         "the mmap'd epoch-shuffled reader (the reference's rank-0 "
         "CIFAR-10 download + barrier path, resnet_fsdp_training.py:"
-        "45-87)",
+        "45-87); digits50k: the CIFAR-SCALE set -- 50k/10k augmented "
+        "32x32 images from the real digits, split by original image "
+        "(vision.prepare_digits_at_scale), exercising the C++ "
+        "prefetch ring at real-dataset size",
     )
     extra.add_argument("--dataset-dir", default="data",
                        help="where --dataset digits stores its files")
@@ -90,12 +94,17 @@ def main(argv=None) -> int:
     else:
         mesh = build_mesh(MeshSpec(axes={"data": -1}))
     param_dtype, compute_dtype = cfg.jax_dtypes()
-    if ns.dataset == "digits":
+    if ns.dataset in ("digits", "digits50k"):
         from tpu_hpc.native import vision
 
-        prefix = os.path.join(ns.dataset_dir, "digits")
+        prefix = os.path.join(ns.dataset_dir, ns.dataset)
+        prep = (
+            (lambda: vision.prepare_digits_at_scale(prefix))
+            if ns.dataset == "digits50k"
+            else (lambda: vision.prepare_digits(prefix))
+        )
         vision.prepare_on_host0(
-            lambda: vision.prepare_digits(prefix),
+            prep,
             [prefix + ".train", prefix + ".test", prefix + ".json"],
         )
         meta0 = vision.read_meta(prefix)
@@ -133,7 +142,7 @@ def main(argv=None) -> int:
         batch_spec = fsdp.hybrid_shard_batch_pspec()
     else:
         specs = dp.param_pspecs(params)
-    if ns.dataset == "digits":
+    if ns.dataset in ("digits", "digits50k"):
         meta = vision.read_meta(prefix)
         ds = vision.NativeImageClassDataset(
             prefix + ".train", cfg.global_batch_size,
@@ -182,7 +191,7 @@ def main(argv=None) -> int:
         ds_test,
         n_steps=(
             max(ds_test.n_samples // cfg.global_batch_size, 1)
-            if ns.dataset == "digits" else None
+            if ns.dataset in ("digits", "digits50k") else None
         ),
     )
     logger.info(

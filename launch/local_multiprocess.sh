@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Explicit-env launch mode, as a runnable script: spawn N local
+# processes with JAX_PROCESS_ID / JAX_NUM_PROCESSES /
+# JAX_COORDINATOR_ADDRESS exported by hand -- the third of this
+# repo's three launch modes (docs/guide/12_tpu_operations.md:36-57;
+# parity role: any reference launcher that exports RANK/WORLD_SIZE/
+# MASTER_ADDR itself, e.g. torchrun_multigpu_ddp.sh:59-76).
+#
+# On a real deployment each process runs on its own TPU host and N
+# comes from the slice shape; locally this is the smoke-test mode
+# (processes share the machine, each on a CPU-sim backend unless
+# TPU_HPC_LOCAL_DEVICES says otherwise).
+#
+# Usage:
+#   ./local_multiprocess.sh 2 examples/...py [args...]
+#   NPROC via $1; coordinator on 127.0.0.1:${COORD_PORT:-12355}.
+set -euo pipefail
+
+NPROC="${1:?usage: local_multiprocess.sh <nproc> <script.py> [args...]}"
+shift
+SCRIPT="${1:?usage: local_multiprocess.sh <nproc> <script.py> [args...]}"
+shift || true
+COORD_PORT="${COORD_PORT:-12355}"
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+PY="${PYTHON:-$(command -v python3 || command -v python)}"
+
+pids=()
+for ((i = 0; i < NPROC; i++)); do
+    JAX_PROCESS_ID="${i}" \
+    JAX_NUM_PROCESSES="${NPROC}" \
+    JAX_COORDINATOR_ADDRESS="127.0.0.1:${COORD_PORT}" \
+    PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:$PYTHONPATH}" \
+        "${PY}" "${SCRIPT}" "$@" &
+    pids+=($!)
+done
+rc=0
+for pid in "${pids[@]}"; do
+    wait "${pid}" || rc=$?
+done
+exit "${rc}"

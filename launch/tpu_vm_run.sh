@@ -12,6 +12,9 @@
 set -euo pipefail
 
 TPU_NAME="${TPU_NAME:-tpu-hpc-dev}"
+# Overridable for smoke tests (tests/test_launch.py substitutes a
+# stub that captures the assembled remote command).
+GCLOUD="${GCLOUD:-gcloud}"
 ZONE="${ZONE:-us-central2-b}"
 LOG_DIR="${LOG_DIR:-}"
 # XLA/libtpu performance preset exported before the program starts --
@@ -44,7 +47,7 @@ if [[ -n "${LOG_DIR}" ]]; then
 fi
 
 echo ">> launching ${SCRIPT} ${ARGS} on all workers of ${TPU_NAME}"
-gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
+"${GCLOUD}" compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
     --command "
         set -e
         ${REDIRECT}
@@ -57,7 +60,7 @@ gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
 
 if [[ -n "${LOG_DIR}" ]]; then
     mkdir -p "${LOG_DIR}"
-    gcloud compute tpus tpu-vm scp --recurse \
+    "${GCLOUD}" compute tpus tpu-vm scp --recurse \
         "${TPU_NAME}:~/tpu_hpc_logs/*" "${LOG_DIR}/" \
         --zone "${ZONE}" --worker=all || true
 fi

@@ -231,17 +231,18 @@ class TestHaloExchange:
             )(None, x)
 
 
-def test_halo_conv2d_rejects_stride():
-    """stride>1 would need asymmetric SAME padding (k=3, s=2 pads
-    (0,1)); the symmetric halo path would shift window centers, so it
-    must refuse rather than silently diverge from the oracle."""
+def test_halo_conv2d_rejects_uneven_stride():
+    """A stride that does not divide the local tile height would make
+    devices emit fractional output rows; it must refuse rather than
+    silently diverge from the oracle. (Strided convs themselves are
+    supported -- see tests/test_domain_unet.py.)"""
     import jax
 
     x = jnp.zeros((1, 8, 8, 1))
     kern = jnp.zeros((3, 3, 1, 1))
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="divide by stride"):
         jax.eval_shape(
             lambda: domain.halo_conv2d(
-                x, kern, axis_name="spatial", stride=2
+                x, kern, axis_name="spatial", stride=3
             )
         )

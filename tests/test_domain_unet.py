@@ -1,0 +1,268 @@
+"""Strided/asymmetric halo ops and the domain-parallel U-Net.
+
+Oracle = the single-device computation on the SAME values:
+``jax.lax.conv`` with SAME padding for the strided convs,
+``jax.image.resize`` for the bilinear upsample, and the flax
+``apply_unet`` itself for the whole network (the domain twin consumes
+``init_unet``'s own trees). Parity target: the strided-downsampling
+capability the reference documents for ShardTensor
+(docs/guide/10_domain_parallel.md:113-149) at its U-Net's real shape
+(multinode_ddp_unet.py:171-214).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hpc.parallel import domain, domain_unet
+from tpu_hpc.runtime import MeshSpec, build_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshSpec(axes={"data": 2, "spatial": 4}))
+
+
+def oracle_conv(x, kernel, stride=1, wrap=False):
+    if wrap:
+        kh, s = kernel.shape[0], stride
+        lo = (kh - s) // 2 if kh > s else 0
+        hi = max(kh - s - lo, 0)
+        parts = [x[:, x.shape[1] - lo:] if lo else None, x,
+                 x[:, :hi] if hi else None]
+        x = jnp.concatenate([p for p in parts if p is not None], axis=1)
+        pad_h = (0, 0)
+        kw = kernel.shape[1]
+        w_out = -(-x.shape[2] // stride)
+        tw = max((w_out - 1) * stride + kw - x.shape[2], 0)
+        pad_w = (tw // 2, tw - tw // 2)
+        return jax.lax.conv_general_dilated(
+            x, kernel, (stride, stride), (pad_h, pad_w),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+    return jax.lax.conv_general_dilated(
+        x, kernel, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def rand(key, shape, scale=1.0):
+    return scale * jax.random.normal(key, shape, jnp.float32)
+
+
+class TestStridedHaloConv:
+    @pytest.mark.parametrize(
+        "k,stride", [(3, 2), (2, 2), (5, 2), (1, 2), (4, 2), (3, 4),
+                     (4, 1), (5, 1)],
+    )
+    def test_matches_same_conv(self, mesh, k, stride):
+        """Any (kernel, stride): halo windows land exactly where XLA
+        SAME places them, including the asymmetric odd-total splits."""
+        kx, kk = jax.random.split(jax.random.key(k * 10 + stride))
+        x = rand(kx, (2, 32, 16, 3))
+        kernel = rand(kk, (k, k, 3, 5), 0.1)
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_conv2d(
+                t, p, axis_name=ax, stride=stride
+            ),
+            mesh,
+        )
+        got = jax.jit(fn)(kernel, x)
+        want = oracle_conv(x, kernel, stride)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize("k,stride", [(4, 2), (3, 1), (6, 2)])
+    def test_periodic_strided(self, mesh, k, stride):
+        kx, kk = jax.random.split(jax.random.key(k))
+        x = rand(kx, (2, 32, 16, 3))
+        kernel = rand(kk, (k, k, 3, 4), 0.1)
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_conv2d(
+                t, p, axis_name=ax, stride=stride, wrap=True
+            ),
+            mesh,
+        )
+        got = jax.jit(fn)(kernel, x)
+        want = oracle_conv(x, kernel, stride, wrap=True)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_periodic_odd_split_rejected(self, mesh):
+        kernel = jnp.zeros((3, 3, 3, 4))
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_conv2d(
+                t, p, axis_name=ax, stride=2, wrap=True
+            ),
+            mesh,
+        )
+        with pytest.raises(ValueError, match="k-s even"):
+            jax.jit(fn)(kernel, jnp.zeros((2, 32, 16, 3)))
+
+    def test_stride_must_divide_tile(self, mesh):
+        kernel = jnp.zeros((3, 3, 3, 4))
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_conv2d(
+                t, p, axis_name=ax, stride=3
+            ),
+            mesh,
+        )
+        # H_loc = 32/4 = 8, not divisible by 3.
+        with pytest.raises(ValueError, match="divide by stride"):
+            jax.jit(fn)(kernel, jnp.zeros((2, 32, 16, 3)))
+
+    def test_grad_matches_oracle(self, mesh):
+        """The strided halo conv's vjp (transposed ppermutes + conv
+        transpose) equals the single-device gradient."""
+        kx, kk = jax.random.split(jax.random.key(7))
+        x = rand(kx, (2, 32, 16, 3))
+        kernel = rand(kk, (3, 3, 3, 5), 0.1)
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_conv2d(
+                t, p, axis_name=ax, stride=2
+            ),
+            mesh,
+        )
+
+        def loss_pp(k_, x_):
+            return jnp.sum(jax.jit(fn)(k_, x_) ** 2)
+
+        def loss_or(k_, x_):
+            return jnp.sum(oracle_conv(x_, k_, 2) ** 2)
+
+        gk, gx = jax.grad(loss_pp, argnums=(0, 1))(kernel, x)
+        wk, wx = jax.grad(loss_or, argnums=(0, 1))(kernel, x)
+        np.testing.assert_allclose(gk, wk, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(gx, wx, rtol=1e-4, atol=1e-4)
+
+
+class TestPoolAndUpsample:
+    def test_pool_matches(self, mesh):
+        import flax.linen as nn
+
+        x = rand(jax.random.key(3), (2, 32, 16, 6))
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.max_pool_2x2(t), mesh
+        )
+        got = jax.jit(fn)({}, x)
+        want = nn.max_pool(x, (2, 2), strides=(2, 2))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_upsample_matches_resize(self, mesh):
+        x = rand(jax.random.key(4), (2, 16, 8, 6))
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_upsample2x(t, ax), mesh
+        )
+        got = jax.jit(fn)({}, x)
+        b, h, w, c = x.shape
+        want = jax.image.resize(
+            x, (b, 2 * h, 2 * w, c), method="bilinear"
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_upsample_grad_matches(self, mesh):
+        x = rand(jax.random.key(5), (2, 16, 8, 3))
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_upsample2x(t, ax), mesh
+        )
+        g = jax.grad(lambda t: jnp.sum(jax.jit(fn)({}, t) ** 2))(x)
+        b, h, w, c = x.shape
+        w_ = jax.grad(
+            lambda t: jnp.sum(
+                jax.image.resize(
+                    t, (b, 2 * h, 2 * w, c), method="bilinear"
+                ) ** 2
+            )
+        )(x)
+        np.testing.assert_allclose(g, w_, rtol=1e-4, atol=1e-5)
+
+
+class TestDomainUNet:
+    """The whole U-Net under the domain mesh vs flax apply_unet on the
+    SAME init trees -- forward (train + eval), updated running stats,
+    and parameter gradients."""
+
+    @pytest.fixture(scope="class")
+    def setup(self, mesh):
+        from tpu_hpc.models.unet import UNetConfig, init_unet
+
+        cfg = UNetConfig(in_channels=3, out_channels=3, base_features=8)
+        # H=32 divides by spatial(4) * 4 (two pool levels).
+        params, state = init_unet(jax.random.key(0), cfg, (32, 16, 3))
+        x = rand(jax.random.key(1), (4, 32, 16, 3))
+        return cfg, params, state, x
+
+    def test_train_forward_and_stats(self, mesh, setup):
+        from tpu_hpc.models.unet import apply_unet
+
+        cfg, params, state, x = setup
+        dom = domain_unet.make_domain_unet(mesh, cfg)
+        got, new_state = jax.jit(
+            lambda p, s, t: dom(p, s, t, train=True)
+        )(params, state, x)
+        want, want_state = apply_unet(params, state, x, cfg, train=True)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+        for (kp, g), (_, w) in zip(
+            jax.tree.flatten_with_path(new_state)[0],
+            jax.tree.flatten_with_path(want_state)[0],
+        ):
+            np.testing.assert_allclose(
+                g, w, rtol=1e-4, atol=1e-5,
+                err_msg=f"stats mismatch at {jax.tree_util.keystr(kp)}",
+            )
+
+    def test_eval_forward(self, mesh, setup):
+        from tpu_hpc.models.unet import apply_unet
+
+        cfg, params, state, x = setup
+        dom = domain_unet.make_domain_unet(mesh, cfg)
+        got, _ = jax.jit(
+            lambda p, s, t: dom(p, s, t, train=False)
+        )(params, state, x)
+        want, _ = apply_unet(params, state, x, cfg, train=False)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_param_grads_match(self, mesh, setup):
+        from tpu_hpc.models.unet import apply_unet
+
+        cfg, params, state, x = setup
+        y = rand(jax.random.key(2), x.shape)
+        dom = domain_unet.make_domain_unet(mesh, cfg)
+
+        def loss_dom(p):
+            pred, _ = dom(p, state, x, train=True)
+            return jnp.mean((pred - y) ** 2)
+
+        def loss_or(p):
+            pred, _ = apply_unet(p, state, x, cfg, train=True)
+            return jnp.mean((pred - y) ** 2)
+
+        gd = jax.jit(jax.grad(loss_dom))(params)
+        go = jax.jit(jax.grad(loss_or))(params)
+        for (kp, g), (_, w) in zip(
+            jax.tree.flatten_with_path(gd)[0],
+            jax.tree.flatten_with_path(go)[0],
+        ):
+            np.testing.assert_allclose(
+                g, w, rtol=2e-3, atol=2e-4,
+                err_msg=f"grad mismatch at {jax.tree_util.keystr(kp)}",
+            )
+
+    def test_trains_under_trainer(self, mesh, setup):
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_hpc.config import TrainingConfig
+        from tpu_hpc.models import datasets
+        from tpu_hpc.train import Trainer
+
+        cfg, params, state, _ = setup
+        ds = datasets.ERA5Synthetic(lat=32, lon=16, n_vars=1, n_levels=3)
+        forward = domain_unet.make_forward(mesh, cfg)
+        tc = TrainingConfig(
+            global_batch_size=4, steps_per_epoch=1, epochs=1,
+            learning_rate=1e-3,
+        )
+        trainer = Trainer(
+            tc, mesh, forward, params, state,
+            batch_pspec=P("data", "spatial"),
+        )
+        metrics = trainer.train_step(ds.batch_at(0, 4))
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))

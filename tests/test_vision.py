@@ -152,3 +152,52 @@ class TestBatchNormEvalRegression:
             f"eval-mode accuracy {acc} near chance: BatchNorm running "
             "stats not converging (momentum regression)"
         )
+
+
+class TestPrepareAtScale:
+    """CIFAR-scale augmented set: real source images, honest split."""
+
+    @pytest.fixture(scope="class")
+    def scaled(self, tmp_path_factory):
+        prefix = str(tmp_path_factory.mktemp("vis50k") / "digits50k")
+        meta = vision.prepare_digits_at_scale(
+            prefix, n_train=600, n_test=150, size=32
+        )
+        return prefix, meta
+
+    def test_meta_and_shapes(self, scaled):
+        prefix, meta = scaled
+        assert meta["x_shape"] == [32, 32, 1]
+        assert meta["n_classes"] == 10
+        assert meta["n_source_images"] == 1797
+        ds = vision.NativeImageClassDataset(
+            prefix + ".train", 32, tuple(meta["x_shape"])
+        )
+        x, y = ds.batch_at(0, 32)
+        assert x.shape == (32, 32, 32, 1) and x.dtype == np.float32
+        assert float(x.min()) >= 0.0 and float(x.max()) <= 1.0
+        assert y.dtype == np.int32 and set(y) <= set(range(10))
+        ds.close()
+
+    def test_augmentations_vary(self, scaled):
+        """Augmented images must not be byte-duplicates of each other
+        (600 draws from 1437 source images would collide constantly
+        if augmentation were a no-op)."""
+        prefix, meta = scaled
+        ds = vision.NativeImageClassDataset(
+            prefix + ".train", 64, tuple(meta["x_shape"])
+        )
+        x, _ = ds.batch_at(0, 64)
+        flat = x.reshape(64, -1)
+        dists = np.linalg.norm(flat[:, None] - flat[None, :], axis=-1)
+        np.fill_diagonal(dists, np.inf)
+        assert dists.min() > 1e-3
+        ds.close()
+
+    def test_deterministic(self, tmp_path):
+        a = str(tmp_path / "a")
+        b = str(tmp_path / "b")
+        vision.prepare_digits_at_scale(a, n_train=50, n_test=20)
+        vision.prepare_digits_at_scale(b, n_train=50, n_test=20)
+        with open(a + ".train", "rb") as fa, open(b + ".train", "rb") as fb:
+            assert fa.read() == fb.read()

@@ -34,29 +34,42 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 def halo_exchange(
     x: jax.Array,
     axis_name: str,
-    halo: int,
+    halo,
     *,
     axis: int = 1,
     wrap: bool = False,
 ) -> jax.Array:
-    """Pad the local tile with ``halo`` rows from each ring neighbor
-    along ``axis``. In-shard_map form; local [..., H_loc, ...] ->
-    [..., H_loc + 2*halo, ...]. Ring ends receive zeros unless
-    ``wrap`` (periodic domain)."""
-    if halo == 0:
+    """Pad the local tile with neighbor rows along ``axis``. In-
+    shard_map form; local [..., H_loc, ...] ->
+    [..., lo + H_loc + hi, ...]. ``halo`` is an int (symmetric) or an
+    ``(lo, hi)`` pair -- strided convs need ASYMMETRIC halos because
+    XLA SAME padding is asymmetric when the total pad is odd (k=3,
+    s=2 pads (0, 1)). Ring ends receive zeros unless ``wrap``
+    (periodic domain), which is exactly the oracle's zero SAME pad at
+    the global boundary."""
+    lo, hi = (halo, halo) if isinstance(halo, int) else halo
+    if lo == 0 and hi == 0:
         return x
+    if lo < 0 or hi < 0:
+        raise ValueError(f"negative halo ({lo}, {hi})")
     n = jax.lax.axis_size(axis_name)
     size = x.shape[axis]
-    if halo > size:
-        raise ValueError(f"halo {halo} exceeds local tile size {size}")
+    if max(lo, hi) > size:
+        raise ValueError(
+            f"halo ({lo}, {hi}) exceeds local tile size {size}"
+        )
     fwd = [(i, i + 1) for i in range(n - 1)] + ([(n - 1, 0)] if wrap else [])
     bwd = [(i + 1, i) for i in range(n - 1)] + ([(0, n - 1)] if wrap else [])
-    first = jax.lax.slice_in_dim(x, 0, halo, axis=axis)
-    last = jax.lax.slice_in_dim(x, size - halo, size, axis=axis)
-    # My last rows become the right neighbor's left halo, and vice versa.
-    from_left = jax.lax.ppermute(last, axis_name, fwd)
-    from_right = jax.lax.ppermute(first, axis_name, bwd)
-    return jnp.concatenate([from_left, x, from_right], axis=axis)
+    parts = []
+    if lo:
+        # My last rows become the right neighbor's left halo.
+        last = jax.lax.slice_in_dim(x, size - lo, size, axis=axis)
+        parts.append(jax.lax.ppermute(last, axis_name, fwd))
+    parts.append(x)
+    if hi:
+        first = jax.lax.slice_in_dim(x, 0, hi, axis=axis)
+        parts.append(jax.lax.ppermute(first, axis_name, bwd))
+    return jnp.concatenate(parts, axis=axis) if len(parts) > 1 else x
 
 
 def halo_conv2d(
@@ -67,39 +80,135 @@ def halo_conv2d(
     axis_name: str,
     stride: int = 1,
     wrap: bool = False,
+    global_h: Optional[int] = None,
+    global_w: Optional[int] = None,
 ) -> jax.Array:
-    """Spatially-correct SAME conv on an H-sharded NHWC tile.
+    """Spatially-correct SAME conv on an H-sharded NHWC tile, any
+    stride.
 
     x: local [B, H_loc, W, Cin]; kernel: [kh, kw, Cin, Cout] (HWIO).
-    Exchanges kh//2 halo rows, then runs a VALID conv on the padded
-    tile (W still zero-padded locally), reproducing the single-device
-    SAME conv exactly (the fix for the boundary corruption demo,
-    10_domain_parallel.md:69-103).
+    Exchanges the exact (asymmetric) halo the global window placement
+    requires, then runs a VALID conv on the padded tile (W zero-padded
+    locally), reproducing the single-device SAME conv bit-for-bit (the
+    fix for the boundary corruption demo, 10_domain_parallel.md:69-103;
+    strided downsampling extends the capability to the realistic
+    SciML encoder shape).
 
-    Only ``stride=1`` is supported: XLA SAME padding is asymmetric
-    when the total pad is odd (k=3, s=2 pads (0, 1)), while the halo
-    path pads kh//2 rows on both sides, so a strided halo conv would
-    silently shift output window centers relative to the single-device
-    oracle. Strided downsampling in a domain-parallel model should
-    pool/stride in the unsharded W dim or re-tile instead."""
-    if stride != 1:
-        raise NotImplementedError(
-            "halo_conv2d supports stride=1 only (asymmetric SAME "
-            "padding under stride>1 breaks oracle equivalence)"
-        )
+    Window placement under stride s: XLA SAME puts window j at rows
+    ``[j*s - pad_lo, j*s - pad_lo + k)`` with total pad
+    ``max((ceil(H/s)-1)*s + k - H, 0)`` split (lo = total//2,
+    hi = total - lo) -- ASYMMETRIC when odd (k=3, s=2 pads (0, 1)).
+    Device d's outputs are rows ``[d*H_loc/s, (d+1)*H_loc/s)``, so its
+    tile needs ``pad_lo`` rows from the left neighbor and
+    ``k - s - pad_lo`` (clamped at 0) from the right; non-cyclic
+    ppermute delivers zeros at the ring ends = the oracle's boundary
+    pad. Requires H_loc % s == 0 (every device emits whole output
+    rows); ``global_h``/``global_w`` override the H/W the SAME-pad
+    arithmetic assumes (defaults: this tile's extents x the axis
+    size, exact when the global size divides evenly).
+    """
     kh, kw = kernel.shape[0], kernel.shape[1]
-    pad_h, pad_w = kh // 2, kw // 2
-    xp = halo_exchange(x, axis_name, pad_h, axis=1, wrap=wrap)
+    h_loc, w = x.shape[1], x.shape[2]
+    if stride < 1:
+        raise ValueError(f"stride {stride} must be >= 1")
+    if h_loc % stride:
+        raise ValueError(
+            f"local tile height {h_loc} must divide by stride {stride} "
+            "(each device must emit whole output rows)"
+        )
+
+    def same_pads(size: int, k: int, s: int):
+        out = -(-size // s)  # ceil
+        total = max((out - 1) * s + k - size, 0)
+        return total // 2, total - total // 2
+
+    # The H pad split depends only on (H % s, k, s); with H_loc % s == 0
+    # the local extent has the same residue as any global multiple, so
+    # the default is exact whenever the shard is even. wrap=True is a
+    # periodic domain: no boundary pad, symmetric halos.
+    if wrap:
+        if (kh - stride) % 2:
+            raise ValueError(
+                f"periodic strided conv needs k-s even (k={kh}, "
+                f"s={stride}): the wrap halo has no zero-pad slack "
+                "to absorb an asymmetric split"
+            )
+        pad_lo = (kh - stride) // 2 if kh > stride else 0
+    else:
+        pad_lo, _ = same_pads(global_h or h_loc, kh, stride)
+    halo_lo = pad_lo
+    # Rows the last local window reads past the tile end; k <= s needs
+    # none (windows never overlap, VALID's floor drops skipped rows).
+    halo_hi = max(kh - stride - pad_lo, 0)
+    xp = halo_exchange(
+        x, axis_name, (halo_lo, halo_hi), axis=1, wrap=wrap
+    )
+    pw_lo, pw_hi = same_pads(global_w or w, kw, stride)
     out = jax.lax.conv_general_dilated(
         xp,
         kernel,
         window_strides=(stride, stride),
-        padding=((0, 0), (pad_w, pad_w)),
+        padding=((0, 0), (pw_lo, pw_hi)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
     if bias is not None:
         out = out + bias
     return out
+
+
+def max_pool_2x2(x: jax.Array) -> jax.Array:
+    """2x2/stride-2 max pool on an H-sharded NHWC tile. Needs NO halo:
+    with H_loc even the pooling windows tile each shard exactly (the
+    k == s case of the window-placement arithmetic above), so the
+    local pool IS the global pool -- the U-Net encoder's downsampling
+    comes free under domain parallelism."""
+    if x.shape[1] % 2:
+        raise ValueError(
+            f"local tile height {x.shape[1]} must be even for a 2x2 "
+            "pool (whole windows per device)"
+        )
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        window_dimensions=(1, 2, 2, 1),
+        window_strides=(1, 2, 2, 1),
+        padding="VALID",
+    )
+
+
+def halo_upsample2x(x: jax.Array, axis_name: str) -> jax.Array:
+    """Bilinear 2x upsample of an H-sharded NHWC tile, exact vs the
+    single-device ``jax.image.resize(..., method="bilinear")`` oracle
+    (the U-Net decoder's F.interpolate analogue, unet.py
+    _bilinear_resize).
+
+    Half-pixel sampling: output row j reads source position
+    ``j/2 - 0.25``, so rows at a shard seam read one row across it --
+    one halo row per side. At the GLOBAL edges the oracle clamps (not
+    zero-pads), so the ring-end halos are replaced with this tile's
+    own edge row before interpolating; with padded rows p the output
+    interleaves ``0.25*p[i] + 0.75*p[i+1]`` (even rows) and
+    ``0.75*p[i+1] + 0.25*p[i+2]`` (odd rows). W is unsharded: its 2x
+    resize runs locally through jax.image.resize (bilinear is
+    separable, so H-then-W equals the joint resize)."""
+    n = jax.lax.axis_size(axis_name)
+    sid = jax.lax.axis_index(axis_name)
+    b, h, w, c = x.shape
+    fwd = [(i, i + 1) for i in range(n - 1)]
+    bwd = [(i + 1, i) for i in range(n - 1)]
+    top, bot = x[:, :1], x[:, -1:]
+    from_left = jax.lax.ppermute(bot, axis_name, fwd)
+    from_right = jax.lax.ppermute(top, axis_name, bwd)
+    # Global edges: clamp == replicate own edge row.
+    from_left = jnp.where(sid == 0, top, from_left)
+    from_right = jnp.where(sid == n - 1, bot, from_right)
+    p = jnp.concatenate([from_left, x, from_right], axis=1)
+    a, mid, z = p[:, :-2], p[:, 1:-1], p[:, 2:]
+    even = 0.25 * a + 0.75 * mid
+    odd = 0.75 * mid + 0.25 * z
+    up = jnp.stack([even, odd], axis=2).reshape(b, 2 * h, w, c)
+    return jax.image.resize(
+        up, (b, 2 * h, 2 * w, c), method="bilinear"
+    ).astype(x.dtype)
 
 
 def spatial_pspec(

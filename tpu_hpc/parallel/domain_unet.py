@@ -1,0 +1,175 @@
+"""Domain-parallel SimpleUNet: the full encoder/decoder under a
+(data x spatial) mesh.
+
+The reference documents domain parallelism as a capability for exactly
+this model class (docs/guide/10_domain_parallel.md:113-149 sketches
+halo-correct convs; its U-Net, multinode_ddp_unet.py:171-214, is the
+realistic SciML shape with strided downsampling). This module runs
+``models/unet.py``'s OWN parameter and batch-stats trees through a
+spatially-sharded forward, so the single-device ``apply_unet`` is the
+bit-comparable oracle for the whole network, not just one conv:
+
+- 3x3 SAME convs -> :func:`domain.halo_conv2d` (1-row halos);
+- 2x2/s2 max pool -> :func:`domain.max_pool_2x2` (zero halo: the
+  windows tile each shard exactly);
+- bilinear 2x upsampling -> :func:`domain.halo_upsample2x` (one halo
+  row per side, edge-clamped at the global boundary);
+- BatchNorm -> batch moments psum-reduced over BOTH mesh axes (batch
+  rows live on ``data``, latitude bands on ``spatial``), so the
+  normalizer sees the same global statistics the oracle computes;
+  running stats come back replicated.
+
+Constraint: the global H must divide by spatial_size * 4 (two pool
+levels of whole windows per device). The oracle's odd-grid support
+(bilinear resize to arbitrary sizes) needs re-tiling, not halos --
+out of scope here, as in the reference's doc.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_hpc.models.resnet import BN_MOMENTUM
+from tpu_hpc.models.unet import UNetConfig
+from tpu_hpc.parallel import domain
+
+
+def _batch_norm(
+    x: jax.Array,
+    p: Dict,
+    ra: Dict,
+    train: bool,
+    axis_names: Tuple[str, ...],
+    n_global: int,
+    eps: float = 1e-5,
+    momentum: float = BN_MOMENTUM,
+):
+    """flax.linen.BatchNorm semantics on a sharded tile: biased batch
+    moments over (B, H, W) with the cross-device sums psum'd, running
+    stats updated with the same momentum convention
+    (ra = m*ra + (1-m)*batch). ``n_global`` = global B*H*W."""
+    if train:
+        s = jax.lax.psum(jnp.sum(x, axis=(0, 1, 2)), axis_names)
+        s2 = jax.lax.psum(jnp.sum(x * x, axis=(0, 1, 2)), axis_names)
+        mean = s / n_global
+        var = s2 / n_global - mean * mean
+        new_ra = {
+            "mean": momentum * ra["mean"] + (1 - momentum) * mean,
+            "var": momentum * ra["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = ra["mean"], ra["var"]
+        new_ra = ra
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return y * p["scale"] + p["bias"], new_ra
+
+
+def _conv_block(
+    axis_name: str,
+    axis_names: Tuple[str, ...],
+    p: Dict,
+    ra: Dict,
+    x: jax.Array,
+    train: bool,
+    n_global: int,
+):
+    """(halo Conv3x3 -> BN -> ReLU) x 2 -- models/unet.py ConvBlock."""
+    new_ra = {}
+    for i in range(2):
+        c = p[f"Conv_{i}"]
+        x = domain.halo_conv2d(
+            x, c["kernel"], c["bias"], axis_name=axis_name
+        )
+        x, new_ra[f"BatchNorm_{i}"] = _batch_norm(
+            x, p[f"BatchNorm_{i}"], ra[f"BatchNorm_{i}"], train,
+            axis_names, n_global,
+        )
+        x = jax.nn.relu(x)
+    return x, new_ra
+
+
+def make_domain_unet(
+    mesh: Mesh,
+    cfg: UNetConfig,
+    dp_axis: str = "data",
+    spatial_axis: str = "spatial",
+):
+    """Build ``fn(params, model_state, x, train) -> (pred, new_state)``
+    over global NHWC arrays laid out (batch=dp, H=spatial): the
+    domain-parallel twin of ``models.unet.apply_unet``, consuming the
+    same ``init_unet`` trees."""
+    axis_names = (dp_axis, spatial_axis)
+    scale = mesh.shape[dp_axis] * mesh.shape[spatial_axis]
+    spec = domain.spatial_pspec(dp_axis, spatial_axis)
+
+    def program(params, batch_stats, x, train: bool):
+        ax = spatial_axis
+        ra = batch_stats["batch_stats"]
+        x = x.astype(cfg.dtype)
+        n = scale * x.shape[0] * x.shape[1] * x.shape[2]
+        new_ra = {}
+        e1, new_ra["enc1"] = _conv_block(
+            ax, axis_names, params["enc1"], ra["enc1"], x, train, n
+        )
+        p1 = domain.max_pool_2x2(e1)
+        n2 = n // 4
+        e2, new_ra["enc2"] = _conv_block(
+            ax, axis_names, params["enc2"], ra["enc2"], p1, train, n2
+        )
+        p2 = domain.max_pool_2x2(e2)
+        n4 = n // 16
+        b, new_ra["bottleneck"] = _conv_block(
+            ax, axis_names, params["bottleneck"], ra["bottleneck"],
+            p2, train, n4,
+        )
+        u2 = domain.halo_upsample2x(b, ax)
+        d2, new_ra["dec2"] = _conv_block(
+            ax, axis_names, params["dec2"], ra["dec2"],
+            jnp.concatenate([u2, e2], axis=-1), train, n2,
+        )
+        u1 = domain.halo_upsample2x(d2, ax)
+        d1, new_ra["dec1"] = _conv_block(
+            ax, axis_names, params["dec1"], ra["dec1"],
+            jnp.concatenate([u1, e1], axis=-1), train, n,
+        )
+        h = params["head"]
+        out = domain.halo_conv2d(
+            d1, h["kernel"], h["bias"], axis_name=ax
+        )
+        return out.astype(jnp.float32), {"batch_stats": new_ra}
+
+    def apply(params, model_state, x, train: bool = True):
+        fn = jax.shard_map(
+            lambda p, s, t: program(p, s, t, train),
+            mesh=mesh,
+            in_specs=(P(), P(), spec),
+            out_specs=(spec, P()),
+            check_vma=False,
+        )
+        return fn(params, model_state, x)
+
+    return apply
+
+
+def make_forward(
+    mesh: Mesh,
+    cfg: UNetConfig,
+    dp_axis: str = "data",
+    spatial_axis: str = "spatial",
+):
+    """Trainer-contract forward: latitude-weighted MSE on (x, y)
+    batches, spatially sharded -- the domain-mesh twin of the DP UNet
+    example's forward."""
+    from tpu_hpc.models.losses import lat_weighted_mse
+
+    apply = make_domain_unet(mesh, cfg, dp_axis, spatial_axis)
+
+    def forward(params, model_state, batch, step_rng):
+        x, y = batch
+        pred, new_state = apply(params, model_state, x, train=True)
+        return lat_weighted_mse(pred, y), new_state, {}
+
+    return forward
