@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Round-5 hardware watchdog: probe the TPU tunnel every 4 minutes;
+# when it answers, drain the remaining job queue in priority order,
+# banking each job's outputs into HW_QUEUE_r05/ as it completes (so a
+# partial window still lands in the repo). Jobs already banked in the
+# 08:27-08:51 UTC window: bench_headline (121,361 tok/s/chip, 56.3%
+# MFU), bench_bk1024 (124,171, 57.6%), bench_pp_1f1b (97,573, 44.6%),
+# bench_pp_gpipe (103,088, 47.2%).
+#
+# Start:  nohup setsid bash HW_QUEUE_r05/watchdog.sh \
+#             > HW_QUEUE_r05/watchdog.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+Q=HW_QUEUE_r05
+DONEDIR="$Q/done"
+mkdir -p "$DONEDIR"
+
+probe() {
+    timeout 180 python -c "import jax; d=jax.devices(); print('PROBE_OK', len(d))" 2>/dev/null | grep -q PROBE_OK
+}
+
+run_job() { # name cmd...
+    local name="$1"; shift
+    [ -e "$DONEDIR/$name" ] && return 0
+    echo "[$(date -u +%H:%M:%S)] running $name: $*"
+    if "$@" > "$Q/$name.log" 2>&1; then
+        echo "[$(date -u +%H:%M:%S)] $name ok"
+        touch "$DONEDIR/$name"
+    else
+        echo "[$(date -u +%H:%M:%S)] $name FAILED rc=$? (will retry next window)"
+        return 1
+    fi
+}
+
+while :; do
+    if ! probe; then
+        echo "[$(date -u +%H:%M:%S)] tunnel down; sleeping 240s"
+        sleep 240
+        continue
+    fi
+    echo "[$(date -u +%H:%M:%S)] tunnel UP; draining queue"
+    export TPU_HPC_BENCH_NO_PROBE=1
+    run_job pp_stash_mb2 python bench.py --workload llama-pp \
+        --pp-schedule 1f1b --pp-backward stash --pp-microbatch-size 2
+    run_job pp_interleaved python bench.py --workload llama-pp \
+        --pp-schedule interleaved-1f1b
+    run_job convergence_tpu python \
+        examples/06_hybrid_parallelism/real_corpus_convergence.py \
+        --dim 512 --layers 8 --heads 8 --seq-len 1024 \
+        --global-batch-size 8 --epochs 5
+    run_job comm_bench_chip python -m tpu_hpc.comm.bench \
+        --output "$Q/comm_bench_chip.csv"
+    run_job digits50k_resnet python \
+        examples/02_fully_sharded_fsdp/train_resnet_fsdp.py \
+        --dataset digits50k --depth 18 --strategy ddp \
+        --global-batch-size 256 --steps-per-epoch 195 --epochs 8 \
+        --log-file "$Q/digits50k_resnet.jsonl"
+    run_job bench_all python bench.py --all --out "$Q/BENCH_EXTRA_r05.md"
+    if [ "$(ls "$DONEDIR" | wc -l)" -ge 6 ]; then
+        echo "[$(date -u +%H:%M:%S)] queue drained; exiting"
+        exit 0
+    fi
+    sleep 120
+done

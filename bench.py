@@ -653,8 +653,12 @@ def main(argv=None) -> int:
     # --grad-accum-steps is also given.
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--attn", choices=("flash", "xla"), default="flash")
+    # 512/1024 q/k tiling: the autotuner's pick (AUTOTUNE_v5e.md),
+    # confirmed end-to-end on the chip this round -- 124,171
+    # tokens/s/chip 57.6% MFU vs 121,361 56.3% at 512/512
+    # (HW_QUEUE_r05/bench_bk1024.log vs bench_headline.log).
     ap.add_argument("--block-q", type=int, default=512)
-    ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument("--block-k", type=int, default=1024)
     ap.add_argument("--block-q-bwd", type=int, default=None,
                     help="backward-kernel q tiling (default: --block-q)")
     ap.add_argument("--block-k-bwd", type=int, default=None,
