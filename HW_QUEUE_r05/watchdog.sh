@@ -55,8 +55,13 @@ while :; do
         --dataset digits50k --depth 18 --strategy ddp \
         --global-batch-size 256 --steps-per-epoch 195 --epochs 8 \
         --log-file "$Q/digits50k_resnet.jsonl"
+    run_job pp_llama_1f1b python bench.py --workload llama-pp \
+        --pp-model llama --pp-schedule 1f1b
+    run_job pp_llama_gpipe python bench.py --workload llama-pp \
+        --pp-model llama --pp-schedule gpipe
+    run_job headline_accum16 python bench.py --grad-accum-steps 16
     run_job bench_all python bench.py --all --out "$Q/BENCH_EXTRA_r05.md"
-    if [ "$(ls "$DONEDIR" | wc -l)" -ge 6 ]; then
+    if [ "$(ls "$DONEDIR" | wc -l)" -ge 9 ]; then
         echo "[$(date -u +%H:%M:%S)] queue drained; exiting"
         exit 0
     fi

@@ -1,0 +1,105 @@
+"""Domain-parallel U-Net training: the FULL encoder/decoder spatially
+sharded.
+
+The reference documents domain parallelism for exactly this model
+class (/root/reference/docs/guide/10_domain_parallel.md:113-149; its
+U-Net, multinode_ddp_unet.py:171-214, is the realistic SciML shape
+with strided downsampling). This script trains ``models/unet.py``'s
+architecture under a (data x spatial) mesh via
+``tpu_hpc.parallel.domain_unet``: 3x3 convs with 1-row halos,
+halo-free 2x2 max pools (windows tile each shard), edge-clamped
+bilinear 2x upsampling, and BatchNorm moments psum-reduced over both
+mesh axes. The single-device ``apply_unet`` is the exact oracle for
+this program (tests/test_domain_unet.py).
+
+Constraint: lat must divide by spatial * 4 (two pool levels of whole
+windows per device) -- the default grid is 32 x 64 for the 4-way
+spatial split; the production 181-row ERA5 grid belongs on the
+batch-parallel path (examples/02) or needs re-tiling.
+
+Run (8 simulated devices):
+  TPU_HPC_SIM_DEVICES=8 python train_domain_unet.py --spatial-parallel 4
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+)
+
+import argparse
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.models import datasets
+from tpu_hpc.models.unet import UNetConfig, init_unet
+from tpu_hpc.parallel import domain_unet
+from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+from tpu_hpc.train import Trainer
+
+
+def main(argv=None) -> int:
+    cfg = TrainingConfig.from_args(argv)
+    extra = argparse.ArgumentParser(add_help=False)
+    extra.add_argument("--spatial-parallel", type=int, default=0,
+                       help="latitude-band shards (default: all "
+                       "devices not taken by --data-parallel)")
+    extra.add_argument("--lat", type=int, default=32)
+    extra.add_argument("--lon", type=int, default=64)
+    extra.add_argument("--base-features", type=int, default=16)
+    own, _ = extra.parse_known_args(argv)
+
+    logger = get_logger()
+    init_distributed()
+    n = jax.device_count()
+    dp = cfg.data_parallel if cfg.data_parallel > 0 else 0
+    spatial = own.spatial_parallel
+    if not spatial:
+        spatial = n // dp if dp else max(n // 2, 1)
+    if not dp:
+        dp = n // spatial
+    if dp * spatial != n or own.lat % (spatial * 4):
+        raise SystemExit(
+            f"need data({dp}) x spatial({spatial}) == devices({n}) and "
+            f"lat({own.lat}) % (spatial*4) == 0"
+        )
+    mesh = build_mesh(MeshSpec(axes={"data": dp, "spatial": spatial}))
+    ds = datasets.ERA5Synthetic(
+        lat=own.lat, lon=own.lon, n_vars=1, n_levels=3
+    )
+    param_dtype, compute_dtype = cfg.jax_dtypes()
+    model_cfg = UNetConfig(
+        in_channels=ds.channels, out_channels=ds.channels,
+        base_features=own.base_features,
+        dtype=compute_dtype, param_dtype=param_dtype,
+    )
+    params, model_state = init_unet(
+        jax.random.key(cfg.seed), model_cfg, ds.sample_shape
+    )
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    logger.info(
+        "domain U-Net: %.2fM params | mesh %s | tile %dx%d of %dx%d",
+        n_params / 1e6, dict(mesh.shape),
+        own.lat // spatial, own.lon, own.lat, own.lon,
+    )
+    trainer = Trainer(
+        cfg, mesh,
+        domain_unet.make_forward(mesh, model_cfg),
+        params, model_state,
+        batch_pspec=P("data", "spatial"),
+    )
+    result = trainer.fit(ds)
+    summary = result["epochs"][-1]
+    logger.info(
+        "run summary | final loss %.5f | %.1f samples/s global",
+        result["final_loss"], summary["items_per_s"],
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

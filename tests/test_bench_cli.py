@@ -97,3 +97,8 @@ def test_pp_accum_divisibility_validated(bench):
         bench.bench_llama_pp(grad_accum_steps=3, microbatch_size=4)
     with pytest.raises(ValueError, match="must divide"):
         bench.bench_llama_pp(grad_accum_steps=8, microbatch_size=4)
+
+
+def test_pp_model_llama_validation(bench):
+    with pytest.raises(ValueError, match="stack|llama"):
+        bench.bench_llama_pp(model="no-such-model")

@@ -84,6 +84,39 @@ def merge_params(split: Dict, cfg: LlamaConfig) -> Dict:
     return out
 
 
+def split_params_interleaved(
+    params: Dict, cfg: LlamaConfig, n_devices: int, n_chunks: int
+) -> Dict:
+    """Like :func:`split_params` but in the Megatron virtual-pipeline
+    layout for the interleaved schedules: ``n_devices * n_chunks``
+    global stages of ``n_layers/(S*v)`` layers each, stacked so device
+    s holds chunks {s, S+s, 2S+s, ...} (pp.stack_interleaved_stage_
+    params' round-robin placement). Pair with
+    ``make_forward(schedule="interleaved"/"interleaved-1f1b",
+    n_chunks=v)``."""
+    split = split_params(params, cfg, n_devices * n_chunks)
+    return {
+        "edges": split["edges"],
+        "stages": pp.interleave_stacked(split["stages"], n_devices),
+    }
+
+
+def merge_params_interleaved(
+    split: Dict, cfg: LlamaConfig, n_devices: int, n_chunks: int
+) -> Dict:
+    """Exact inverse of :func:`split_params_interleaved` -- undo the
+    round-robin placement, then the sequential split."""
+    import numpy as np
+
+    S, V = n_devices, n_chunks
+    order = [j * S + s for s in range(S) for j in range(V)]
+    inv = np.argsort(order)
+    stages = jax.tree.map(lambda a: a[inv], split["stages"])
+    return merge_params(
+        {"edges": split["edges"], "stages": stages}, cfg
+    )
+
+
 def make_stage_fn(
     cfg: LlamaConfig,
     n_stages: int,
@@ -154,6 +187,8 @@ def make_forward(
     batch_spec: P = P(),
     attn_fn: AttnFn = None,
     positions: Optional[jax.Array] = None,
+    remat_stage: bool = False,
+    n_chunks: int = 1,
 ):
     """Trainer-contract forward for pipelined Llama training: embed ->
     pipelined stage body -> head -> next-token cross-entropy, with the
@@ -161,13 +196,19 @@ def make_forward(
     ``batch_spec`` shards the microbatch rows (e.g. P(None, "data")
     for the PP x DP composition); the pipe axis itself never appears
     in it -- activations are replicated over stages by construction.
+    ``remat_stage`` wraps the stage in jax.checkpoint on the autodiff
+    schedules -- see pp.pipelined. ``n_chunks`` > 1 selects the
+    Megatron virtual-pipeline placement (stack the params with
+    :func:`split_params_interleaved`; interleaved schedules only).
     """
     from tpu_hpc.models.losses import cross_entropy
 
     S = mesh.shape[axis]
     pipe = pp.pipelined(
-        make_stage_fn(cfg, S, attn_fn, positions), mesh, axis=axis,
+        make_stage_fn(cfg, S * n_chunks, attn_fn, positions),
+        mesh, axis=axis,
         schedule=schedule, batch_spec=batch_spec, backward=backward,
+        remat_stage=remat_stage, n_chunks=n_chunks,
     )
 
     def forward(params, model_state, batch, step_rng):

@@ -64,7 +64,9 @@ def _batch_norm(
         mean, var = ra["mean"], ra["var"]
         new_ra = ra
     y = (x - mean) * jax.lax.rsqrt(var + eps)
-    return y * p["scale"] + p["bias"], new_ra
+    # flax BatchNorm(dtype=...) emits the compute dtype; the fp32
+    # scale/bias promotion must not leak fp32 into the next conv.
+    return (y * p["scale"] + p["bias"]).astype(x.dtype), new_ra
 
 
 def _conv_block(
@@ -80,8 +82,11 @@ def _conv_block(
     new_ra = {}
     for i in range(2):
         c = p[f"Conv_{i}"]
+        # flax.linen.Conv promotes kernel/bias to the compute dtype;
+        # the direct lax.conv path must do the same cast.
         x = domain.halo_conv2d(
-            x, c["kernel"], c["bias"], axis_name=axis_name
+            x, c["kernel"].astype(x.dtype), c["bias"].astype(x.dtype),
+            axis_name=axis_name,
         )
         x, new_ra[f"BatchNorm_{i}"] = _batch_norm(
             x, p[f"BatchNorm_{i}"], ra[f"BatchNorm_{i}"], train,
@@ -137,7 +142,8 @@ def make_domain_unet(
         )
         h = params["head"]
         out = domain.halo_conv2d(
-            d1, h["kernel"], h["bias"], axis_name=ax
+            d1, h["kernel"].astype(d1.dtype), h["bias"].astype(d1.dtype),
+            axis_name=ax,
         )
         return out.astype(jnp.float32), {"batch_stats": new_ra}
 
