@@ -45,9 +45,16 @@ def main(argv=None) -> int:
     cfg = TrainingConfig.from_args(argv)
     extra = argparse.ArgumentParser(add_help=False)
     extra.add_argument(
-        "--schedule", choices=["gpipe", "1f1b"], default="1f1b",
+        "--schedule",
+        choices=["gpipe", "1f1b", "interleaved", "interleaved-1f1b"],
+        default="1f1b",
     )
     extra.add_argument("--num-microbatches", type=int, default=8)
+    extra.add_argument(
+        "--num-chunks", type=int, default=2,
+        help="virtual stage chunks per device (interleaved schedules "
+        "only): Megatron round-robin placement, bubble / num-chunks",
+    )
     extra.add_argument(
         "--pp-backward", choices=["remat", "stash"], default="remat",
         help="1f1b backward: remat recomputes each stage forward "
@@ -64,16 +71,20 @@ def main(argv=None) -> int:
     mesh = build_mesh(MeshSpec(axes=cfg.mesh_axes()))
     n_stages = mesh.shape.get("pipe", 1)
     M = args.num_microbatches
+    interleaved = args.schedule in ("interleaved", "interleaved-1f1b")
+    v = args.num_chunks if interleaved and n_stages > 1 else 1
     logger.info(
-        "mesh: %s | llama-2 over %d stages | schedule %s | "
+        "mesh: %s | llama-2 over %d stages%s | schedule %s | "
         "%d microbatches | bubble %.1f%%",
-        dict(mesh.shape), n_stages, args.schedule, M,
-        100 * pp.bubble_fraction(max(n_stages, 1), M),
+        dict(mesh.shape), n_stages,
+        f" x {v} chunks" if v > 1 else "",
+        args.schedule, M,
+        100 * pp.bubble_fraction(max(n_stages, 1), M, n_chunks=v),
     )
 
     param_dtype, compute_dtype = cfg.jax_dtypes()
     model_cfg = llama2.LlamaConfig(
-        dim=256, n_layers=max(2 * n_stages, 2), n_heads=8,
+        dim=256, n_layers=max(2 * n_stages * v, 2), n_heads=8,
         vocab_size=4096, multiple_of=64, max_seq_len=256,
         dtype=compute_dtype, param_dtype=param_dtype,
     )
@@ -82,11 +93,17 @@ def main(argv=None) -> int:
     dp_size = mesh.shape.get("data", 1)
     batch_spec = P(None, "data") if dp_size > 1 else P()
     if n_stages > 1:
-        split = llama_pp.split_params(params, model_cfg, n_stages)
+        split = (
+            llama_pp.split_params_interleaved(
+                params, model_cfg, n_stages, v
+            )
+            if v > 1 else
+            llama_pp.split_params(params, model_cfg, n_stages)
+        )
         forward = llama_pp.make_forward(
             model_cfg, mesh, n_microbatches=M,
             schedule=args.schedule, backward=args.pp_backward,
-            batch_spec=batch_spec,
+            batch_spec=batch_spec, n_chunks=v,
         )
         train_params = split
         specs = llama_pp.pp_pspecs(split)
@@ -112,7 +129,8 @@ def main(argv=None) -> int:
         "%d-layer llama over %d stages (%s%s)",
         result["final_loss"], tokens_per_s, model_cfg.n_layers, n_stages,
         args.schedule,
-        f"-{args.pp_backward}" if args.schedule == "1f1b" else "",
+        f"-{args.pp_backward}"
+        if args.schedule in ("1f1b", "interleaved-1f1b") else "",
     )
     return 0
 

@@ -102,3 +102,18 @@ def test_pp_accum_divisibility_validated(bench):
 def test_pp_model_llama_validation(bench):
     with pytest.raises(ValueError, match="stack|llama"):
         bench.bench_llama_pp(model="no-such-model")
+
+
+def test_bench_model_cfg_is_single_source(bench):
+    # The comparability claim of the flagship pp row rests on every
+    # llama-family workload building THE same architecture from one
+    # factory; a second hardcoded config literal would let them drift.
+    cfg = bench.bench_model_cfg()
+    assert (cfg.dim, cfg.n_layers, cfg.n_heads, cfg.vocab_size) == (
+        1024, 8, 8, 32000
+    )
+    assert bench.bench_model_cfg(seq_len=8192).max_seq_len == 8192
+    import pathlib
+    src = pathlib.Path(bench.__file__).read_text()
+    # Exactly one dim=1024 Llama literal: the factory's own.
+    assert src.count("dim=1024, n_layers=8") == 1
