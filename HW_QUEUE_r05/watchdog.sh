@@ -59,9 +59,11 @@ while :; do
         --pp-model llama --pp-schedule 1f1b
     run_job pp_llama_gpipe python bench.py --workload llama-pp \
         --pp-model llama --pp-schedule gpipe
+    run_job pp_llama_stash python bench.py --workload llama-pp \
+        --pp-model llama --pp-schedule 1f1b --pp-backward stash
     run_job headline_accum16 python bench.py --grad-accum-steps 16
     run_job bench_all python bench.py --all --out "$Q/BENCH_EXTRA_r05.md"
-    if [ "$(ls "$DONEDIR" | wc -l)" -ge 9 ]; then
+    if [ "$(ls "$DONEDIR" | wc -l)" -ge 10 ]; then
         echo "[$(date -u +%H:%M:%S)] queue drained; exiting"
         exit 0
     fi

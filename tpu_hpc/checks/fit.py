@@ -961,6 +961,14 @@ def main(argv=None) -> int:
     parser.add_argument("--hbm-gib", type=float, default=32.0)
     parser.add_argument("--layers", type=int, default=None,
                         help="override n_layers (default: 7B's 32)")
+    parser.add_argument("--dim", type=int, default=None,
+                        help="override model dim (with --heads/"
+                        "--vocab, analyzes arbitrary architectures, "
+                        "e.g. the bench model: --dim 1024 --layers 8 "
+                        "--heads 8)")
+    parser.add_argument("--heads", type=int, default=None)
+    parser.add_argument("--kv-heads", type=int, default=None)
+    parser.add_argument("--vocab", type=int, default=None)
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="analyze the N-way accumulated step")
     parser.add_argument("--model", type=str, default=None,
@@ -1037,8 +1045,15 @@ def main(argv=None) -> int:
         )
     else:
         cfg = llama2.LlamaConfig(max_seq_len=args.seq_len, remat=True)
-    if args.layers is not None:
-        cfg = dataclasses.replace(cfg, n_layers=args.layers)
+    overrides = {
+        k: v for k, v in (
+            ("n_layers", args.layers), ("dim", args.dim),
+            ("n_heads", args.heads), ("n_kv_heads", args.kv_heads),
+            ("vocab_size", args.vocab),
+        ) if v is not None
+    }
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
     r = analyze(
         cfg=cfg, dp=args.dp, tp_size=args.pp or args.cp or args.tp,
         global_batch=args.global_batch, seq_len=args.seq_len,

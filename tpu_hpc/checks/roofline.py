@@ -459,6 +459,12 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--model", choices=sorted(llama2.PRESETS), default=None)
     p.add_argument("--layers", type=int, default=None)
+    p.add_argument("--dim", type=int, default=None,
+                   help="override model dim (with --heads/--vocab, "
+                   "bounds arbitrary architectures)")
+    p.add_argument("--heads", type=int, default=None)
+    p.add_argument("--kv-heads", type=int, default=None)
+    p.add_argument("--vocab", type=int, default=None)
     p.add_argument("--chip", choices=sorted(CHIPS), default="v5e")
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
@@ -500,8 +506,15 @@ def main(argv=None) -> int:
     )
     if args.seq_len:
         cfg = dc.replace(cfg, max_seq_len=args.seq_len)
-    if args.layers:
-        cfg = dc.replace(cfg, n_layers=args.layers)
+    overrides = {
+        k: v for k, v in (
+            ("n_layers", args.layers), ("dim", args.dim),
+            ("n_heads", args.heads), ("n_kv_heads", args.kv_heads),
+            ("vocab_size", args.vocab),
+        ) if v is not None
+    }
+    if overrides:
+        cfg = dc.replace(cfg, **overrides)
     chip = (
         measured_chip_spec(CHIPS[args.chip]) if args.measured
         else args.chip
