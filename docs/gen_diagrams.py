@@ -713,6 +713,42 @@ def parallelism_modes():
     _save(fig, "parallelism_modes.svg")
 
 
+def pp_measured_rows():
+    """Round-5 measured single-chip pipeline rows vs the DP headline
+    (one measure -> one hue; identity lives in the row labels)."""
+    rows = [
+        ("DP headline (512/1024 tiling)", 124.2),
+        ("DP headline (512/512)", 121.4),
+        ("GPipe + remat_stage", 103.1),
+        ("1F1B (remat backward)", 97.6),
+    ]
+    bound = 121.4 * 3 / 4  # the naive 4/3-FLOPs remat bound
+    fig, ax = plt.subplots(figsize=(6.4, 2.4))
+    names = [r[0] for r in rows][::-1]
+    vals = [r[1] for r in rows][::-1]
+    bars = ax.barh(names, vals, height=0.62, color="#0072B2",
+                   edgecolor="none")
+    for b, v in zip(bars, vals):
+        ax.text(v + 1.5, b.get_y() + b.get_height() / 2,
+                f"{v:.1f}k", va="center", fontsize=8.5,
+                color="#333333")
+    ax.axvline(bound, color="#999999", lw=1.2, ls="--")
+    ax.text(bound - 1.5, 3.45, "4/3-FLOPs bound (91.0k)",
+            ha="right", fontsize=7.5, color="#666666")
+    ax.set_xlim(0, 140)
+    ax.set_xlabel("measured tokens/s/chip (thousands, v5e single chip)")
+    ax.set_title(
+        "Pipeline schedules vs the data-parallel headline (round 5)",
+        fontsize=9.5,
+    )
+    for s in ("top", "right", "left"):
+        ax.spines[s].set_visible(False)
+    ax.tick_params(left=False)
+    ax.xaxis.grid(True, color="#e6e6e6", lw=0.7)
+    ax.set_axisbelow(True)
+    _save(fig, "pp_measured_rows.svg")
+
+
 if __name__ == "__main__":
     pipeline_schedules()
     mesh_torus()
@@ -726,3 +762,4 @@ if __name__ == "__main__":
     multislice_mesh()
     hbm_memory()
     parallelism_modes()
+    pp_measured_rows()
