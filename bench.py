@@ -58,6 +58,23 @@ def resolve_batch_accum(batch, accum, microbatch: int):
     return batch, 1 if accum is None else accum
 
 
+def flash_blocks_record(attn, block_q, block_k, block_q_bwd, block_k_bwd):
+    """The effective flash-attention tiling as artifact fields, bwd
+    defaults resolved -- so a JSON row always says which kernel shape
+    produced it (the CLI and function defaults drifted once, ADVICE
+    r5; now every artifact is self-describing)."""
+    if attn != "flash":
+        return {}
+    return {
+        "flash_blocks": {
+            "q": block_q,
+            "k": block_k,
+            "q_bwd": block_q_bwd if block_q_bwd is not None else block_q,
+            "k_bwd": block_k_bwd if block_k_bwd is not None else block_k,
+        }
+    }
+
+
 def bench_model_cfg(seq_len: int = 2048, remat: bool = False):
     """THE bench architecture: the ~170M-param Llama every llama-family
     workload runs, sized to single-chip v5e HBM. One factory so the
@@ -73,7 +90,7 @@ def bench_model_cfg(seq_len: int = 2048, remat: bool = False):
 
 def bench_llama(
     steps: int = 20, remat: bool = False, batch_per_dp: int = 4,
-    attn: str = "flash", block_q: int = 512, block_k: int = 512,
+    attn: str = "flash", block_q: int = 512, block_k: int = 1024,
     seq_len: int = 2048, grad_accum_steps: int = 1,
     moments_dtype: str = "float32",
     block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
@@ -82,8 +99,12 @@ def bench_llama(
     default (the *function* defaults are the unaccumulated round-2
     config; main() resolves the CLI policy via resolve_batch_accum):
     no remat (model fits HBM comfortably; remat costs ~14%), Pallas
-    flash attention with 512/512 blocks (+8 MFU points over the XLA
-    einsum path; 1024 or 256 blocks each cost ~0.6-2.5 points),
+    flash attention with 512/1024 q/k blocks (+8 MFU points over the
+    XLA einsum path; the round-5 hardware confirmation moved block_k
+    512 -> 1024: 124,171 tokens/s/chip 57.6% MFU vs 121,361 56.3% --
+    HW_QUEUE_r05/bench_bk1024.log -- and the function default now
+    matches the CLI so both entry points measure the same tiling;
+    every record also carries the effective blocks),
     microbatch 4 (microbatch 8 loses ~6 points to memory pressure, 2
     ~3 to underfill), and grad-accum 8 over a batch of 32 --
     amortizing the fp32 AdamW state traffic (~6 ms/update) across 8x
@@ -179,6 +200,12 @@ def bench_llama(
         # Reference publishes no measured numbers (BASELINE.md);
         # compare against its stated >=40%-MFU target instead.
         "vs_baseline": round(mfu / 0.40, 3),
+        # Effective attention config: rows from the CLI and from
+        # programmatic callers must be distinguishable (ADVICE r5).
+        "attn": attn,
+        **flash_blocks_record(
+            attn, block_q, block_k, block_q_bwd, block_k_bwd
+        ),
     }
 
 
@@ -271,7 +298,7 @@ def bench_llama_long(
     steps: int = 20, seq_len: int = 8192, batch: int = 1,
     remat: bool = False, grad_accum_steps: int = 1,
     moments_dtype: str = "float32",
-    block_q: int = 512, block_k: int = 512,
+    block_q: int = 512, block_k: int = 1024,
     block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
 ) -> dict:
     """Long-context Llama: seq 8192 (4x the headline bench) -- the
@@ -297,7 +324,7 @@ def bench_llama_long(
 def bench_llama_pp(
     steps: int = 20, schedule: str = "1f1b", microbatches: int = 8,
     microbatch_size: int = 4, attn: str = "flash",
-    block_q: int = 512, block_k: int = 512,
+    block_q: int = 512, block_k: int = 1024,
     block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
     grad_accum_steps: int = 1, backward: str = "remat",
     remat_stage: "bool | None" = None,
@@ -490,6 +517,10 @@ def bench_llama_pp(
         # present a duplicate of the 1f1b row as interleaved evidence.
         "n_chunks": v,
         "bubble_fraction": round(bubble, 4),
+        "attn": attn,
+        **flash_blocks_record(
+            attn, block_q, block_k, block_q_bwd, block_k_bwd
+        ),
     }
 
 
@@ -685,7 +716,10 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser()
+    # allow_abbrev=False: --supervise is stripped from argv by exact
+    # name before re-exec; an accepted abbreviation ("--superv 2")
+    # would survive the strip and recurse supervisors forever.
+    ap = argparse.ArgumentParser(allow_abbrev=False)
     ap.add_argument(
         "--workload",
         choices=("llama", "llama-sp", "llama-pp", "llama-long", "unet"),
@@ -705,9 +739,11 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--attn", choices=("flash", "xla"), default="flash")
     # 512/1024 q/k tiling: the autotuner's pick (AUTOTUNE_v5e.md),
-    # confirmed end-to-end on the chip this round -- 124,171
+    # confirmed end-to-end on the chip in round 5 -- 124,171
     # tokens/s/chip 57.6% MFU vs 121,361 56.3% at 512/512
-    # (HW_QUEUE_r05/bench_bk1024.log vs bench_headline.log).
+    # (HW_QUEUE_r05/bench_bk1024.log vs bench_headline.log). The
+    # bench_* function defaults MATCH these (reconciled, ADVICE r5),
+    # and every record carries its effective flash_blocks.
     ap.add_argument("--block-q", type=int, default=512)
     ap.add_argument("--block-k", type=int, default=1024)
     ap.add_argument("--block-q-bwd", type=int, default=None,
@@ -760,7 +796,37 @@ def main(argv=None) -> int:
         help="AdamW moment storage dtype (bfloat16 halves optimizer-"
         "state HBM bytes read+written per step)",
     )
+    ap.add_argument(
+        "--supervise", type=int, default=0, metavar="N",
+        help="re-launch this bench under the resilience supervisor "
+        "with N bounded restarts (attempt-unique logs in "
+        "bench_logs/; a preempted/crashed run restarts instead of "
+        "losing the allocation -- the shell-watchdog replacement)",
+    )
     args = ap.parse_args(argv)
+    if args.supervise:
+        from tpu_hpc.resilience.supervisor import run_supervised
+
+        raw = list(sys.argv[1:] if argv is None else argv)
+        # Strip the flag (both "--supervise N" and "--supervise=N"):
+        # the supervised child must run the bench itself.
+        child_args = []
+        skip = False
+        for a in raw:
+            if skip:
+                skip = False
+                continue
+            if a == "--supervise":
+                skip = True
+                continue
+            if a.startswith("--supervise="):
+                continue
+            child_args.append(a)
+        return run_supervised(
+            [sys.executable, os.path.abspath(__file__), *child_args],
+            max_restarts=args.supervise,
+            log_dir=os.environ.get("TPU_HPC_SUPERVISE_LOGS", "bench_logs"),
+        )
     devinfo = None
     if os.environ.get("TPU_HPC_BENCH_NO_PROBE") != "1":
         # Children of --all skip this: the parent already probed, and

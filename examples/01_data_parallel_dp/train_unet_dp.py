@@ -89,7 +89,12 @@ def main(argv=None) -> int:
         summary["items_per_s"],
         summary["items_per_s_per_device"],
     )
-    return 0
+    # Exit-code contract (docs/guide/resilience.md): a preemption
+    # snapshot exits EXIT_RESUMABLE so the supervisor/launcher knows
+    # to relaunch-and-resume rather than count a failure.
+    from tpu_hpc.resilience import exit_code_for
+
+    return exit_code_for(result.get("preempted", False))
 
 
 if __name__ == "__main__":

@@ -91,7 +91,11 @@ def main(argv=None) -> int:
         result["final_loss"], summary["items_per_s"],
         summary["items_per_s_per_device"], test_metrics["loss"],
     )
-    return 0
+    # Exit-code contract (docs/guide/resilience.md): resumable
+    # preemption snapshots are distinguishable from success/failure.
+    from tpu_hpc.resilience import exit_code_for
+
+    return exit_code_for(result.get("preempted", False))
 
 
 if __name__ == "__main__":

@@ -33,8 +33,53 @@ for ((i = 0; i < NPROC; i++)); do
         "${PY}" "${SCRIPT}" "$@" &
     pids+=($!)
 done
+
+# Fail fast (torchrun process-group semantics): the moment ANY rank
+# exits nonzero, kill the survivors instead of letting them block on
+# the JAX coordinator's connection timeout. `wait -n` reaps ranks in
+# completion order; the final plain `wait` reaps the killed ones.
+kill_survivors() {
+    for pid in "${pids[@]}"; do
+        kill "${pid}" 2>/dev/null || true
+    done
+}
+# Forwarded preemption (the supervisor sends TERM here): pass the
+# signal to the ranks, then propagate THEIR verdict -- if any rank
+# took its snapshot and exited 75 (EXIT_RESUMABLE), this launcher
+# reports 75 too, keeping the resumable contract intact through the
+# process-group layer. A blanket exit 130 would relabel a clean
+# preemption as a crash.
+on_signal() {
+    trap - INT TERM
+    kill_survivors
+    local final=0 code
+    for pid in "${pids[@]}"; do
+        code=0
+        wait "${pid}" 2>/dev/null || code=$?
+        # 127 = already reaped by the main loop's `wait -n` (its exit
+        # code was folded in there); not a rank verdict, skip it.
+        if ((code == 127)); then
+            continue
+        fi
+        if ((code == 75)); then
+            final=75
+        elif ((code != 0 && final != 75)); then
+            final="${code}"
+        fi
+    done
+    exit "${final}"
+}
+trap on_signal INT TERM
 rc=0
-for pid in "${pids[@]}"; do
-    wait "${pid}" || rc=$?
+for ((n = 0; n < NPROC; n++)); do
+    code=0
+    wait -n || code=$?
+    if ((code != 0)); then
+        rc="${code}"
+        echo "local_multiprocess: a rank exited rc=${rc}; killing survivors" >&2
+        kill_survivors
+        break
+    fi
 done
+wait || true
 exit "${rc}"

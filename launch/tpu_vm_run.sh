@@ -22,6 +22,12 @@ LOG_DIR="${LOG_DIR:-}"
 # (torchrun_multigpu_ddp.sh:59-76). "default" = no flags; see
 # tpu_hpc/runtime/tuning.py for profiles.
 TUNING="${TUNING:-collective-overlap}"
+# SUPERVISE=N runs the remote program under the in-framework run
+# supervisor (tpu_hpc.resilience.supervisor) with N bounded
+# restarts-with-resume per worker -- preempted/crashed runs relaunch
+# themselves and auto-resume from the newest checkpoint, replacing
+# the ad-hoc shell watchdog pattern. 0 (default) = run bare.
+SUPERVISE="${SUPERVISE:-0}"
 
 SCRIPT="${1:?usage: tpu_vm_run.sh <script.py> [args...]}"
 shift || true
@@ -46,6 +52,16 @@ if [[ -n "${LOG_DIR}" ]]; then
     REDIRECT="mkdir -p ~/tpu_hpc_logs && exec > >(tee ~/tpu_hpc_logs/\$(hostname).out) 2>&1;"
 fi
 
+# The runnable leg: bare, or wrapped in the bounded-restart
+# supervisor (attempt logs + heartbeat land next to the worker logs).
+RUNNER="python ${SCRIPT} ${ARGS}"
+if [[ "${SUPERVISE}" != "0" ]]; then
+    RUNNER="python -m tpu_hpc.resilience.supervisor \
+--max-restarts ${SUPERVISE} --log-dir ~/tpu_hpc_logs/supervisor \
+--heartbeat ~/tpu_hpc_logs/supervisor/heartbeat.json \
+-- python ${SCRIPT} ${ARGS}"
+fi
+
 echo ">> launching ${SCRIPT} ${ARGS} on all workers of ${TPU_NAME}"
 "${GCLOUD}" compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
     --command "
@@ -55,7 +71,7 @@ echo ">> launching ${SCRIPT} ${ARGS} on all workers of ${TPU_NAME}"
         cd ~/tpu_hpc_repo
         TUNING_VARS=\"\$(python -m tpu_hpc.runtime.tuning --profile ${TUNING} --shell)\"
         eval \"\${TUNING_VARS}\"
-        python ${SCRIPT} ${ARGS}
+        ${RUNNER}
     "
 
 if [[ -n "${LOG_DIR}" ]]; then

@@ -28,6 +28,104 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+# fast/slow split (VERDICT item 8): the tier-1 core must stay under
+# ~10 minutes on a 2-core CPU host so it runs on every change; the
+# full suite (no -m filter) is the round gate. This is the measured
+# slowlist -- every entry's wall time (comment) comes from a full
+# --durations=0 run on the CI-class container; together they cut the
+# suite from ~32 min to ~10 min while the core keeps at least one
+# cheap test on every subsystem. Durable coverage note: everything
+# here still runs in the unfiltered suite.
+SLOW_NODEIDS = frozenset(nodeid for nodeid, _ in [
+    ("tests/test_autotune.py::test_bwd_tiling_is_numerics_invariant", "10s"),
+    ("tests/test_ckpt.py::test_auto_resume_continues_from_step", "14s"),
+    ("tests/test_ckpt.py::test_elastic_restore_onto_smaller_mesh", "13s"),
+    ("tests/test_ckpt.py::test_mid_epoch_resume_stream_alignment", "19s"),
+    ("tests/test_ckpt.py::test_restore_fp32_checkpoint_into_bf16_moments_run", "8s"),
+    ("tests/test_ckpt.py::test_save_restore_roundtrip", "18s"),
+    ("tests/test_doctor.py::TestAccumEscalation::test_accum_raised_until_fit", "27s"),
+    ("tests/test_doctor.py::TestCandidates::test_cp_only_with_long_context", "48s"),
+    ("tests/test_doctor.py::TestCandidates::test_gqa_head_divisibility", "58s"),
+    ("tests/test_doctor.py::TestCandidates::test_meshes_are_legal", "17s"),
+    ("tests/test_doctor.py::TestOutput::test_json_mode", "12s"),
+    ("tests/test_doctor.py::TestOutput::test_no_fit_verdict", "87s"),
+    ("tests/test_doctor.py::TestOutput::test_tight_marker", "13s"),
+    ("tests/test_doctor.py::TestRanking::test_fitting_plans_rank_above_nonfitting", "74s"),
+    ("tests/test_doctor.py::TestSlices::test_markdown_names_slices", "14s"),
+    ("tests/test_doctor.py::TestSlices::test_slices_filter_and_dcn_cost", "14s"),
+    ("tests/test_domain_unet.py::TestDomainUNet::test_param_grads_match", "11s"),
+    ("tests/test_domain_unet.py::TestDomainUNet::test_train_forward_and_stats", "12s"),
+    ("tests/test_eval.py::test_evaluate_returns_loss_and_accuracy", "105s"),
+    ("tests/test_eval.py::test_fit_with_eval_dataset_records_curve", "48s"),
+    ("tests/test_fit.py::TestCPLayout::test_cp_step_compiles_on_sim_mesh", "16s"),
+    ("tests/test_fit.py::test_model_presets", "10s"),
+    ("tests/test_fit.py::test_sizing_table_rows_fit", "15s"),
+    ("tests/test_fsdp_modes.py::TestHybridShard::test_matches_dp_numerics", "11s"),
+    ("tests/test_fsdp_modes.py::TestShardGradOp::test_matches_full_shard_numerics", "13s"),
+    ("tests/test_grad_clip.py::TestClipTraining::test_trains_and_is_accum_invariant", "10s"),
+    ("tests/test_graft_entry.py::test_dryrun_multichip_in_process", "54s"),
+    ("tests/test_graft_entry.py::test_dryrun_multichip_subprocess_path", "68s"),
+    ("tests/test_pp.py::TestInterleaved::test_grads_match_oracle[interleaved-1f1b]", "13s"),
+    ("tests/test_pp.py::TestInterleaved::test_grads_match_oracle[interleaved]", "15s"),
+    ("tests/test_pp.py::TestInterleaved::test_indivisible_microbatches_still_correct[interleaved-1f1b]", "19s"),
+    ("tests/test_pp.py::TestInterleaved::test_indivisible_microbatches_still_correct[interleaved]", "20s"),
+    ("tests/test_pp.py::TestInterleaved::test_interleaved_1f1b_stash_grads_match_oracle", "15s"),
+    ("tests/test_pp.py::TestInterleaved::test_interleaved_stash_wraparound_and_partial_group", "20s"),
+    ("tests/test_pp.py::TestInterleaved::test_ppxdp_grads_match_oracle[interleaved-1f1b]", "10s"),
+    ("tests/test_pp.py::TestInterleaved::test_ppxdp_grads_match_oracle[interleaved]", "15s"),
+    ("tests/test_pp.py::TestStashBackward::test_grads_match_oracle", "12s"),
+    ("tests/test_pp.py::TestStashBackward::test_ppxdp_grads_match_oracle", "13s"),
+    ("tests/test_pp.py::TestStashBackward::test_stash_ring_wraparound", "9s"),
+    ("tests/test_pp.py::test_grads_match_oracle[1f1b]", "10s"),
+    ("tests/test_precision.py::test_trainer_preserves_param_dtype_through_updates", "31s"),
+    ("tests/test_precision.py::test_unet_vit_param_dtype_follows_config", "10s"),
+    ("tests/test_profiling.py::test_window_triggering", "14s"),
+    ("tests/test_resnet.py::test_forward_shape[50]", "14s"),
+    ("tests/test_resnet.py::test_fsdp_training_step", "60s"),
+    ("tests/test_run_metrics.py::TestMetricsLog::test_appends_across_runs", "13s"),
+    ("tests/test_runtime.py::TestHybridMesh::test_end_to_end_train_step_over_two_slices", "12s"),
+    ("tests/test_sp.py::TestFSDPWithRing::test_fsdp_cp_trainer_bitexact_vs_replicated", "29s"),
+    ("tests/test_sp.py::TestZigzagDataLayout::test_loss_and_grads_match_contiguous", "30s"),
+    ("tests/test_train_dp.py::TestDPTraining::test_loss_decreases", "20s"),
+    ("tests/test_train_dp.py::TestDPTraining::test_params_replicated", "9s"),
+    ("tests/test_train_dp.py::TestFSDPTraining::test_fsdp_training_matches_dp", "20s"),
+    ("tests/test_vision.py::TestBatchNormEvalRegression::test_eval_mode_tracks_train_mode", "68s"),
+])
+
+
+def pytest_collection_modifyitems(config, items):
+    """fast/slow split: measured-heavy tests get the ``slow`` marker
+    centrally (SLOW_NODEIDS above); everything else IS the fast core,
+    marked so ``-m fast`` and ``-m 'not slow'`` select the same
+    suite -- one partition, no test left in neither tier."""
+    for item in items:
+        if item.nodeid in SLOW_NODEIDS:
+            item.add_marker(pytest.mark.slow)
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.fast)
+    # Self-maintenance: a renamed/re-parametrized slow test must not
+    # silently drop into the fast tier. Checked per collected file so
+    # single-file runs stay valid; skipped entirely for nodeid-level
+    # selections or --deselect, where partial collection of a file is
+    # expected (a single-test dev run must not abort on the file's
+    # OTHER slowlist entries).
+    if any("::" in a for a in config.args) or config.getoption(
+        "deselect", None
+    ):
+        return
+    present_files = {item.nodeid.split("::", 1)[0] for item in items}
+    seen = {item.nodeid for item in items}
+    stale = sorted(
+        n for n in SLOW_NODEIDS
+        if n.split("::", 1)[0] in present_files and n not in seen
+    )
+    if stale:
+        raise pytest.UsageError(
+            "conftest SLOW_NODEIDS entries match no collected test "
+            f"(renamed? re-parametrized?): {stale}"
+        )
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -117,3 +117,39 @@ def test_bench_model_cfg_is_single_source(bench):
     src = pathlib.Path(bench.__file__).read_text()
     # Exactly one dim=1024 Llama literal: the factory's own.
     assert src.count("dim=1024, n_layers=8") == 1
+
+
+def test_block_defaults_reconciled_cli_vs_functions(bench):
+    """The CLI's --block-q/--block-k defaults and the bench_* function
+    defaults must agree (they drifted in round 5: CLI 1024 vs function
+    512, so the two entry points silently measured different flash
+    tilings -- ADVICE r5). Pinned via introspection so the next retune
+    must move both."""
+    import inspect
+
+    ap_defaults = {}
+    for fn_name in ("bench_llama", "bench_llama_long", "bench_llama_pp"):
+        sig = inspect.signature(getattr(bench, fn_name))
+        ap_defaults[fn_name] = (
+            sig.parameters["block_q"].default,
+            sig.parameters["block_k"].default,
+        )
+    assert set(ap_defaults.values()) == {(512, 1024)}, ap_defaults
+    src = pathlib.Path(bench.__file__).read_text()
+    assert '"--block-q", type=int, default=512' in src
+    assert '"--block-k", type=int, default=1024' in src
+
+
+def test_records_carry_effective_flash_blocks(bench):
+    """Every flash-attention artifact row must be self-describing
+    about its tiling, with bwd defaults resolved; xla rows carry no
+    block fields (there is no tiling to describe)."""
+    rec = bench.flash_blocks_record("flash", 512, 1024, None, None)
+    assert rec == {
+        "flash_blocks": {"q": 512, "k": 1024, "q_bwd": 512, "k_bwd": 1024}
+    }
+    rec = bench.flash_blocks_record("flash", 256, 512, 128, 256)
+    assert rec["flash_blocks"] == {
+        "q": 256, "k": 512, "q_bwd": 128, "k_bwd": 256
+    }
+    assert bench.flash_blocks_record("xla", 512, 1024, None, None) == {}

@@ -246,3 +246,48 @@ def test_halo_conv2d_rejects_uneven_stride():
                 x, kern, axis_name="spatial", stride=3
             )
         )
+
+
+class TestGlobalExtentOverrides:
+    """halo_conv2d's global_h/global_w explicit-override semantics:
+    None derives from the tile; a GIVEN value must be validated, and a
+    falsy 0 must error instead of silently falling back to the local
+    extent (ADVICE r5)."""
+
+    def test_explicit_global_matches_default(self, spatial_mesh):
+        x, kernel = rand_case(jax.random.key(11))
+        def conv(gh, gw):
+            fn = domain.domain_parallel(
+                lambda ax, p, t: domain.halo_conv2d(
+                    t, p, axis_name=ax, global_h=gh, global_w=gw
+                ),
+                spatial_mesh,
+            )
+            return jax.jit(fn)(kernel, x)
+        np.testing.assert_allclose(
+            conv(32, 16), conv(None, None), atol=1e-6
+        )
+
+    @pytest.mark.parametrize("gh,gw", [(0, None), (None, 0), (-4, None)])
+    def test_zero_or_negative_rejected(self, spatial_mesh, gh, gw):
+        x, kernel = rand_case(jax.random.key(12))
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_conv2d(
+                t, p, axis_name=ax, global_h=gh, global_w=gw
+            ),
+            spatial_mesh,
+        )
+        with pytest.raises(ValueError, match="global_[hw]"):
+            jax.jit(fn)(kernel, x)
+
+    def test_non_multiple_global_h_rejected(self, spatial_mesh):
+        # H_loc = 32/4 = 8; a global H of 30 cannot tile into it.
+        x, kernel = rand_case(jax.random.key(13))
+        fn = domain.domain_parallel(
+            lambda ax, p, t: domain.halo_conv2d(
+                t, p, axis_name=ax, global_h=30
+            ),
+            spatial_mesh,
+        )
+        with pytest.raises(ValueError, match="multiple of the"):
+            jax.jit(fn)(kernel, x)

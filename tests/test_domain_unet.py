@@ -246,6 +246,47 @@ class TestDomainUNet:
                 err_msg=f"grad mismatch at {jax.tree_util.keystr(kp)}",
             )
 
+    def test_bf16_batchnorm_stats_stay_fp32(self, mesh):
+        """bf16 compute must not blow up the BatchNorm variance: the
+        E[x^2]-E[x]^2 cancellation on a mean-4 activation (bf16 ulp at
+        16 is 0.125) zeroes or negates a bf16-accumulated variance
+        (ADVICE r5). _batch_norm now accumulates in fp32, like flax's
+        _compute_stats -- the domain twin must still track the oracle
+        under the example's default compute_dtype='bfloat16'."""
+        from tpu_hpc.models.unet import UNetConfig, apply_unet, init_unet
+
+        cfg = UNetConfig(
+            in_channels=3, out_channels=3, base_features=8,
+            dtype=jnp.bfloat16,
+        )
+        params, state = init_unet(jax.random.key(0), cfg, (32, 16, 3))
+        # Offset, small-spread input: the regime where bf16 moment
+        # accumulation loses the variance outright.
+        x = rand(jax.random.key(1), (4, 32, 16, 3), 0.5) + 4.0
+        dom = domain_unet.make_domain_unet(mesh, cfg)
+        got, new_state = jax.jit(
+            lambda p, s, t: dom(p, s, t, train=True)
+        )(params, state, x)
+        want, want_state = apply_unet(params, state, x, cfg, train=True)
+        assert np.isfinite(np.asarray(got)).all()
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=5e-2, atol=5e-2,
+        )
+        for (kp, g), (_, w) in zip(
+            jax.tree.flatten_with_path(new_state)[0],
+            jax.tree.flatten_with_path(want_state)[0],
+        ):
+            g, w = np.asarray(g, np.float32), np.asarray(w, np.float32)
+            assert np.isfinite(g).all(), jax.tree_util.keystr(kp)
+            np.testing.assert_allclose(
+                g, w, rtol=5e-2, atol=5e-2,
+                err_msg=f"stats mismatch at {jax.tree_util.keystr(kp)}",
+            )
+            if jax.tree_util.keystr(kp).endswith("['var']"):
+                # The actual regression: a negated variance.
+                assert (g > 0).all(), jax.tree_util.keystr(kp)
+
     def test_trains_under_trainer(self, mesh, setup):
         from jax.sharding import PartitionSpec as P
 

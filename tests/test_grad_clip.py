@@ -231,7 +231,10 @@ class TestGradNormMetric:
             batch_pspec=dp.batch_pspec(),
         )
         tr.fit(datasets.ToyRegression())
-        epoch = [json.loads(x) for x in open(mpath)][-1]
+        records = [json.loads(x) for x in open(mpath)]
+        # The closing record is the resilience goodput summary; the
+        # last EPOCH record is the one that carries grad_norm.
+        epoch = [r for r in records if r["event"] == "epoch"][-1]
         assert epoch["event"] == "epoch"
         assert math.isfinite(epoch["grad_norm"])
 

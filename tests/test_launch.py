@@ -26,6 +26,30 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LAUNCH = os.path.join(REPO, "launch")
 
+# The two-process rendezvous worker, shared by the bare smoke test
+# and its supervisor-wrapped port (docs/guide/resilience.md: supervise
+# the LAUNCHER, not individual ranks).
+RENDEZVOUS_WORKER = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    for var in ("TPU_VISIBLE_DEVICES",
+                "TPU_CHIPS_PER_PROCESS_BOUNDS",
+                "PALLAS_AXON_POOL_IPS",
+                "AXON_POOL_SVC_OVERRIDE",
+                "TPU_WORKER_HOSTNAMES"):
+        os.environ.pop(var, None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_hpc.runtime.distributed import (
+        get_host_info, init_distributed,
+    )
+    info = get_host_info()
+    assert info.launcher == "explicit", info
+    init_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    print(f"proc {jax.process_index()}/{jax.process_count()} ok")
+""")
+
 
 class TestGkeJobset:
     @pytest.fixture(scope="class")
@@ -131,6 +155,35 @@ class TestTpuVmRunScript:
         assert proc.returncode != 0
         assert "no-such-profile" in (proc.stderr + proc.stdout)
 
+    def test_supervise_wraps_remote_command(self, tmp_path):
+        """SUPERVISE=N: the remote program runs under the resilience
+        supervisor (bounded restart-with-resume per worker) instead of
+        bare -- the launcher-level adoption of the subsystem."""
+        stub = tmp_path / "gcloud"
+        capture = tmp_path / "captured.txt"
+        stub.write_text(
+            "#!/usr/bin/env bash\n"
+            f'printf \'%s\\n---ARG---\\n\' "$@" >> "{capture}"\n'
+        )
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        proc = subprocess.run(
+            [
+                os.path.join(LAUNCH, "tpu_vm_run.sh"),
+                "bench.py", "--steps", "5",
+            ],
+            env=dict(
+                os.environ, GCLOUD=str(stub), SUPERVISE="2",
+                TUNING="collective-overlap",
+            ),
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr
+        got = capture.read_text()
+        assert "python -m tpu_hpc.resilience.supervisor" in got
+        assert "--max-restarts 2" in got
+        # The target program rides behind the '--' separator.
+        assert "-- python bench.py --steps 5" in got
+
 
 class TestExplicitEnvMode:
     def test_two_process_rendezvous(self, tmp_path):
@@ -138,26 +191,7 @@ class TestExplicitEnvMode:
         processes with explicit JAX_* env; both must detect the
         'explicit' launcher and rendezvous to process_count == 2."""
         worker = tmp_path / "worker.py"
-        worker.write_text(textwrap.dedent("""
-            import os
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            for var in ("TPU_VISIBLE_DEVICES",
-                        "TPU_CHIPS_PER_PROCESS_BOUNDS",
-                        "PALLAS_AXON_POOL_IPS",
-                        "AXON_POOL_SVC_OVERRIDE",
-                        "TPU_WORKER_HOSTNAMES"):
-                os.environ.pop(var, None)
-            import jax
-            jax.config.update("jax_platforms", "cpu")
-            from tpu_hpc.runtime.distributed import (
-                get_host_info, init_distributed,
-            )
-            info = get_host_info()
-            assert info.launcher == "explicit", info
-            init_distributed()
-            assert jax.process_count() == 2, jax.process_count()
-            print(f"proc {jax.process_index()}/{jax.process_count()} ok")
-        """))
+        worker.write_text(RENDEZVOUS_WORKER)
         proc = subprocess.run(
             [
                 os.path.join(LAUNCH, "local_multiprocess.sh"),
@@ -169,3 +203,69 @@ class TestExplicitEnvMode:
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "proc 0/2 ok" in proc.stdout
         assert "proc 1/2 ok" in proc.stdout
+
+    def test_fail_fast_kills_survivors(self, tmp_path):
+        """One rank dying must take the group down immediately
+        (torchrun process-group semantics), not leave the survivors
+        blocking on the JAX coordinator timeout (ADVICE r5)."""
+        import sys
+        import time
+
+        worker = tmp_path / "worker.py"
+        worker.write_text(textwrap.dedent("""
+            import os, sys, time
+            if os.environ["JAX_PROCESS_ID"] == "1":
+                sys.exit(3)   # this rank fails at startup
+            time.sleep(120)   # this one would block for minutes
+        """))
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [
+                os.path.join(LAUNCH, "local_multiprocess.sh"),
+                "2", str(worker),
+            ],
+            env=dict(os.environ, COORD_PORT="12429",
+                     PYTHON=sys.executable),
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        elapsed = time.monotonic() - t0
+        assert proc.returncode == 3, (proc.returncode, proc.stderr)
+        assert elapsed < 60, f"did not fail fast: {elapsed:.0f}s"
+        assert "killing survivors" in proc.stderr
+
+
+class TestSupervisedLaunch:
+    def test_supervisor_wraps_multiprocess_smoke(self, tmp_path):
+        """The explicit-env smoke test ported onto the resilience
+        supervisor: supervise the LAUNCHER (one restartable unit that
+        re-rendezvouses the whole group), attempt log + event trail
+        land in --log-dir."""
+        import json
+        import sys
+
+        worker = tmp_path / "worker.py"
+        worker.write_text(RENDEZVOUS_WORKER)
+        sup_dir = tmp_path / "sup"
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tpu_hpc.resilience.supervisor",
+                "--max-restarts", "1", "--log-dir", str(sup_dir),
+                "--",
+                os.path.join(LAUNCH, "local_multiprocess.sh"),
+                "2", str(worker),
+            ],
+            env=dict(os.environ, COORD_PORT="12433",
+                     PYTHON=sys.executable),
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        log = (sup_dir / "run.attempt0.log").read_text()
+        assert "proc 0/2 ok" in log
+        assert "proc 1/2 ok" in log
+        events = [
+            json.loads(x)
+            for x in open(sup_dir / "supervisor.jsonl")
+        ]
+        assert [
+            e["rc"] for e in events if e["event"] == "attempt_end"
+        ] == [0]

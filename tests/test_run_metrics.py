@@ -62,19 +62,29 @@ class TestMetricsLog:
             json.loads(line) for line in open(mpath)
         ]
         assert [r["event"] for r in records] == [
-            "run_start", "epoch", "epoch"
+            "run_start", "epoch", "epoch", "run_end"
         ]
         start = records[0]
         assert start["total_steps"] == 4
         assert start["n_devices"] == 8
         assert start["config"]["global_batch_size"] == 16
         assert start["jax_version"] == jax.__version__
-        for i, r in enumerate(records[1:]):
+        for i, r in enumerate(records[1:-1]):
             assert r["epoch"] == i
             assert r["step"] == (i + 1) * 2
             assert math.isfinite(r["loss"])
             assert r["items_per_s"] > 0
             assert r["s_per_step"] > 0
+        # Goodput / restart accounting rides the closing record
+        # (resilience: every fit leaves an auditable productive-vs-
+        # overhead trail; see docs/guide/resilience.md).
+        end = records[-1]
+        assert end["step"] == 4
+        assert end["preempted"] is False
+        assert end["attempt"] == 0
+        assert end["resumed_from_step"] == 0
+        assert end["goodput"]["productive_s"] > 0
+        assert 0.0 <= end["goodput"]["goodput"] <= 1.0
 
     def test_appends_across_runs(self, mesh8, tiny_setup, tmp_path):
         """Two fits append to the same file -- the reference's
@@ -93,7 +103,7 @@ class TestMetricsLog:
             )
             tr.fit(ds)
         events = [json.loads(x)["event"] for x in open(mpath)]
-        assert events == ["run_start", "epoch"] * 2
+        assert events == ["run_start", "epoch", "run_end"] * 2
 
     def test_nested_path_created(self, mesh8, tiny_setup, tmp_path):
         """A metrics_path in a directory that does not exist yet must
@@ -110,7 +120,7 @@ class TestMetricsLog:
             batch_pspec=dp.batch_pspec(),
         )
         tr.fit(ds)
-        assert len(open(mpath).readlines()) == 2
+        assert len(open(mpath).readlines()) == 3
 
     def test_off_by_default(self, mesh8, tiny_setup, tmp_path):
         forward, params, ms, ds = tiny_setup

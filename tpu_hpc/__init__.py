@@ -15,6 +15,8 @@ Layer map (mirrors SURVEY.md section 1):
   models/     llama2, unet, vit, pipeline transformer, synthetic datasets
   train/      trainer loop, throughput metrics, losses
   ckpt/       orbax checkpointing + snapshot auto-resume
+  resilience/ preemption guard, hang watchdog, retry/backoff, run
+              supervisor, deterministic fault injection
   config/     unified dataclass + YAML/CLI config
   profiling/  jax.profiler wrapper with schedule windows
   logging_/   host-0 logging, per-process output redirect
@@ -37,6 +39,57 @@ if _sim:
     from tpu_hpc.runtime.sim import force_sim_devices as _force_sim
 
     _force_sim(int(_sim))
+
+
+def _install_jax_compat() -> None:
+    """Runtime-version shims: the framework targets the current stable
+    jax API; on older runtimes (e.g. the 0.4.x this container ships)
+    a few entry points are missing or spelled differently. Install
+    equivalent adapters at the same names so every module and recipe
+    runs unchanged on both. Each shim self-disables the day the
+    baseline jax has the real thing.
+
+    * ``jax.shard_map`` -- lives under ``jax.experimental.shard_map``
+      with ``check_rep`` instead of ``check_vma``.
+    * ``jax.lax.axis_size`` -- ``psum(1, axis)`` constant-folds to the
+      static axis size under shard_map tracing, which is exactly what
+      the newer helper returns.
+    """
+    import jax as _jax
+
+    if not hasattr(_jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh=None, in_specs, out_specs,
+                      check_vma=None, **kwargs):
+            if check_vma is not None:
+                kwargs["check_rep"] = check_vma
+            return _shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kwargs,
+            )
+
+        _jax.shard_map = shard_map
+
+    if not hasattr(_jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            return _jax.lax.psum(1, axis_name)
+
+        _jax.lax.axis_size = axis_size
+
+    # The *_with_path family graduated from jax.tree_util to jax.tree
+    # after 0.4.x; alias the originals.
+    for _name in (
+        "flatten_with_path", "leaves_with_path", "map_with_path"
+    ):
+        if not hasattr(_jax.tree, _name):
+            setattr(
+                _jax.tree, _name,
+                getattr(_jax.tree_util, f"tree_{_name}"),
+            )
+
+
+_install_jax_compat()
 
 from tpu_hpc.runtime import (  # noqa: F401
     HostInfo,

@@ -13,6 +13,13 @@ TPU-native replacements in this one class:
   3 -> ``restore_latest``: give it the current (abstract) state, get
       back the newest checkpoint resharded onto the live mesh, or None
       -- the Trainer resumes from ``state.step`` exactly.
+
+Resilience integration (tpu_hpc.resilience, docs/guide/resilience.md):
+``save_now`` is the emergency synchronous preemption snapshot;
+``restore_latest`` retries transient failures and falls back to the
+next-older step when the newest snapshot is torn; saves replay over
+existing steps after such a fallback instead of dying on
+StepAlreadyExists.
 """
 from __future__ import annotations
 
@@ -22,6 +29,10 @@ from typing import Any, Optional
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from tpu_hpc.logging_ import get_logger
+from tpu_hpc.resilience.faults import fault_plan_from_env
+from tpu_hpc.resilience.retry import retry_call
 
 
 class CheckpointManager:
@@ -52,16 +63,138 @@ class CheckpointManager:
         state.step). Returns True if a save was started."""
         if step is None:
             step = int(jax.device_get(state.step))
-        return self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+        aside = self._stash_existing(step)
+        started = self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        if aside is not None:
+            if started:
+                # The old copy is only discarded once its replacement
+                # is DURABLE: deleting up front would open a window
+                # (async save in flight) where a crash leaves no
+                # readable copy of the step at all.
+                self._mgr.wait_until_finished()
+                import shutil
 
-    def restore_latest(self, template_state: Any) -> Optional[Any]:
-        """Restore the newest checkpoint resharded to match
-        ``template_state``'s shardings; None if no checkpoint exists."""
-        step = self._mgr.latest_step()
-        if step is None:
+                shutil.rmtree(aside, ignore_errors=True)
+            else:
+                # orbax declined the save (should_save is False when
+                # a LATER step already exists -- replay below the
+                # newest surviving snapshot). Put the only copy back.
+                os.rename(
+                    aside, os.path.join(self.directory, str(step))
+                )
+                reload = getattr(self._mgr, "reload", None)
+                if reload is not None:
+                    reload()
+        if started:
+            self._maybe_corrupt(step)
+        return started
+
+    def _stash_existing(self, step: int) -> Optional[str]:
+        """Resume replay: a run restored below its newest snapshot
+        (restore fallback after a torn write, or an explicit
+        restore(step)) re-trains through steps it already saved.
+        Overwrite them -- the fresh save is the good one -- instead of
+        dying on StepAlreadyExists mid-run (orbax's already-exists
+        check is unconditional; ``force`` only bypasses should_save).
+        The old copy is RENAMED aside, not deleted, and the caller
+        removes it only after the replacement save is durable; the
+        non-numeric suffix hides it from orbax's step listing.
+        Returns the aside path, or None if the step did not exist."""
+        path = os.path.join(self.directory, str(step))
+        if not os.path.isdir(path):
             return None
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template_state)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        aside, k = f"{path}.replaced", 0
+        while os.path.exists(aside):
+            k += 1
+            aside = f"{path}.replaced.{k}"
+        os.rename(path, aside)
+        reload = getattr(self._mgr, "reload", None)
+        if reload is not None:
+            reload()
+        return aside
+
+    def save_now(self, state: Any, step: Optional[int] = None) -> int:
+        """Emergency SYNCHRONOUS save: force-write at ``step`` and
+        block until the snapshot is durable on storage. This is the
+        preemption-notice path (resilience.signals): the grace window
+        may be seconds, so nothing here is allowed to stay in flight
+        when the call returns. Returns the step saved."""
+        if step is None:
+            step = int(jax.device_get(state.step))
+        aside = self._stash_existing(step)
+        self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=True
+        )
+        self._mgr.wait_until_finished()
+        if aside is not None:
+            import shutil
+
+            shutil.rmtree(aside, ignore_errors=True)
+        self._maybe_corrupt(step)
+        return step
+
+    def _maybe_corrupt(self, step: int) -> None:
+        """Fault-injection hook (no-op unless TPU_HPC_FAULTS asks for
+        corrupt_ckpt_at_step): garbage this step's files after the
+        write lands, simulating a torn multi-file write -- the failure
+        restore_latest's fallback exists for."""
+        plan = fault_plan_from_env()
+        if plan is None or not plan.wants_ckpt_corruption(step):
+            return
+        self._mgr.wait_until_finished()  # corrupt AFTER the write lands
+        n = plan.corrupt_checkpoint(
+            os.path.join(self.directory, str(step))
+        )
+        get_logger().warning(
+            "fault injection: corrupted %d files of checkpoint step %d",
+            n, step,
+        )
+
+    def restore_latest(
+        self, template_state: Any, retries: int = 1
+    ) -> Optional[Any]:
+        """Restore the newest READABLE checkpoint resharded to match
+        ``template_state``'s shardings; None if no checkpoint can be
+        restored.
+
+        Self-healing restore: each step gets ``retries`` extra
+        attempts (transient shared-filesystem flake), and a step that
+        still fails -- torn write from the crash that triggered this
+        very restart -- falls back to the next-older one instead of
+        wedging the relaunch loop on a corrupt newest snapshot.
+
+        Loud-failure guarantee: if checkpoints EXIST but none restore
+        (a structural mismatch -- wrong mesh/model config on relaunch
+        -- fails every step, unlike a torn write which fails only the
+        newest), the last error is re-raised. Returning None there
+        would silently restart from step 0 and then overwrite the
+        surviving snapshots as training re-passed them."""
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        abstract = jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, template_state
+        )
+        last_exc: Optional[Exception] = None
+        for step in steps:
+            try:
+                return retry_call(
+                    self._mgr.restore,
+                    (step,),
+                    {"args": ocp.args.StandardRestore(abstract)},
+                    retries=retries, base_delay=0.2, max_delay=5.0,
+                    describe=f"checkpoint restore (step {step})",
+                )
+            except Exception as exc:  # noqa: BLE001 - fall back older
+                last_exc = exc
+                get_logger().warning(
+                    "checkpoint step %d unreadable (%s: %s); falling "
+                    "back to the previous one",
+                    step, type(exc).__name__, exc,
+                )
+        if last_exc is not None:
+            raise last_exc
+        return None
 
     def restore(self, step: int, template_state: Any) -> Any:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template_state)

@@ -275,13 +275,27 @@ def prepare_on_host0(prepare_fn, paths) -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("tpu_hpc_prepare")
-    missing = [p for p in paths if not os.path.exists(p)]
-    if missing:
-        raise FileNotFoundError(
-            f"prepare did not produce {missing} -- is the data "
-            "directory shared across hosts (GCS/NFS)? Each host needs "
-            "to see the same files."
-        )
+
+    def check_visible():
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(
+                f"prepare did not produce {missing} -- is the data "
+                "directory shared across hosts (GCS/NFS)? Each host "
+                "needs to see the same files."
+            )
+
+    # Shared filesystems are close-to-open consistent at best: a file
+    # host 0 just wrote can take seconds to appear to the other hosts
+    # even after the barrier. Bounded retry instead of failing the
+    # whole job on the propagation race (resilience.retry).
+    from tpu_hpc.resilience.retry import retry_call
+
+    retry_call(
+        check_visible, retries=4, base_delay=0.5, max_delay=8.0,
+        retry_on=(FileNotFoundError,),
+        describe="shared-filesystem dataset visibility",
+    )
 
 
 def write_dataset(path: str, x: np.ndarray, y: np.ndarray) -> str:

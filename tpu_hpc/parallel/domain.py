@@ -111,6 +111,20 @@ def halo_conv2d(
     h_loc, w = x.shape[1], x.shape[2]
     if stride < 1:
         raise ValueError(f"stride {stride} must be >= 1")
+    # Explicit-override semantics: None means "derive from the tile";
+    # any given value must be a real extent. A falsy 0 must error, not
+    # silently fall back to the local default (ADVICE r5).
+    if global_h is not None and (global_h <= 0 or global_h % h_loc):
+        raise ValueError(
+            f"global_h {global_h} must be a positive multiple of the "
+            f"local tile height {h_loc}"
+        )
+    if global_w is not None and global_w != w:
+        raise ValueError(
+            f"global_w {global_w} must equal the tile width {w}: W is "
+            "never sharded here (there is no W halo exchange), so any "
+            "other extent would silently mis-pad the SAME conv"
+        )
     if h_loc % stride:
         raise ValueError(
             f"local tile height {h_loc} must divide by stride {stride} "
@@ -135,7 +149,9 @@ def halo_conv2d(
             )
         pad_lo = (kh - stride) // 2 if kh > stride else 0
     else:
-        pad_lo, _ = same_pads(global_h or h_loc, kh, stride)
+        pad_lo, _ = same_pads(
+            h_loc if global_h is None else global_h, kh, stride
+        )
     halo_lo = pad_lo
     # Rows the last local window reads past the tile end; k <= s needs
     # none (windows never overlap, VALID's floor drops skipped rows).
@@ -143,7 +159,9 @@ def halo_conv2d(
     xp = halo_exchange(
         x, axis_name, (halo_lo, halo_hi), axis=1, wrap=wrap
     )
-    pw_lo, pw_hi = same_pads(global_w or w, kw, stride)
+    pw_lo, pw_hi = same_pads(
+        w if global_w is None else global_w, kw, stride
+    )
     out = jax.lax.conv_general_dilated(
         xp,
         kernel,

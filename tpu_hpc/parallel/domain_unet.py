@@ -50,10 +50,18 @@ def _batch_norm(
     """flax.linen.BatchNorm semantics on a sharded tile: biased batch
     moments over (B, H, W) with the cross-device sums psum'd, running
     stats updated with the same momentum convention
-    (ra = m*ra + (1-m)*batch). ``n_global`` = global B*H*W."""
+    (ra = m*ra + (1-m)*batch). ``n_global`` = global B*H*W.
+
+    Moment math runs in float32 regardless of the compute dtype --
+    flax BatchNorm forces the same in ``_compute_stats``. In bf16 the
+    B*H*W sum loses low bits and the ``E[x^2] - E[x]^2`` cancellation
+    (bf16 ulp at 4.0 is 0.03) can zero or even NEGATE the variance,
+    blowing up rsqrt (ADVICE r5). Running stats stay fp32; only the
+    normalized output casts back to ``x.dtype``."""
+    xf = x.astype(jnp.float32)
     if train:
-        s = jax.lax.psum(jnp.sum(x, axis=(0, 1, 2)), axis_names)
-        s2 = jax.lax.psum(jnp.sum(x * x, axis=(0, 1, 2)), axis_names)
+        s = jax.lax.psum(jnp.sum(xf, axis=(0, 1, 2)), axis_names)
+        s2 = jax.lax.psum(jnp.sum(xf * xf, axis=(0, 1, 2)), axis_names)
         mean = s / n_global
         var = s2 / n_global - mean * mean
         new_ra = {
@@ -63,7 +71,7 @@ def _batch_norm(
     else:
         mean, var = ra["mean"], ra["var"]
         new_ra = ra
-    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
     # flax BatchNorm(dtype=...) emits the compute dtype; the fp32
     # scale/bias promotion must not leak fp32 into the next conv.
     return (y * p["scale"] + p["bias"]).astype(x.dtype), new_ra

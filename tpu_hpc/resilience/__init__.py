@@ -1,0 +1,38 @@
+"""Resilience: preemption-safe, self-healing training runs.
+
+On TPU pods preemption, coordinator hangs, and flaky slices are the
+NORMAL operating regime, not the exception -- every round-5 hardware
+run was babysat by an ad-hoc shell watchdog (HW_QUEUE_r05/watchdog.log,
+the rc=3 exhausted probe window, the overwritten OOM stash log). This
+package moves fault handling from the queue script into the framework,
+the position "Collective Communication for 100k+ GPUs" (PAPERS.md)
+argues is mandatory at scale:
+
+  signals.py    SIGTERM/preemption-notice guard: final synchronous
+                checkpoint + clean exit with a distinct resumable code
+  heartbeat.py  step-progress heartbeat file + in-process hang
+                watchdog (a stalled collective aborts with diagnostics
+                instead of hanging the allocation)
+  retry.py      bounded retry/backoff with deterministic jitter, used
+                for jax.distributed.initialize, checkpoint restore,
+                and shared-filesystem dataset reads
+  supervisor.py bounded restart-with-resume process supervisor
+                (``python -m tpu_hpc.resilience.supervisor -- <cmd>``)
+                replacing the shell watchdog; attempt-unique log
+                paths, failure dumps are never overwritten
+  faults.py     deterministic fault injection (kill-at-step,
+                preempt-at-step, stall, corrupt-ckpt-write) so all of
+                the above is testable on CPU
+
+Everything here is stdlib-only and import-cheap: the supervisor must
+start (and restart a dead run) without touching jax.
+"""
+from tpu_hpc.resilience.faults import FaultPlan, fault_plan_from_env  # noqa: F401
+from tpu_hpc.resilience.heartbeat import HangWatchdog, Heartbeat  # noqa: F401
+from tpu_hpc.resilience.retry import backoff_delays, retry_call  # noqa: F401
+from tpu_hpc.resilience.signals import (  # noqa: F401
+    EXIT_HANG,
+    EXIT_RESUMABLE,
+    PreemptionGuard,
+    exit_code_for,
+)

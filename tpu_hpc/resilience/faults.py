@@ -1,0 +1,138 @@
+"""Deterministic fault injection: make failures a test input.
+
+Every resilience mechanism in this package claims to survive a failure
+class; none of those claims are testable on CPU unless the failure can
+be produced on demand, at an exact step, on an exact attempt. This
+module is that switch. It is env-var armed (``TPU_HPC_FAULTS``) so the
+injected process needs NO code changes -- the supervisor test launches
+the ordinary training entry point with::
+
+    TPU_HPC_FAULTS="kill_at_step=4" \
+        python -m tpu_hpc.resilience.supervisor -- python train.py ...
+
+Fault kinds (all step-indexed, fired at the trainer's chunk
+boundaries, i.e. at the first progress point where ``step >= N``):
+
+* ``kill_at_step=N``     SIGKILL self -- a hard preemption/OOM kill,
+                         no grace, no snapshot.
+* ``preempt_at_step=N``  SIGTERM self -- a graceful preemption notice;
+                         exercises PreemptionGuard + emergency save.
+* ``stall_at_step=N``    sleep ``stall_s`` (default 3600) -- a wedged
+                         collective; exercises the hang watchdog.
+* ``corrupt_ckpt_at_step=N``  garbage every file of checkpoint step N
+                         after it lands -- a torn write; exercises
+                         restore fallback to the previous step.
+
+``on_attempt`` (default 0) scopes injection to one restart ordinal so
+a supervised run fails once and then completes -- the
+restart-with-resume round trip, deterministic end to end.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+from typing import Optional
+
+from tpu_hpc.resilience.heartbeat import current_attempt
+
+ENV_FAULTS = "TPU_HPC_FAULTS"
+
+_INT_KEYS = (
+    "kill_at_step",
+    "preempt_at_step",
+    "stall_at_step",
+    "corrupt_ckpt_at_step",
+    "on_attempt",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A parsed, armed fault schedule for THIS process."""
+
+    kill_at_step: Optional[int] = None
+    preempt_at_step: Optional[int] = None
+    stall_at_step: Optional[int] = None
+    corrupt_ckpt_at_step: Optional[int] = None
+    stall_s: float = 3600.0
+    on_attempt: int = 0
+    attempt: int = 0
+
+    @property
+    def active(self) -> bool:
+        """Injection is scoped to one restart ordinal: the fault fires
+        once, and the relaunched attempt runs clean."""
+        return self.attempt == self.on_attempt
+
+    def on_step(self, step: int) -> None:
+        """Called from the training loop at each progress point."""
+        if not self.active:
+            return
+        if (
+            self.stall_at_step is not None
+            and step >= self.stall_at_step
+        ):
+            time.sleep(self.stall_s)
+        if (
+            self.preempt_at_step is not None
+            and step >= self.preempt_at_step
+        ):
+            # Graceful notice to self: PreemptionGuard's flag is set
+            # synchronously (same-process SIGTERM runs the Python
+            # handler at the next bytecode boundary).
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self.kill_at_step is not None and step >= self.kill_at_step:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def wants_ckpt_corruption(self, step: int) -> bool:
+        return self.active and self.corrupt_ckpt_at_step == step
+
+    def corrupt_checkpoint(self, step_dir: str) -> int:
+        """Garbage every regular file under ``step_dir`` (a torn
+        multi-file write); returns the count corrupted."""
+        n = 0
+        for root, _, files in os.walk(step_dir):
+            for name in files:
+                corrupt_file(os.path.join(root, name))
+                n += 1
+        return n
+
+
+def corrupt_file(path: str) -> None:
+    """Deterministically destroy a file's contents in place (replace
+    with a short garbage header -- breaks zarr/msgpack/json parsing
+    alike)."""
+    with open(path, "wb") as f:
+        f.write(b"\x00TPU_HPC_FAULT_CORRUPTED\x00")
+
+
+def fault_plan_from_env(env=None) -> Optional[FaultPlan]:
+    """Parse ``TPU_HPC_FAULTS`` ("k=v,k=v"); None when unset (the
+    production default -- every injection site is a no-op).
+
+    Unknown keys are a hard error: a typo'd fault spec silently
+    injecting nothing would make a resilience test pass vacuously.
+    """
+    env = os.environ if env is None else env
+    spec = env.get(ENV_FAULTS, "").strip()
+    if not spec:
+        return None
+    fields: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if key in _INT_KEYS:
+            fields[key] = int(val)
+        elif key == "stall_s":
+            fields[key] = float(val)
+        else:
+            raise ValueError(
+                f"unknown fault key {key!r} in {ENV_FAULTS}={spec!r} "
+                f"(known: {', '.join(_INT_KEYS + ('stall_s',))})"
+            )
+    return FaultPlan(attempt=current_attempt(env), **fields)

@@ -1,0 +1,122 @@
+"""Preemption-notice handling and the run exit-code contract.
+
+TPU-VM spot/maintenance events deliver SIGTERM with a short grace
+window (the reference's analogue is PBS resubmission, multinode_ddp_
+basic.py:144-155 -- but there the *queue script* owns recovery). The
+contract here:
+
+* ``PreemptionGuard`` installs an async-signal-safe flag handler; the
+  training loop polls ``guard.triggered`` at chunk boundaries,
+  requests one final SYNCHRONOUS checkpoint, and exits cleanly.
+* The process then exits with ``EXIT_RESUMABLE`` (75, the sysexits
+  EX_TEMPFAIL convention): "nothing is wrong, relaunch me and I will
+  resume". The supervisor (supervisor.py) restarts on it without
+  treating the run as failing.
+* ``EXIT_HANG`` (76) is the hang watchdog's abort code (heartbeat.py):
+  the run was killed because it stopped making progress -- restart,
+  but count it against the failure budget and keep the diagnostics.
+
+Anything else nonzero is an ordinary crash. Exit codes are the ONLY
+channel a dead process has, which is why they are pinned constants
+here rather than conventions scattered through launch scripts.
+"""
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import Iterable, Optional, Tuple
+
+# sysexits.h EX_TEMPFAIL: a clean preemption snapshot; relaunch resumes.
+EXIT_RESUMABLE = 75
+# Hang-watchdog abort: progress stalled; diagnostics were dumped.
+EXIT_HANG = 76
+
+_MEANINGS = {
+    0: "success",
+    EXIT_RESUMABLE: "resumable (preemption snapshot taken)",
+    EXIT_HANG: "hang-watchdog abort (progress stalled)",
+}
+
+
+def describe_exit(code: int) -> str:
+    """Human label for the exit-code contract (supervisor logs)."""
+    if code < 0:
+        return f"killed by signal {-code}"
+    return _MEANINGS.get(code, f"failure (exit {code})")
+
+
+def exit_code_for(preempted: bool) -> int:
+    """The code a training entry point should exit with after fit():
+    the resumable contract when the run stopped on a preemption
+    notice, plain success otherwise. Usage::
+
+        result = trainer.fit(ds)
+        sys.exit(exit_code_for(result.get("preempted", False)))
+    """
+    return EXIT_RESUMABLE if preempted else 0
+
+
+def resumable_exit() -> None:
+    """Exit now under the resumable contract (snapshot already taken)."""
+    sys.exit(EXIT_RESUMABLE)
+
+
+class PreemptionGuard:
+    """Flag-only signal handler for preemption notices.
+
+    The handler does nothing but set a flag (async-signal-safe: no
+    I/O, no locks, no jax) -- the training loop polls ``triggered`` at
+    its own safe points. Install/restore are explicit so the guard can
+    bracket exactly one fit() and always hand the previous disposition
+    back (a dataset/OOM exception mid-loop must not leave the no-op
+    flag handler installed for the life of the process).
+
+    Non-main threads cannot install signal handlers; there ``install``
+    is a no-op and the guard simply never triggers, matching the old
+    inline behavior in Trainer.fit.
+    """
+
+    def __init__(
+        self, signums: Iterable[int] = (signal.SIGTERM,)
+    ):
+        self.signums: Tuple[int, ...] = tuple(signums)
+        self._event = threading.Event()
+        self._old: dict = {}
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def installed(self) -> bool:
+        return bool(self._old)
+
+    def _handler(self, signum, frame):  # pragma: no cover - trivial
+        self._event.set()
+
+    def install(self) -> "PreemptionGuard":
+        for signum in self.signums:
+            try:
+                self._old[signum] = signal.signal(signum, self._handler)
+            except ValueError:
+                # Non-main thread: skip, keep training unguarded.
+                pass
+        return self
+
+    def restore(self) -> None:
+        """Put back the previous dispositions. ``signal.signal``
+        returns None when the previous handler was installed from C;
+        SIG_DFL is the honest restoration then."""
+        for signum, old in self._old.items():
+            signal.signal(
+                signum, old if old is not None else signal.SIG_DFL
+            )
+        self._old.clear()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.restore()
+        return None

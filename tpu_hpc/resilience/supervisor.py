@@ -1,0 +1,333 @@
+"""Single-host run supervisor: bounded restart-with-resume.
+
+Replaces the ad-hoc shell watchdogs every round-5 hardware run was
+babysat by (HW_QUEUE_r05/watchdog.log) with one auditable process::
+
+    python -m tpu_hpc.resilience.supervisor \
+        --max-restarts 3 --log-dir runs/job1 \
+        --heartbeat runs/job1/heartbeat.json --heartbeat-timeout 900 \
+        -- python train.py --config cfg.yaml
+
+Contract with the child (any command; the Trainer honors all of it
+automatically):
+
+* ``TPU_HPC_ATTEMPT`` -- restart ordinal (0-based). Fault injection
+  and log naming key off it.
+* ``TPU_HPC_HEARTBEAT`` -- exported when ``--heartbeat`` is given; the
+  child ticks it (Trainer does, at every chunk boundary). With
+  ``--heartbeat-timeout``, a stale file means the child is wedged in a
+  way its own in-process watchdog could not catch (e.g. the whole
+  interpreter stuck in C++): the supervisor kills and restarts it.
+* Exit 0 ends the run; ``EXIT_RESUMABLE`` (75) and any other nonzero
+  code restart it (up to ``--max-restarts``), each attempt resuming
+  from the newest checkpoint via the Trainer's own auto-resume.
+
+Provenance rules (VERDICT item 9 -- the overwritten OOM dump): every
+attempt logs to an ATTEMPT-UNIQUE path (``run.attempt<N>.log``; if a
+previous supervision left one there, a numeric suffix is added -- a
+failure dump is NEVER overwritten), and every attempt appends a JSON
+event (rc, duration, log path, restart reason) to
+``supervisor.jsonl``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import IO, List, Optional, Sequence, Tuple
+
+from tpu_hpc.resilience.heartbeat import ENV_ATTEMPT, ENV_HEARTBEAT
+from tpu_hpc.resilience.retry import backoff_delays
+from tpu_hpc.resilience.signals import EXIT_HANG, describe_exit
+
+
+def unique_attempt_path(log_dir: str, attempt: int) -> str:
+    """``run.attempt<N>.log``, suffixed rather than overwritten when a
+    previous supervision already left one in this directory."""
+    base = os.path.join(log_dir, f"run.attempt{attempt}.log")
+    path, k = base, 0
+    while os.path.exists(path):
+        k += 1
+        path = f"{base}.{k}"
+    return path
+
+
+def _wait_rc(code: int) -> int:
+    """Normalize Popen returncodes to shell convention (signal n ->
+    128 + n) so the supervisor's own exit code is launcher-readable."""
+    return 128 - code if code < 0 else code
+
+
+class Supervisor:
+    def __init__(
+        self,
+        cmd: Sequence[str],
+        *,
+        max_restarts: int = 3,
+        log_dir: Optional[str] = None,
+        heartbeat: Optional[str] = None,
+        heartbeat_timeout: float = 0.0,
+        backoff: float = 1.0,
+        no_restart_on: Sequence[int] = (),
+        kill_grace_s: float = 10.0,
+        poll_s: float = 0.2,
+    ):
+        if not cmd:
+            raise ValueError("empty command")
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts {max_restarts} must be >= 0")
+        self.cmd = list(cmd)
+        self.max_restarts = max_restarts
+        self.log_dir = log_dir
+        self.heartbeat = heartbeat
+        self.heartbeat_timeout = heartbeat_timeout
+        self.backoff = backoff
+        self.no_restart_on = set(no_restart_on)
+        self.kill_grace_s = kill_grace_s
+        self.poll_s = poll_s
+        self._child: Optional[subprocess.Popen] = None
+        self._stop_requested = False
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+
+    # -- event log ----------------------------------------------------
+    def _event(self, **rec) -> None:
+        rec = {"time": time.time(), **rec}
+        line = json.dumps(rec)
+        print(f"supervisor: {line}", file=sys.stderr, flush=True)
+        if self.log_dir:
+            with open(
+                os.path.join(self.log_dir, "supervisor.jsonl"), "a"
+            ) as f:
+                f.write(line + "\n")
+
+    # -- signal forwarding --------------------------------------------
+    def _forward(self, signum, frame):
+        """Preemption of the supervisor itself: pass the notice down
+        (the child snapshots and exits resumable) and stop
+        restarting -- the allocation is going away."""
+        self._stop_requested = True
+        if self._child is not None and self._child.poll() is None:
+            self._child.send_signal(signum)
+
+    # -- heartbeat staleness ------------------------------------------
+    def _heartbeat_age(self, attempt_start: float) -> float:
+        """Seconds since last observed progress: the heartbeat file's
+        mtime, or the attempt start while none exists yet (startup /
+        compile time counts against the same budget -- document the
+        timeout accordingly)."""
+        try:
+            return time.time() - os.path.getmtime(self.heartbeat)
+        except OSError:
+            return time.monotonic() - attempt_start
+
+    def _kill_child(self) -> None:
+        assert self._child is not None
+        self._child.terminate()
+        try:
+            self._child.wait(timeout=self.kill_grace_s)
+        except subprocess.TimeoutExpired:
+            self._child.kill()
+            self._child.wait()
+
+    # -- one attempt --------------------------------------------------
+    def _run_attempt(self, attempt: int) -> Tuple[int, str, str]:
+        """Returns (rc, reason, log_path). ``reason`` is "exit" or
+        "heartbeat-stall"."""
+        env = dict(os.environ, **{ENV_ATTEMPT: str(attempt)})
+        if self.heartbeat:
+            env[ENV_HEARTBEAT] = self.heartbeat
+            # Clear the previous attempt's heartbeat: a stale file
+            # would read as an instant stall and kill every restarted
+            # child within one poll, burning the whole budget on one
+            # hang. With the file gone, staleness is measured from
+            # this attempt's start.
+            try:
+                os.remove(self.heartbeat)
+            except OSError:
+                pass
+        log_path, log_f = "", None  # type: str, Optional[IO]
+        if self.log_dir:
+            log_path = unique_attempt_path(self.log_dir, attempt)
+            log_f = open(log_path, "w")
+        start = time.monotonic()
+        try:
+            self._child = subprocess.Popen(
+                self.cmd,
+                stdout=log_f or None,
+                stderr=subprocess.STDOUT if log_f else None,
+                env=env,
+            )
+            reason = "exit"
+            while True:
+                rc = self._child.poll()
+                if rc is not None:
+                    break
+                if (
+                    self.heartbeat_timeout > 0
+                    and not self._stop_requested
+                    and self._heartbeat_age(start)
+                    > self.heartbeat_timeout
+                ):
+                    self._event(
+                        event="heartbeat_stall", attempt=attempt,
+                        timeout_s=self.heartbeat_timeout,
+                    )
+                    self._kill_child()
+                    # Policy-wise a supervisor-detected stall IS the
+                    # watchdog abort, just caught one layer out.
+                    rc, reason = EXIT_HANG, "heartbeat-stall"
+                    break
+                time.sleep(self.poll_s)
+            return _wait_rc(rc), reason, log_path
+        finally:
+            self._child = None
+            if log_f:
+                log_f.close()
+
+    # -- the loop -----------------------------------------------------
+    def run(self) -> int:
+        old = {}
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                old[signum] = signal.signal(signum, self._forward)
+            except ValueError:  # non-main thread (tests)
+                pass
+        # seed=None -> pid-seeded jitter: one supervisor per pod
+        # worker must NOT relaunch all ranks in lockstep after a
+        # pod-wide fault (the thundering-herd knock the jitter
+        # exists to break up).
+        delays = backoff_delays(
+            self.max_restarts, base_delay=self.backoff,
+            max_delay=60.0, jitter=0.25, seed=None,
+        )
+        try:
+            attempt = 0
+            while True:
+                self._event(
+                    event="attempt_start", attempt=attempt,
+                    cmd=self.cmd,
+                )
+                t0 = time.monotonic()
+                rc, reason, log_path = self._run_attempt(attempt)
+                self._event(
+                    event="attempt_end", attempt=attempt, rc=rc,
+                    meaning=describe_exit(rc), reason=reason,
+                    duration_s=round(time.monotonic() - t0, 3),
+                    log=log_path,
+                )
+                if rc == 0:
+                    return 0
+                if self._stop_requested:
+                    # Preemption rode through us: propagate the
+                    # child's (resumable) code to the launcher above.
+                    return rc
+                if rc in self.no_restart_on:
+                    self._event(
+                        event="giving_up", attempt=attempt, rc=rc,
+                        why="exit code marked non-restartable",
+                    )
+                    return rc
+                if attempt >= self.max_restarts:
+                    self._event(
+                        event="giving_up", attempt=attempt, rc=rc,
+                        why=f"restart budget ({self.max_restarts}) "
+                        "exhausted",
+                    )
+                    return rc
+                delay = next(delays)
+                self._event(
+                    event="restarting", next_attempt=attempt + 1,
+                    backoff_s=round(delay, 3),
+                )
+                time.sleep(delay)
+                if self._stop_requested:
+                    # Preemption arrived during the backoff sleep
+                    # (no child to forward to): launching another
+                    # attempt would strand a snapshot-less child in a
+                    # dying allocation.
+                    return rc
+                attempt += 1
+        finally:
+            for signum, handler in old.items():
+                # signal.signal returns None when the previous handler
+                # was installed from C; SIG_DFL is the honest
+                # restoration then (same edge PreemptionGuard handles).
+                signal.signal(
+                    signum,
+                    handler if handler is not None else signal.SIG_DFL,
+                )
+
+
+def run_supervised(cmd: Sequence[str], **kwargs) -> int:
+    """Library entry point (bench.py --supervise uses this)."""
+    return Supervisor(cmd, **kwargs).run()
+
+
+def _split_argv(
+    argv: Sequence[str],
+) -> Tuple[List[str], List[str]]:
+    if "--" not in argv:
+        raise SystemExit(
+            "usage: python -m tpu_hpc.resilience.supervisor "
+            "[options] -- <command> [args...]   (the '--' is required)"
+        )
+    i = list(argv).index("--")
+    return list(argv[:i]), list(argv[i + 1:])
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    opts, cmd = _split_argv(argv)
+    ap = argparse.ArgumentParser(
+        prog="tpu_hpc.resilience.supervisor",
+        description="bounded restart-with-resume run supervisor",
+    )
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument(
+        "--log-dir", type=str, default=None,
+        help="attempt-unique child logs + supervisor.jsonl here "
+        "(default: inherit the supervisor's stdio)",
+    )
+    ap.add_argument(
+        "--heartbeat", type=str, default=None,
+        help="heartbeat file path exported to the child as "
+        f"{ENV_HEARTBEAT}",
+    )
+    ap.add_argument(
+        "--heartbeat-timeout", type=float, default=0.0,
+        help="seconds of heartbeat staleness before the child is "
+        "killed and restarted (0 = off); must cover startup + one "
+        "epoch chunk + one XLA compile",
+    )
+    ap.add_argument("--backoff", type=float, default=1.0)
+    ap.add_argument(
+        "--no-restart-on", type=str, default="",
+        help="comma-separated exit codes that end the run immediately "
+        "(e.g. '2' for usage errors)",
+    )
+    args = ap.parse_args(opts)
+    if not cmd:
+        ap.error("no command after '--'")
+    no_restart = tuple(
+        int(c) for c in args.no_restart_on.split(",") if c.strip()
+    )
+    if args.heartbeat_timeout > 0 and not args.heartbeat:
+        ap.error("--heartbeat-timeout requires --heartbeat")
+    return run_supervised(
+        cmd,
+        max_restarts=args.max_restarts,
+        log_dir=args.log_dir,
+        heartbeat=args.heartbeat,
+        heartbeat_timeout=args.heartbeat_timeout,
+        backoff=args.backoff,
+        no_restart_on=no_restart,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,17 +154,48 @@ def init_distributed(
     if info.is_distributed and not _INITIALIZED:
         import jax
 
+        from tpu_hpc.logging_ import get_logger
+        from tpu_hpc.resilience.retry import retry_call
+
         if info.launcher in ("slurm", "tpu_pod"):
             # Full auto-detection: jax.distributed knows these clusters
             # natively and derives the coordinator from the scheduler's
             # own metadata (correct rank-0 node, bracketed nodelists).
-            jax.distributed.initialize()
+            kwargs = {}
         else:
-            jax.distributed.initialize(
+            kwargs = dict(
                 coordinator_address=info.coordinator_address,
                 num_processes=info.num_processes,
                 process_id=info.process_id,
             )
+        # Rendezvous is the flakiest moment of a pod job: worker VMs
+        # come up seconds apart and a restarted coordinator may still
+        # hold its old port. Bounded retry instead of one-shot
+        # (TPU_HPC_INIT_RETRIES extra attempts; per-host jittered
+        # backoff de-synchronizes the re-knocks).
+        def _initialize_once():
+            try:
+                jax.distributed.initialize(**kwargs)
+            except Exception:
+                # A failed rendezvous can leave the half-built client
+                # in jax's global state; without this reset every
+                # retry would die on "already initialized" instead of
+                # re-attempting the connection.
+                try:
+                    jax.distributed.shutdown()
+                except Exception:  # noqa: BLE001 - best-effort reset
+                    pass
+                raise
+
+        retry_call(
+            _initialize_once,
+            retries=int(os.environ.get("TPU_HPC_INIT_RETRIES", "2")),
+            base_delay=2.0, max_delay=30.0,
+            on_retry=lambda attempt, exc, delay: get_logger().warning(
+                "jax.distributed.initialize failed (attempt %d: %s); "
+                "retrying in %.1fs", attempt, exc, delay,
+            ),
+        )
         _INITIALIZED = True
     if verbose and info.process_id == 0:
         from tpu_hpc.logging_ import get_logger

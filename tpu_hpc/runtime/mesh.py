@@ -151,10 +151,16 @@ def build_mesh(
         # ICI-topology-aware layout: jax.make_mesh assigns axes onto the
         # physical torus so inner axes get the fastest links. Auto axis
         # types: the framework relies on GSPMD sharding propagation, not
-        # the newer explicit sharding-in-types mode.
-        return jax.make_mesh(
-            shape, names, axis_types=(jax.sharding.AxisType.Auto,) * len(names)
-        )
+        # the newer explicit sharding-in-types mode. AxisType only
+        # exists on newer jax (>= 0.5); older runtimes are implicitly
+        # Auto, so omit the kwarg there instead of crashing every
+        # mesh construction.
+        if hasattr(jax.sharding, "AxisType"):
+            return jax.make_mesh(
+                shape, names,
+                axis_types=(jax.sharding.AxisType.Auto,) * len(names),
+            )
+        return jax.make_mesh(shape, names)
     subset = list(devices[:total])
     if all(getattr(d, "platform", None) == "tpu" for d in subset):
         # Explicit TPU device subsets (pod sub-meshes, virtual-topology

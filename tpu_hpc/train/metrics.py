@@ -7,9 +7,10 @@ north-star metric, BASELINE.md) which the reference lacks.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 
 @dataclasses.dataclass
@@ -62,6 +63,64 @@ class ThroughputMeter:
     def reset(self) -> None:
         self.batch_times.clear()
         self.batch_items.clear()
+
+
+@dataclasses.dataclass
+class GoodputMeter:
+    """Goodput accounting: productive training wall-clock vs the
+    overheads resilience adds back (checkpoint saves, restore-on-
+    resume) and everything else (compile, restart tax).
+
+    "Goodput" in the hyperscale-fleet sense (the metric the 100k-GPU
+    collective paper's operators optimize): the fraction of a run's
+    wall-clock that advanced the model. A preempted-and-resumed run
+    reports it per attempt; summing ``productive_s`` across attempts
+    against total allocation time gives the fleet view. Buckets:
+
+    * ``productive_s`` -- time inside dispatched training chunks;
+    * ``ckpt_s``       -- checkpoint saves (incl. the emergency
+                          preemption snapshot) and waits;
+    * ``restore_s``    -- checkpoint restore on resume;
+    * ``other_s``      -- the remainder (XLA compile, data prep, the
+                          restart tax the supervisor's attempt gaps
+                          represent).
+    """
+
+    productive_s: float = 0.0
+    ckpt_s: float = 0.0
+    restore_s: float = 0.0
+    _t_start: float = dataclasses.field(
+        default_factory=time.monotonic
+    )
+
+    _KINDS = ("productive", "ckpt", "restore")
+
+    def add(self, kind: str, seconds: float) -> None:
+        if kind not in self._KINDS:
+            raise ValueError(
+                f"unknown goodput bucket {kind!r} (one of {self._KINDS})"
+            )
+        setattr(self, f"{kind}_s", getattr(self, f"{kind}_s") + seconds)
+
+    @contextlib.contextmanager
+    def measure(self, kind: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(kind, time.perf_counter() - t0)
+
+    def summary(self) -> Dict[str, float]:
+        total = time.monotonic() - self._t_start
+        accounted = self.productive_s + self.ckpt_s + self.restore_s
+        return {
+            "total_s": total,
+            "productive_s": self.productive_s,
+            "ckpt_s": self.ckpt_s,
+            "restore_s": self.restore_s,
+            "other_s": max(total - accounted, 0.0),
+            "goodput": self.productive_s / total if total > 0 else 0.0,
+        }
 
 
 def mfu(

@@ -28,7 +28,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_hpc.config import TrainingConfig
 from tpu_hpc.logging_ import get_logger
 from tpu_hpc.parallel.plans import derived_pspecs, shardings_for
-from tpu_hpc.train.metrics import ThroughputMeter
+from tpu_hpc.resilience.faults import fault_plan_from_env
+from tpu_hpc.resilience.heartbeat import (
+    ENV_HANG_TIMEOUT,
+    HangWatchdog,
+    Heartbeat,
+    current_attempt,
+)
+from tpu_hpc.resilience.signals import PreemptionGuard
+from tpu_hpc.train.metrics import GoodputMeter, ThroughputMeter
 
 
 class TrainState(struct.PyTreeNode):
@@ -506,6 +514,18 @@ class Trainer:
         self._eval_fns: Dict[Any, Callable] = {}
         self.meter = ThroughputMeter(n_devices=mesh.size)
         self._resumed = False
+        # Resilience wiring (tpu_hpc.resilience): goodput accounting
+        # always on (zero-cost counters); heartbeat/fault-injection
+        # arm themselves from the supervisor's env contract and are
+        # no-ops when unsupervised.
+        self.goodput = GoodputMeter()
+        self.heartbeat = Heartbeat.from_env()
+        self.fault_plan = fault_plan_from_env()
+        # Optional callable(state, step) run when a preemption notice
+        # stops the run, BEFORE the emergency snapshot -- the hook for
+        # recipe-level cleanup (flush custom logs, export metrics).
+        self.on_preempt: Optional[Callable[[Any, int], None]] = None
+        self._watchdog: Optional[HangWatchdog] = None
 
     # -- the HOT LOOP body lives in make_step_fn (SURVEY 3.1/3.4);
     # self._step_impl is bound in __init__ --
@@ -707,7 +727,8 @@ class Trainer:
         checkpoint exists (parity: multinode_ddp_basic.py:144-155)."""
         if self.checkpoint_manager is None or not self.cfg.resume:
             return 0
-        restored = self.checkpoint_manager.restore_latest(self.state)
+        with self.goodput.measure("restore"):
+            restored = self.checkpoint_manager.restore_latest(self.state)
         if restored is not None:
             self.state = restored
             step = int(jax.device_get(self.state.step))
@@ -743,27 +764,24 @@ class Trainer:
                 f"'cosine' sized for cfg.epochs={cfg.epochs}: set "
                 "cfg.epochs to the intended run length instead"
             )
+        # Per-fit accounting: the goodput record is an attempt-scoped
+        # trail; carrying buckets (or the wall-clock origin) across
+        # fits would misreport every fit after the first.
+        self.goodput = GoodputMeter()
         start_step = self.maybe_resume()
         # Preemption safety: TPU-VM spot/maintenance events deliver
         # SIGTERM with a short grace window. Snapshot-then-exit is the
         # recovery model (the reference's PBS-resubmission + snapshot
         # pattern, SURVEY 5.3): the relaunched job auto-resumes from
         # the saved step. Installed only around fit() and only when a
-        # checkpoint manager exists; chunk boundaries check the flag.
-        preempted = {"flag": False}
-        old_handler = None
-        handler_installed = False
-        if self.checkpoint_manager is not None:
-            import signal
-
-            def _on_sigterm(signum, frame):
-                preempted["flag"] = True
-
-            try:
-                old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
-                handler_installed = True
-            except ValueError:  # non-main thread: skip, keep training
-                pass
+        # checkpoint manager exists; chunk boundaries check the flag
+        # (PreemptionGuard handles the non-main-thread and
+        # restore-previous-disposition edge cases).
+        # (Guard install and watchdog start are deferred to just
+        # before the try/finally below: an exception in the remaining
+        # setup -- metrics I/O, profiler construction -- must not
+        # leak a signal handler or leave an un-ticked watchdog to
+        # os._exit the process while the real error propagates.)
         steps_per_epoch = cfg.steps_per_epoch
         total_steps = epochs * steps_per_epoch
         run_summaries = []
@@ -806,45 +824,79 @@ class Trainer:
                 cfg.profile_num_steps,
             )
         done = start_step
+        guard: Optional[PreemptionGuard] = None
+        if self.checkpoint_manager is not None:
+            guard = PreemptionGuard().install()
+        # Hang watchdog (supervisor env contract): a train_step or
+        # collective that stalls past the timeout aborts the process
+        # with stack dumps + EXIT_HANG instead of hanging the
+        # allocation. The timeout must cover one epoch chunk plus one
+        # XLA compile -- ticks happen at chunk boundaries. Started
+        # immediately before the try so the finally below is the only
+        # exit path with it running.
+        hang_timeout = float(
+            os.environ.get(ENV_HANG_TIMEOUT, "0") or 0
+        )
+        if hang_timeout > 0:
+            self._watchdog = HangWatchdog(
+                hang_timeout,
+                dump_path=os.path.join(
+                    self.cfg.checkpoint_dir or ".",
+                    f"hang.attempt{current_attempt()}.dump",
+                ),
+            ).start()
         try:
             last_metrics = self._fit_loop(
                 dataset, done, total_steps, steps_per_epoch, scanned,
-                prof, preempted, run_summaries,
+                prof, guard, run_summaries,
                 eval_dataset=eval_dataset, eval_steps=eval_steps,
             )
         finally:
             # Always restore the SIGTERM disposition -- a dataset/OOM
             # exception mid-loop must not leave the no-op flag handler
             # installed for the life of the process (a later real
-            # SIGTERM would then neither snapshot nor exit). Tracked by
-            # a flag, not old_handler's truthiness: signal.signal
-            # returns None when the previous handler was installed
-            # from C, and SIG_DFL is the honest restoration then.
-            if handler_installed:
-                import signal
-
-                signal.signal(
-                    signal.SIGTERM,
-                    old_handler if old_handler is not None
-                    else signal.SIG_DFL,
-                )
+            # SIGTERM would then neither snapshot nor exit).
+            if guard is not None:
+                guard.restore()
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
             if prof is not None:
                 prof.stop()
+        preempted = guard is not None and guard.triggered
+        goodput = self.goodput.summary()
+        if jax.process_index() == 0:
+            # Restart accounting: every fit appends one goodput record
+            # so a supervised, preempted-and-resumed run leaves an
+            # auditable productive-vs-overhead trail per attempt.
+            self._append_metrics({
+                "event": "run_end",
+                "time": time.time(),
+                "step": int(jax.device_get(self.state.step)),
+                "preempted": preempted,
+                "attempt": current_attempt(),
+                "resumed_from_step": start_step,
+                "goodput": goodput,
+            })
         return {
             "epochs": run_summaries,
             "final_loss": float(jax.device_get(last_metrics["loss"]))
             if last_metrics
             else None,
+            "preempted": preempted,
+            "goodput": goodput,
         }
 
     def _fit_loop(
         self, dataset, done, total_steps, steps_per_epoch, scanned,
-        prof, preempted, run_summaries,
+        prof, guard, run_summaries,
         eval_dataset=None, eval_steps=None,
     ):
         cfg = self.cfg
         last_metrics: Dict = {}
         while done < total_steps:
+            if self._watchdog is not None:
+                self._watchdog.tick()
             epoch = done // steps_per_epoch
             chunk = min(steps_per_epoch - done % steps_per_epoch,
                         total_steps - done)
@@ -861,6 +913,10 @@ class Trainer:
             if scanned:
                 epoch_fn = self._get_epoch_fn(dataset, chunk)
             jax.device_get(self.state.step)  # drain pending work
+            if self._watchdog is not None:
+                # Compile time (AOT, above) must not eat into the
+                # chunk's stall budget.
+                self._watchdog.tick()
             if prof is not None:
                 # Chunked loops advance a whole epoch per dispatch, so
                 # the window opens/closes at chunk boundaries.
@@ -873,7 +929,7 @@ class Trainer:
                 prof.annotate(done) if prof is not None
                 else contextlib.nullcontext()
             )
-            with ann:
+            with self.goodput.measure("productive"), ann:
                 if scanned:
                     self.state, stacked = epoch_fn(self.state)
                     last_metrics = jax.tree.map(lambda a: a[-1], stacked)
@@ -883,9 +939,15 @@ class Trainer:
                             done + i, cfg.global_batch_size
                         )
                         last_metrics = self.train_step(batch)
-            float(jax.device_get(last_metrics["loss"]))  # chunk barrier
+                # Chunk barrier INSIDE the productive window: the
+                # dispatched work isn't done until the fetch lands.
+                float(jax.device_get(last_metrics["loss"]))
             self.meter.end_batch(chunk * cfg.global_batch_size)
             done += chunk
+            if self._watchdog is not None:
+                self._watchdog.tick()
+            if self.heartbeat is not None:
+                self.heartbeat.tick(done)
             summary = self.meter.epoch_summary(skip_first=0)
             run_summaries.append(summary)
             if jax.process_index() == 0:
@@ -914,6 +976,12 @@ class Trainer:
                         jax.device_get(last_metrics["grad_norm"])
                     )
                 self._append_metrics(rec)
+            # Fault injection (no-op unless TPU_HPC_FAULTS is set):
+            # fires BEFORE the periodic save so a kill at step N
+            # leaves the previous checkpoint as the newest one -- the
+            # restart really re-trains the killed span.
+            if self.fault_plan is not None:
+                self.fault_plan.on_step(done)
             if eval_dataset is not None:
                 # evaluate() logs and appends its own 'eval' metrics
                 # record (host 0); runs on every host so any sharded
@@ -924,17 +992,28 @@ class Trainer:
                 and cfg.save_every
                 and done % (cfg.save_every * steps_per_epoch) == 0
             ):
-                self.checkpoint_manager.save(self.state)
-                self._snapshot_config()
-            if preempted["flag"]:
+                with self.goodput.measure("ckpt"):
+                    self.checkpoint_manager.save(self.state)
+                    self._snapshot_config()
+            if guard is not None and guard.triggered:
                 self.logger.warning(
-                    "SIGTERM received: snapshotting at step %d and "
-                    "stopping (relaunch auto-resumes with --resume)",
+                    "preemption notice (SIGTERM): snapshotting at "
+                    "step %d and stopping -- exit with "
+                    "resilience.EXIT_RESUMABLE; the relaunch "
+                    "auto-resumes with --resume",
                     done,
                 )
-                if done not in (self.checkpoint_manager.all_steps() or []):
-                    self.checkpoint_manager.save(self.state, force=True)
-                self._snapshot_config()
-                self.checkpoint_manager.wait()
+                if self.on_preempt is not None:
+                    self.on_preempt(self.state, done)
+                with self.goodput.measure("ckpt"):
+                    if done not in (
+                        self.checkpoint_manager.all_steps() or []
+                    ):
+                        # Emergency synchronous save: the grace window
+                        # may be seconds; save_now blocks until the
+                        # snapshot is durable.
+                        self.checkpoint_manager.save_now(self.state)
+                    self._snapshot_config()
+                    self.checkpoint_manager.wait()
                 break
         return last_metrics
