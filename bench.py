@@ -20,24 +20,13 @@ import json
 import os
 import sys
 
-# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-
 def peak_flops_per_chip(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for k, v in PEAK_FLOPS.items():
-        if kind.startswith(k):
-            return v
-    return 197e12  # conservative default (v5e class)
+    """Peak dense bf16 FLOP/s by device kind, from the single spec
+    table in checks/roofline.py; conservative v5e-class default for
+    unknown kinds so MFU never silently flatters."""
+    from tpu_hpc.checks.roofline import peak_flops_for_device
+
+    return peak_flops_for_device(device, default=197e12)
 
 
 def resolve_batch_accum(batch, accum, microbatch: int):
@@ -139,13 +128,11 @@ def bench_llama(
             block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
         )
 
-    tp_size = tp.auto_tp_degree(
+    axes = tp.auto_mesh_axes(
         n_dev, model_cfg.n_heads, model_cfg.kv_heads, cap=4
-    ) if n_dev > 1 else 1
-    dp_size = n_dev // tp_size
-    axes = {"data": dp_size}
-    if tp_size > 1:
-        axes["model"] = tp_size
+    )
+    dp_size = axes["data"]
+    tp_size = axes.get("model", 1)
     mesh = build_mesh(MeshSpec(axes=axes))
 
     params = llama2.init_llama(jax.random.key(0), model_cfg)
@@ -524,6 +511,71 @@ def bench_llama_pp(
     }
 
 
+def serve_record(summary: dict) -> dict:
+    """Serving summary -> the training-bench record schema
+    (metric/value/unit/vs_baseline), with the serving-native latency
+    quantiles riding along. vs_baseline = serving MFU (forward-only
+    2N accounting, train.metrics.mfu mode="inference") against the
+    same 40% north-star target the training rows use; None on
+    backends with no published peak (CPU sim)."""
+    mfu = summary.get("serve_mfu")
+    return {
+        "metric": "serve_tokens_per_s_per_chip",
+        "value": round(summary["tokens_per_s_per_chip"], 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 3) if mfu is not None else None,
+        "ttft_ms_p50": round(summary["ttft_ms_p50"], 2),
+        "ttft_ms_p95": round(summary["ttft_ms_p95"], 2),
+        "itl_ms_p50": round(summary["itl_ms_p50"], 2),
+        "itl_ms_p95": round(summary["itl_ms_p95"], 2),
+        "serve": {
+            "requests": summary["requests"],
+            "slots": summary["slots"],
+            "prefill_buckets": summary["prefill_buckets"],
+            "recompiles": summary["recompiles"],
+        },
+    }
+
+
+def bench_serve(
+    requests: int = 32, slots: int = 8, max_new: int = 64,
+    prompt_lens=(96, 192, 384), buckets=(128, 256, 512),
+    model_cfg=None,
+) -> dict:
+    """Batched-inference throughput: the SAME ~170M bench architecture
+    as the training headline (bench_model_cfg -- one factory, so
+    train and serve rows describe one model), run through the serving
+    engine's continuous batcher. Emits TTFT/ITL quantiles and
+    tokens/s/chip in the training-record schema; ``recompiles`` in the
+    record must read 0 -- the engine warms up every program shape
+    before the replay clock starts."""
+    import jax
+
+    from tpu_hpc.runtime import init_distributed
+    from tpu_hpc.serve.engine import ServeConfig
+    from tpu_hpc.serve.server import run_replay
+
+    init_distributed(verbose=False)
+    model_cfg = model_cfg or bench_model_cfg()
+    serve_cfg = ServeConfig(
+        slots=slots,
+        max_seq_len=max(buckets) + max_new,
+        prefill_buckets=tuple(buckets),
+    )
+    summary = run_replay(
+        model_cfg, serve_cfg, requests, prompt_lens, max_new
+    )
+    rec = serve_record(summary)
+    print(
+        f"serve | {summary['mesh']} slots={slots} | "
+        f"{summary['tokens_per_s']:.0f} tokens/s | "
+        f"TTFT p50 {summary['ttft_ms_p50']:.0f} ms | "
+        f"ITL p50 {summary['itl_ms_p50']:.1f} ms",
+        file=sys.stderr,
+    )
+    return rec
+
+
 def bench_unet(steps: int = 20) -> dict:
     import jax
     import jax.numpy as jnp
@@ -653,6 +705,7 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
         ("llama-pp interleaved-1f1b",
          ["--workload", "llama-pp", "--pp-schedule", "interleaved-1f1b"]),
         ("llama-long seq 8192", ["--workload", "llama-long"]),
+        ("serve (continuous batching)", ["--workload", "serve"]),
         ("unet ddp", ["--workload", "unet"]),
     ]
     rows, raw = [], []
@@ -722,9 +775,21 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(allow_abbrev=False)
     ap.add_argument(
         "--workload",
-        choices=("llama", "llama-sp", "llama-pp", "llama-long", "unet"),
-        default="llama",
+        choices=(
+            "llama", "llama-sp", "llama-pp", "llama-long", "unet",
+            "serve",
+        ),
+        default=None,  # resolved after --serve alias handling
     )
+    ap.add_argument(
+        "--serve", action="store_true",
+        help="alias for --workload serve: batched-inference "
+        "throughput (TTFT/ITL/tokens-per-s) on the bench model via "
+        "tpu_hpc.serve",
+    )
+    ap.add_argument("--serve-requests", type=int, default=32)
+    ap.add_argument("--serve-slots", type=int, default=8)
+    ap.add_argument("--serve-max-new", type=int, default=64)
     ap.add_argument(
         "--all", action="store_true",
         help="run every workload family, write BENCH_EXTRA.md/.jsonl",
@@ -804,24 +869,28 @@ def main(argv=None) -> int:
         "losing the allocation -- the shell-watchdog replacement)",
     )
     args = ap.parse_args(argv)
+    if args.serve:
+        if args.workload not in (None, "serve"):
+            # The alias must never silently replace an explicit
+            # conflicting request -- the record's metric name would
+            # not be the one the caller's pipeline expects.
+            ap.error(
+                f"--serve conflicts with --workload {args.workload}"
+            )
+        args.workload = "serve"
+    elif args.workload is None:
+        args.workload = "llama"
     if args.supervise:
-        from tpu_hpc.resilience.supervisor import run_supervised
+        from tpu_hpc.resilience.supervisor import (
+            run_supervised,
+            strip_flag,
+        )
 
-        raw = list(sys.argv[1:] if argv is None else argv)
         # Strip the flag (both "--supervise N" and "--supervise=N"):
         # the supervised child must run the bench itself.
-        child_args = []
-        skip = False
-        for a in raw:
-            if skip:
-                skip = False
-                continue
-            if a == "--supervise":
-                skip = True
-                continue
-            if a.startswith("--supervise="):
-                continue
-            child_args.append(a)
+        child_args = strip_flag(
+            list(sys.argv[1:] if argv is None else argv), "--supervise"
+        )
         return run_supervised(
             [sys.executable, os.path.abspath(__file__), *child_args],
             max_restarts=args.supervise,
@@ -881,6 +950,11 @@ def main(argv=None) -> int:
             moments_dtype=args.moments_dtype,
             block_q=args.block_q, block_k=args.block_k,
             block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
+        )
+    elif args.workload == "serve":
+        rec = bench_serve(
+            requests=args.serve_requests, slots=args.serve_slots,
+            max_new=args.serve_max_new,
         )
     else:
         rec = bench_unet(args.steps)

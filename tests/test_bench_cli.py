@@ -153,3 +153,56 @@ def test_records_carry_effective_flash_blocks(bench):
         "q": 256, "k": 512, "q_bwd": 128, "k_bwd": 256
     }
     assert bench.flash_blocks_record("xla", 512, 1024, None, None) == {}
+
+
+def test_serve_record_schema_matches_training_benches(bench):
+    """--serve artifacts must land in the same record schema every
+    training workload emits (metric/value/unit/vs_baseline), with the
+    serving-native latency quantiles riding along."""
+    summary = {
+        "tokens_per_s_per_chip": 123.456, "serve_mfu": 0.10,
+        "ttft_ms_p50": 25.0, "ttft_ms_p95": 40.0,
+        "itl_ms_p50": 8.0, "itl_ms_p95": 12.0,
+        "requests": 32, "slots": 8, "prefill_buckets": [128, 256],
+        "recompiles": 0,
+    }
+    rec = bench.serve_record(summary)
+    assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
+    assert rec["metric"] == "serve_tokens_per_s_per_chip"
+    assert rec["value"] == 123.5
+    assert rec["unit"] == "tokens/s/chip"
+    assert rec["vs_baseline"] == 0.25  # 0.10 MFU / 0.40 target
+    assert rec["ttft_ms_p50"] == 25.0 and rec["itl_ms_p95"] == 12.0
+    assert rec["serve"]["recompiles"] == 0
+    # No published peak (CPU sim) -> honest None, not a fake ratio.
+    no_mfu = bench.serve_record({**summary, "serve_mfu": None})
+    assert no_mfu["vs_baseline"] is None
+
+
+def test_serve_mode_routes_flags(bench, monkeypatch):
+    """Both spellings (--serve and --workload serve) reach bench_serve
+    with the serve-specific knobs."""
+    seen = {}
+
+    def fake_bench_serve(requests, slots, max_new):
+        seen.update(requests=requests, slots=slots, max_new=max_new)
+        return {"metric": "serve_tokens_per_s_per_chip", "value": 1,
+                "unit": "tokens/s/chip", "vs_baseline": None}
+
+    monkeypatch.setattr(bench, "bench_serve", fake_bench_serve)
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    rc = bench.main([
+        "--serve", "--serve-requests", "12", "--serve-slots", "4",
+        "--serve-max-new", "7",
+    ])
+    assert rc == 0
+    assert seen == {"requests": 12, "slots": 4, "max_new": 7}
+    seen.clear()
+    assert bench.main(["--workload", "serve"]) == 0
+    assert seen == {"requests": 32, "slots": 8, "max_new": 64}
+
+
+def test_serve_alias_conflicts_with_explicit_workload(bench, monkeypatch):
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "llama", "--serve"])

@@ -335,3 +335,62 @@ class TestPPLayout:
         assert sum(stash.act_bytes.values()) > \
             sum(remat.act_bytes.values())
         assert stash.static_bytes == remat.static_bytes
+
+
+class TestKVCacheTerm:
+    """Memory fit with a co-resident decode config: the serving
+    engine's preallocated KV cache is real HBM the training-only
+    analysis used to ignore."""
+
+    def test_formula_exact(self):
+        cfg = llama2.LlamaConfig(
+            dim=64, n_layers=3, n_heads=4, n_kv_heads=2,
+            vocab_size=128, multiple_of=16, max_seq_len=32,
+        )
+        # slots x seq x layers x kv_heads x head_dim x 2 (K,V) x bf16
+        want = 8 * 32 * 3 * 2 * 16 * 2 * 2
+        assert fit.kv_cache_bytes(cfg, 8) == want
+        # explicit capacity overrides the model's max_seq_len
+        assert fit.kv_cache_bytes(cfg, 8, max_seq_len=16) == want // 2
+        # fp32 cache doubles it
+        assert fit.kv_cache_bytes(
+            cfg, 8, cache_dtype="float32"
+        ) == 2 * want
+
+    @pytest.fixture(scope="class")
+    def with_kv(self, full_7b):
+        # Same mesh/batch as the module's full_7b fixture, plus a
+        # 64-slot decode config -- the pair the deltas below compare.
+        return fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False, kv_slots=64,
+        )
+
+    def test_analyze_adds_sharded_term_to_total(
+        self, full_7b, with_kv
+    ):
+        assert full_7b.kv_cache_bytes == 0
+        full = fit.kv_cache_bytes(full_7b.cfg, 64)
+        # 7B MHA: 32 kv heads shard over tp=8, 64 slots over dp=4.
+        assert with_kv.kv_cache_bytes == full // (4 * 8)
+        assert with_kv.total_bytes == \
+            full_7b.total_bytes + with_kv.kv_cache_bytes
+        assert with_kv.to_json()["kv_cache_bytes"] == \
+            with_kv.kv_cache_bytes
+
+    def test_indivisible_slots_stay_replicated(self):
+        cfg = llama2.LlamaConfig(
+            dim=64, n_layers=2, n_heads=8, n_kv_heads=8,
+            vocab_size=256, multiple_of=16, max_seq_len=64,
+        )
+        r = fit.analyze(
+            cfg, dp=4, tp_size=8, global_batch=8, seq_len=64,
+            do_compile=False, kv_slots=6,  # 6 % dp(4) != 0
+        )
+        # slots don't divide dp -> only the kv-head split applies.
+        assert r.kv_cache_bytes == fit.kv_cache_bytes(cfg, 6) // 8
+
+    def test_markdown_reports_the_row(self, full_7b, with_kv):
+        md = fit.to_markdown(with_kv)
+        assert "KV cache (decode, 64 slots)" in md
+        assert "KV cache" not in fit.to_markdown(full_7b)

@@ -78,3 +78,65 @@ class TestUNet:
         assert ms2 is ms  # eval does not mutate state
         out_eval2, _ = apply_unet(params, ms, x, cfg, train=False)
         np.testing.assert_array_equal(np.asarray(out_eval), np.asarray(out_eval2))
+
+
+class TestLlamaCacheBounds:
+    """The module-level lru_caches in models/llama2.py must be bounded
+    (a long-lived server sees many shapes/configs) and safe to evict:
+    every entry recomputes from its key alone."""
+
+    def test_caches_are_bounded(self):
+        from tpu_hpc.models import llama2
+
+        for fn in (
+            llama2._make_embed_lookup,
+            llama2.count_params,
+            llama2.count_params_by_part,
+        ):
+            assert fn.cache_info().maxsize == llama2._CACHE_MAXSIZE
+
+    def test_embed_lookup_eviction_is_value_safe(self):
+        from tpu_hpc.models import llama2
+
+        table = jnp.arange(12, dtype=jnp.float32).reshape(6, 2)
+        tokens = jnp.asarray([[1, 4, 4]], jnp.int32)
+        before = llama2._make_embed_lookup(6, "float32")
+        want = np.asarray(before(table, tokens))
+        # steady state: the same key returns the SAME callable (stable
+        # jit identity -- no retrace between calls)
+        assert llama2._make_embed_lookup(6, "float32") is before
+        # force eviction with > maxsize fresh keys
+        for v in range(1000, 1000 + llama2._CACHE_MAXSIZE + 4):
+            llama2._make_embed_lookup(v, "float32")
+        after = llama2._make_embed_lookup(6, "float32")
+        assert after is not before  # evicted -> rebuilt...
+        np.testing.assert_array_equal(
+            np.asarray(after(table, tokens)), want
+        )  # ...but value-identical, gradient factory included
+        g = jax.grad(
+            lambda t: after(t, tokens).sum()
+        )(table)
+        assert g.shape == table.shape
+        np.testing.assert_array_equal(
+            np.asarray(g[4]), np.asarray([2.0, 2.0])
+        )
+
+    def test_count_params_eviction_recomputes_identically(self):
+        from tpu_hpc.models import llama2
+
+        cfg = llama2.LlamaConfig(
+            dim=32, n_layers=1, n_heads=2, vocab_size=64,
+            multiple_of=16, max_seq_len=16,
+        )
+        n = llama2.count_params(cfg)
+        assert llama2.count_params.cache_info().currsize <= \
+            llama2._CACHE_MAXSIZE
+        # Eviction = the entry disappears and the next call recomputes
+        # from the key alone; cache_clear IS that removal, without
+        # paying maxsize eval_shape calls to churn it out naturally.
+        llama2.count_params.cache_clear()
+        llama2.count_params_by_part.cache_clear()
+        assert llama2.count_params(cfg) == n
+        parts = llama2.count_params_by_part(cfg)
+        assert parts["per_layer"] * cfg.n_layers + parts["embed"] \
+            + parts["head"] + parts["other"] == n

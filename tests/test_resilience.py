@@ -635,3 +635,68 @@ class TestFaultHelpers:
         faults.corrupt_file(str(p))
         data = p.read_bytes()
         assert data == b"\x00TPU_HPC_FAULT_CORRUPTED\x00"
+
+
+def _preempt_gated_cmd(threshold: int):
+    """A child that takes a clean preemption snapshot (EXIT_RESUMABLE)
+    until TPU_HPC_ATTEMPT >= threshold."""
+    return [
+        sys.executable, "-c",
+        "import os, sys; "
+        f"sys.exit(0 if int(os.environ['TPU_HPC_ATTEMPT']) >= "
+        f"{threshold} else 75)",
+    ]
+
+
+class TestResumableBudgetCarveOut:
+    def test_preemptions_do_not_burn_the_failure_budget(self, tmp_path):
+        """signals.py contract: EXIT_RESUMABLE means 'nothing is
+        wrong, relaunch me' -- three preemptions must ride through a
+        max_restarts=1 supervisor and still reach success."""
+        rc = run_supervised(
+            _preempt_gated_cmd(3), max_restarts=1,
+            log_dir=str(tmp_path), backoff=0.01,
+        )
+        assert rc == 0
+        events = [
+            json.loads(x)
+            for x in open(os.path.join(str(tmp_path), "supervisor.jsonl"))
+        ]
+        ends = [e for e in events if e["event"] == "attempt_end"]
+        assert [e["rc"] for e in ends] == [75, 75, 75, 0]
+        restarts = [e for e in events if e["event"] == "restarting"]
+        assert all(
+            e.get("why") == "resumable preemption snapshot"
+            for e in restarts
+        )
+
+    def test_crashes_still_bounded(self, tmp_path):
+        rc = run_supervised(
+            [sys.executable, "-c", "import sys; sys.exit(3)"],
+            max_restarts=1, log_dir=str(tmp_path), backoff=0.01,
+        )
+        assert rc == 3
+        events = [
+            json.loads(x)
+            for x in open(os.path.join(str(tmp_path), "supervisor.jsonl"))
+        ]
+        ends = [e for e in events if e["event"] == "attempt_end"]
+        assert [e["rc"] for e in ends] == [3, 3]  # 1 restart, then stop
+
+    def test_preemption_cap_bounds_the_loop(self, tmp_path):
+        """The carve-out is generous, not infinite: a preemption
+        cadence outpacing checkpoints must eventually give up."""
+        rc = run_supervised(
+            [sys.executable, "-c", "import sys; sys.exit(75)"],
+            max_restarts=5, max_preemptions=2,
+            log_dir=str(tmp_path), backoff=0.01,
+        )
+        assert rc == 75
+        events = [
+            json.loads(x)
+            for x in open(os.path.join(str(tmp_path), "supervisor.jsonl"))
+        ]
+        ends = [e for e in events if e["event"] == "attempt_end"]
+        assert [e["rc"] for e in ends] == [75, 75, 75]  # cap + 1 runs
+        give = [e for e in events if e["event"] == "giving_up"]
+        assert "preemption budget" in give[0]["why"]

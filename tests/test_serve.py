@@ -1,0 +1,461 @@
+"""The serving engine: KV-cache correctness, continuous batching, and
+the zero-recompile steady state.
+
+Three invariant families:
+  * **parity** -- greedy decode THROUGH the cache is token-exact
+    against the no-cache full forward pass (llama2.apply_llama), the
+    oracle that pins the functional replay in serve/engine.py to the
+    training model's math;
+  * **slot invariants** -- evict/admit mid-stream reuses slots safely
+    (stale cache rows unreachable behind the per-slot length mask),
+    position counters track prompt + generated and feed RoPE;
+  * **compile discipline** -- after warmup, a replayed request mix
+    touching every program shape triggers ZERO new compiles (the
+    engine's executable-table counter is the guard).
+
+All on the 8-device simulated mesh (data=4 x model=2: batch slots
+shard over data, KV heads over the TP axis), fp32 compute so
+"token-exact" means exact.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hpc.models import llama2
+from tpu_hpc.runtime import MeshSpec, build_mesh
+from tpu_hpc.serve import (
+    ContinuousBatcher,
+    Engine,
+    Request,
+    ServeConfig,
+    ServeMeter,
+)
+from tpu_hpc.serve.engine import kv_cache_pspec
+
+
+TINY = llama2.LlamaConfig(
+    dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+    multiple_of=16, max_seq_len=64, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def serve_mesh(devices):
+    return build_mesh(MeshSpec(axes={"data": 4, "model": 2}))
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama2.init_llama(jax.random.key(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def warm_engine(tiny_params, serve_mesh):
+    engine = Engine(
+        tiny_params, TINY,
+        ServeConfig(slots=4, max_seq_len=48, prefill_buckets=(8, 16)),
+        serve_mesh,
+    )
+    engine.warmup()
+    return engine
+
+
+_ORACLE_LEN = 32  # fixed oracle shape; covers every test's prompt+new
+
+
+@pytest.fixture(scope="module")
+def greedy_oracle(tiny_params):
+    """Greedy continuation via the full NO-CACHE forward pass
+    (llama2.apply_llama -- the training model, not engine code).
+
+    Jitted once at a fixed padded length: under the causal mask,
+    logits at row i depend only on tokens <= i, so reading row
+    ``len-1`` of a zero-padded [1, 32] forward is exactly the
+    unpadded full forward -- one compile serves every prompt length
+    in the file."""
+    fwd = jax.jit(
+        lambda toks: llama2.apply_llama(tiny_params, toks, TINY)
+    )
+
+    def oracle(params, prompt, steps):
+        assert params is tiny_params  # one param tree per module
+        toks = list(prompt)
+        out = []
+        for _ in range(steps):
+            assert len(toks) <= _ORACLE_LEN
+            padded = np.zeros((1, _ORACLE_LEN), np.int32)
+            padded[0, :len(toks)] = toks
+            logits = fwd(jnp.asarray(padded))
+            t = int(jnp.argmax(logits[0, len(toks) - 1]))
+            out.append(t)
+            toks.append(t)
+        return out
+
+    return oracle
+
+
+class TestGreedyParity:
+    def test_single_request_token_exact(
+        self, warm_engine, tiny_params, greedy_oracle
+    ):
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, TINY.vocab_size, size=11).tolist()
+        got = ContinuousBatcher(warm_engine).run(
+            [Request(rid="a", prompt=prompt, max_new_tokens=4)]
+        )["a"]
+        assert got == greedy_oracle(tiny_params, prompt, 4)
+
+    def test_prompt_of_one_token(
+        self, warm_engine, tiny_params, greedy_oracle
+    ):
+        got = ContinuousBatcher(warm_engine).run(
+            [Request(rid="a", prompt=[5], max_new_tokens=4)]
+        )["a"]
+        assert got == greedy_oracle(tiny_params, [5], 4)
+
+    def test_both_buckets_agree_with_oracle(
+        self, warm_engine, tiny_params, greedy_oracle
+    ):
+        # Lengths straddling the bucket boundary: 7 pads to bucket 8,
+        # 9 to bucket 16 -- padding must not leak into the logits.
+        rng = np.random.default_rng(1)
+        for n in (7, 9, 16):
+            prompt = rng.integers(0, TINY.vocab_size, size=n).tolist()
+            got = ContinuousBatcher(warm_engine).run(
+                [Request(rid="a", prompt=prompt, max_new_tokens=2)]
+            )["a"]
+            assert got == greedy_oracle(tiny_params, prompt, 2), n
+
+
+class TestContinuousBatching:
+    def test_mixed_stream_matches_solo_oracles(
+        self, warm_engine, tiny_params, greedy_oracle
+    ):
+        """Staggered lengths force mid-stream evictions and
+        re-admissions; every request must still generate exactly its
+        solo greedy continuation (slots are isolated)."""
+        rng = np.random.default_rng(2)
+        shapes = [(5, 3), (11, 6), (7, 1), (13, 4), (4, 5), (9, 2)]
+        reqs = [
+            Request(
+                rid=f"r{i}",
+                prompt=rng.integers(
+                    0, TINY.vocab_size, size=plen
+                ).tolist(),
+                max_new_tokens=mnew,
+            )
+            for i, (plen, mnew) in enumerate(shapes)
+        ]
+        batcher = ContinuousBatcher(warm_engine)
+        got = batcher.run(reqs)
+        for r in reqs:
+            assert got[r.rid] == greedy_oracle(
+                tiny_params, r.prompt, r.max_new_tokens
+            ), r.rid
+        # 6 requests through 4 slots: reuse actually happened.
+        assert batcher.stats["admitted"] == len(shapes)
+        assert batcher.stats["admitted"] > warm_engine.serve_cfg.slots
+        assert batcher.stats["evicted"] == len(shapes)
+
+    def test_position_counters_track_prompt_plus_generated(
+        self, warm_engine
+    ):
+        batcher = ContinuousBatcher(warm_engine)
+        batcher.submit(Request(rid="a", prompt=[1, 2, 3],
+                               max_new_tokens=5))
+        batcher.step()  # admit (prefill -> 1 token) + 1 decode
+        assert batcher.slot_positions()[0] == 4  # 3 prompt + 1 decoded
+        batcher.step()
+        assert batcher.slot_positions()[0] == 5
+        batcher.run()  # drain
+        assert len(batcher.results["a"]) == 5
+
+    def test_eos_stops_early(
+        self, warm_engine, tiny_params, greedy_oracle
+    ):
+        prompt = [3, 1, 4, 1, 5]
+        free_run = greedy_oracle(tiny_params, prompt, 6)
+        eos = free_run[2]
+        got = ContinuousBatcher(warm_engine).run([
+            Request(rid="a", prompt=prompt, max_new_tokens=6,
+                    eos_id=eos)
+        ])["a"]
+        # Cut at (and including) the FIRST occurrence of the EOS id.
+        assert got == free_run[:free_run.index(eos) + 1]
+
+    def test_capacity_and_validation_errors(self, warm_engine):
+        batcher = ContinuousBatcher(warm_engine)
+        with pytest.raises(ValueError, match="cache capacity"):
+            batcher.submit(
+                Request(rid="big", prompt=[1] * 16, max_new_tokens=40)
+            )
+        # Oversized prompt fails at SUBMIT, not mid-drain where it
+        # would abort every other in-flight request.
+        with pytest.raises(ValueError, match="largest"):
+            batcher.submit(
+                Request(rid="wide", prompt=[1] * 17, max_new_tokens=2)
+            )
+        with pytest.raises(ValueError, match="empty prompt"):
+            Request(rid="e", prompt=[], max_new_tokens=1)
+        with pytest.raises(ValueError, match="exceeds the largest"):
+            warm_engine.prefill(0, list(range(17)))
+        batcher.submit(Request(rid="a", prompt=[1], max_new_tokens=1))
+        with pytest.raises(ValueError, match="duplicate"):
+            batcher.submit(
+                Request(rid="a", prompt=[1], max_new_tokens=1)
+            )
+
+
+class TestCompileDiscipline:
+    def test_warm_engine_serves_mix_with_zero_recompiles(
+        self, warm_engine
+    ):
+        """The acceptance guard: a replayed request mix hitting every
+        bucket and forcing slot churn adds NO executables after
+        warmup."""
+        warmed = warm_engine.compile_count
+        assert warmed == 3  # two prefill buckets + one decode program
+        rng = np.random.default_rng(3)
+        reqs = [
+            Request(
+                rid=f"m{i}",
+                prompt=rng.integers(
+                    0, TINY.vocab_size, size=4 + (5 * i) % 13
+                ).tolist(),
+                max_new_tokens=1 + i % 5,
+            )
+            for i in range(9)
+        ]
+        ContinuousBatcher(warm_engine).run(reqs)
+        assert warm_engine.compile_count == warmed
+
+    def test_cache_layout_on_mesh(self, warm_engine, serve_mesh):
+        # Slots shard over data, KV heads over model; the resident
+        # cache must actually carry that sharding.
+        spec = kv_cache_pspec(serve_mesh, 4, TINY.kv_heads)
+        assert spec == jax.sharding.PartitionSpec(
+            None, "data", None, "model", None
+        )
+        assert warm_engine.ks.sharding.spec == spec
+        assert warm_engine.vs.sharding.spec == spec
+        assert warm_engine.cache_bytes == (
+            2 * TINY.n_layers * 4 * 48 * TINY.kv_heads
+            * TINY.head_dim * 4  # fp32 cache follows the compute dtype
+        )
+
+    def test_bucket_selection(self):
+        scfg = ServeConfig(
+            slots=2, max_seq_len=64, prefill_buckets=(16, 8, 32)
+        )
+        assert scfg.prefill_buckets == (8, 16, 32)  # sorted
+        assert scfg.bucket_for(1) == 8
+        assert scfg.bucket_for(9) == 16
+        assert scfg.bucket_for(32) == 32
+        with pytest.raises(ValueError, match="largest"):
+            scfg.bucket_for(33)
+        with pytest.raises(ValueError, match="exceed the cache"):
+            ServeConfig(slots=2, max_seq_len=16, prefill_buckets=(32,))
+
+
+class TestServingWeights:
+    def test_trainer_checkpoint_restores_into_serving_layout(
+        self, tiny_params, serve_mesh, tmp_path
+    ):
+        """Save a TrainState in the TRAINING (FSDPxTP) layout, restore
+        via load_serving_params: values identical, layout = the
+        serving plan (TP over model, replicated over data)."""
+        from tpu_hpc.ckpt import CheckpointManager
+        from tpu_hpc.parallel import hybrid, tp
+        from tpu_hpc.parallel.plans import shardings_for
+        from tpu_hpc.serve.weights import (
+            load_serving_params,
+            serving_pspecs,
+        )
+        from tpu_hpc.train.trainer import TrainState, make_adamw
+
+        specs = hybrid.hybrid_pspecs(
+            tiny_params, tp.llama_rules(), data_size=4, min_size=100
+        )
+        placed = jax.jit(
+            lambda t: t,
+            out_shardings=shardings_for(serve_mesh, specs),
+        )(tiny_params)
+        opt = make_adamw(3e-4, 0.1)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=placed,
+            opt_state=opt.init(placed),
+            model_state={},
+        )
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save_now(state, step=3)
+        mgr.close()
+
+        served = load_serving_params(str(tmp_path), TINY, serve_mesh)
+        for a, b in zip(
+            jax.tree.leaves(tiny_params), jax.tree.leaves(served)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        want = serving_pspecs(tiny_params, serve_mesh)
+        for leaf, spec in zip(
+            jax.tree.leaves(served),
+            jax.tree.leaves(
+                want,
+                is_leaf=lambda x: isinstance(
+                    x, jax.sharding.PartitionSpec
+                ),
+            ),
+        ):
+            assert leaf.sharding.spec == spec
+
+    def test_missing_checkpoint_raises(self, serve_mesh, tmp_path):
+        from tpu_hpc.serve.weights import load_serving_params
+
+        with pytest.raises(FileNotFoundError):
+            load_serving_params(
+                str(tmp_path / "nothing"), TINY, serve_mesh
+            )
+
+    def test_opt_state_template_restores_sharded(self, serve_mesh):
+        """The discarded AdamW moments still transit HBM during the
+        restore; at real model sizes a replicated template would OOM
+        every chip, so large moment leaves must carry a distributed
+        sharding in the restore template."""
+        from tpu_hpc.serve.weights import (
+            abstract_train_state,
+            serving_pspecs,
+        )
+
+        cfg = llama2.PRESETS["7b"]
+        abstract = jax.eval_shape(
+            lambda: llama2.init_llama(jax.random.key(0), cfg)
+        )
+        tmpl = abstract_train_state(
+            cfg, serve_mesh, serving_pspecs(abstract, serve_mesh)
+        )
+        big = [
+            leaf for leaf in jax.tree.leaves(tmpl.opt_state)
+            if int(np.prod(leaf.shape)) >= 100_000
+        ]
+        assert big, "7B AdamW state has large moment leaves"
+        for leaf in big:
+            assert any(
+                e is not None for e in leaf.sharding.spec
+            ), f"moment leaf {leaf.shape} left replicated"
+
+
+class TestReplayServerCLI:
+    def test_main_runs_replay_and_prints_summary(self, capsys):
+        """The `python -m tpu_hpc.serve` wiring end-to-end on the sim
+        mesh (the exact configuration launch/README.md points at):
+        flag parsing, mesh bring-up, warmup, drain, summary JSON."""
+        from tpu_hpc.serve import server
+
+        rc = server.main([
+            "--requests", "3", "--max-new", "2", "--slots", "2",
+            "--buckets", "8", "--prompt-lens", "3,6", "--vocab", "64",
+        ])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.splitlines()[-1])
+        assert summary["requests"] == 3
+        assert summary["tokens"] == 6
+        assert summary["recompiles"] == 0
+        assert summary["batcher"]["admitted"] == 3
+        assert summary["compiled_programs"] == 2  # 1 bucket + decode
+
+    def test_main_rejects_prompt_longer_than_buckets(self):
+        from tpu_hpc.serve import server
+
+        with pytest.raises(SystemExit):
+            server.main([
+                "--buckets", "8", "--prompt-lens", "9",
+            ])
+
+
+class TestServeMetrics:
+    def test_meter_records_and_summary(self, tmp_path):
+        import time
+
+        path = str(tmp_path / "serve.jsonl")
+        meter = ServeMeter(metrics_path=path)
+        for rid in ("a", "b"):
+            meter.submitted(rid)
+            time.sleep(0.002)  # queue wait: must show up in TTFT
+            meter.admitted(rid)
+            meter.token(rid, first=True)
+            time.sleep(0.002)
+            meter.token(rid)
+            meter.finished(rid)
+        s = meter.summary(n_devices=8)
+        assert s["requests"] == 2 and s["tokens"] == 4
+        assert s["tokens_per_s"] > 0
+        assert s["tokens_per_s_per_chip"] == pytest.approx(
+            s["tokens_per_s"] / 8
+        )
+        assert s["ttft_ms_p50"] >= 0 and s["itl_ms_p50"] > 0
+        meter.write_summary(s)
+        records = [
+            json.loads(l)
+            for l in open(path).read().splitlines()
+        ]
+        events = [r["event"] for r in records]
+        assert events == ["request", "request", "serve_summary"]
+        for r in records[:2]:
+            # TTFT from SUBMISSION: the queue wait is inside it.
+            assert r["ttft_ms"] >= r["queue_ms"] > 0
+
+    def test_serving_mfu_counts_prefill_and_decode_tokens(self):
+        s = ServeMeter()
+        s.admitted("a", prefill_tokens=10)
+        s.token("a", first=True)
+        summary = s.summary(
+            n_devices=1, n_params=10**9,
+            peak_flops_per_device=100e12,
+        )
+        from tpu_hpc.train.metrics import mfu
+
+        # throughput = GENERATED tokens; MFU = ALL forwarded tokens
+        # (padded prefill + generated) on the 2N inference estimate.
+        assert summary["tokens"] == 1
+        assert summary["prefill_tokens"] == 10
+        forwarded_per_s = (1 + 10) / summary["wall_s"]
+        assert summary["serve_mfu"] == pytest.approx(
+            mfu(forwarded_per_s, 10**9, 1, 100e12, mode="inference")
+        )
+        assert summary["serve_mfu"] > mfu(
+            summary["tokens_per_s"], 10**9, 1, 100e12,
+            mode="inference",
+        )
+
+
+class TestMfuModes:
+    def test_inference_mode_is_one_third_of_train(self):
+        # Same throughput, 2N vs 6N: inference MFU must read exactly
+        # 3x lower FLOPs -> 1/3 of the train number.
+        from tpu_hpc.train.metrics import mfu
+
+        t = mfu(1e5, 7e9, 8, 197e12, mode="train")
+        i = mfu(1e5, 7e9, 8, 197e12, mode="inference")
+        assert t == pytest.approx(3 * i)
+
+    def test_default_stays_train_and_bad_mode_rejected(self):
+        from tpu_hpc.train.metrics import mfu
+
+        assert mfu(1e5, 7e9, 8, 197e12) == mfu(
+            1e5, 7e9, 8, 197e12, mode="train"
+        )
+        with pytest.raises(ValueError, match="unknown mfu mode"):
+            mfu(1e5, 7e9, 8, 197e12, mode="decode")
+
+    def test_attn_flops_add_on_in_both_modes(self):
+        from tpu_hpc.train.metrics import mfu
+
+        base = mfu(1e5, 7e9, 8, 197e12, mode="inference")
+        more = mfu(
+            1e5, 7e9, 8, 197e12, attn_flops_per_token=2e9,
+            mode="inference",
+        )
+        assert more > base

@@ -82,6 +82,27 @@ def tree_bytes_per_chip(abstract: Any, specs: Any, mesh_axes: Dict[str, int]) ->
     return total
 
 
+def kv_cache_bytes(
+    cfg: llama2.LlamaConfig,
+    batch_slots: int,
+    max_seq_len: Optional[int] = None,
+    cache_dtype: str = "bfloat16",
+) -> int:
+    """Per-POD bytes of a decode KV cache: batch_slots x seq x layers
+    x kv_heads x head_dim x 2 (K and V) x dtype. The term the serving
+    engine preallocates (tpu_hpc/serve/engine.py) and the memory-fit
+    analysis previously ignored -- at 70B GQA with 4k context and 64
+    slots this is ~80 GiB, not a rounding error. Divide by the mesh
+    extents sharding the cache (slots over data, kv_heads over model)
+    for the per-chip share; analyze() does that with its own mesh."""
+    s = max_seq_len if max_seq_len is not None else cfg.max_seq_len
+    itemsize = jnp.dtype(cache_dtype).itemsize
+    return (
+        batch_slots * s * cfg.n_layers * cfg.kv_heads * cfg.head_dim
+        * 2 * itemsize
+    )
+
+
 @dataclasses.dataclass
 class FitResult:
     cfg: llama2.LlamaConfig
@@ -108,6 +129,8 @@ class FitResult:
     compiler_options: Dict[str, str] = dataclasses.field(
         default_factory=dict
     )
+    kv_cache_bytes: int = 0      # per chip, decode-config KV cache
+    kv_slots: int = 0            # decode batch slots the term assumes
 
     @property
     def static_bytes(self) -> int:
@@ -115,7 +138,10 @@ class FitResult:
 
     @property
     def total_bytes(self) -> int:
-        return self.static_bytes + sum(self.act_bytes.values())
+        return (
+            self.static_bytes + sum(self.act_bytes.values())
+            + self.kv_cache_bytes
+        )
 
     @property
     def fits(self) -> bool:
@@ -487,6 +513,9 @@ def analyze(
     moments_dtype: str = "float32",
     layout: str = "tp",
     pp_backward: str = "remat",
+    kv_slots: int = 0,
+    kv_seq_len: Optional[int] = None,
+    kv_cache_dtype: str = "bfloat16",
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
 
@@ -538,6 +567,22 @@ def analyze(
             f"{global_batch} into microbatches divisible by dp {dp}"
         )
 
+    # Decode-config KV-cache term (``kv_slots > 0``): what a serving
+    # engine co-resident with this config would preallocate
+    # (tpu_hpc/serve/engine.py). Sharded like the engine shards it --
+    # slots over data, KV heads over the model axis -- when the
+    # extents divide; otherwise that dimension is replicated.
+    kv_bytes_chip = 0
+    if kv_slots:
+        full = kv_cache_bytes(cfg, kv_slots, kv_seq_len, kv_cache_dtype)
+        denom = 1
+        if dp > 1 and kv_slots % dp == 0:
+            denom *= dp
+        if layout == "tp" and tp_size > 1 \
+                and cfg.kv_heads % tp_size == 0:
+            denom *= tp_size
+        kv_bytes_chip = -(-full // denom)
+
     if layout == "pp":
         # The stage-shard byte accounting mirrors pp.stage_pspecs
         # (params stage-local, replicated over data -- the PP x DP
@@ -564,6 +609,8 @@ def analyze(
             moments_dtype=moments_dtype,
             layout="pp",
             attn=attn,
+            kv_cache_bytes=kv_bytes_chip,
+            kv_slots=kv_slots,
         )
         result.compiler_options = dict(compiler_options or {})
         if not do_compile:
@@ -625,6 +672,8 @@ def analyze(
         grad_accum=grad_accum,
         moments_dtype=moments_dtype,
         layout=layout,
+        kv_cache_bytes=kv_bytes_chip,
+        kv_slots=kv_slots,
     )
     if attn not in ("xla", "flash"):
         raise ValueError(f"unknown attn {attn!r} (xla|flash)")
@@ -777,6 +826,11 @@ def to_markdown(r: FitResult) -> str:
     ]
     for name, b in r.act_bytes.items():
         lines.append(f"| activations: {name} | {b:,} | {b/GIB:.2f} |")
+    if r.kv_cache_bytes:
+        lines.append(
+            f"| KV cache (decode, {r.kv_slots} slots) | "
+            f"{r.kv_cache_bytes:,} | {r.kv_cache_bytes/GIB:.2f} |"
+        )
     lines += [
         f"| **total** | **{r.total_bytes:,}** | "
         f"**{r.total_bytes/GIB:.2f}** |",
@@ -785,7 +839,12 @@ def to_markdown(r: FitResult) -> str:
         f"**{'FITS' if r.fits else 'DOES NOT FIT'}** "
         f"({r.total_bytes/ (r.hbm_gib*GIB) * 100:.1f}% of HBM; "
         f"static {r.static_bytes/GIB:.2f} GiB + activations "
-        f"{act_total/GIB:.2f} GiB).",
+        f"{act_total/GIB:.2f} GiB"
+        + (
+            f" + decode KV cache {r.kv_cache_bytes/GIB:.2f} GiB"
+            if r.kv_cache_bytes else ""
+        )
+        + ").",
         "",
         "Static accounting is exact (eval_shape + the PartitionSpec "
         "plan); the activation rows are the analytic model described "
@@ -1005,6 +1064,17 @@ def main(argv=None) -> int:
                         "saves stage inputs only; stash adds the vjp-"
                         "residual buffers (Megatron-style) to the HBM "
                         "model")
+    parser.add_argument("--kv-slots", type=int, default=0,
+                        help="add a decode-config KV-cache term: "
+                        "batch slots of a co-resident serving engine "
+                        "(0 = no serving, the training-only budget)")
+    parser.add_argument("--kv-seq-len", type=int, default=None,
+                        help="KV-cache capacity per slot "
+                        "(default: the model's max_seq_len)")
+    parser.add_argument("--kv-cache-dtype",
+                        choices=("bfloat16", "float32"),
+                        default="bfloat16",
+                        help="KV-cache storage dtype")
     parser.add_argument("--xla-opt", action="append", default=[],
                         metavar="KEY=VALUE",
                         help="extra XLA compiler option for the "
@@ -1064,6 +1134,9 @@ def main(argv=None) -> int:
         moments_dtype=args.moments_dtype,
         layout="pp" if args.pp else ("cp" if args.cp else "tp"),
         pp_backward=args.pp_backward,
+        kv_slots=args.kv_slots,
+        kv_seq_len=args.kv_seq_len,
+        kv_cache_dtype=args.kv_cache_dtype,
     )
     md = to_markdown(r)
     if args.markdown:

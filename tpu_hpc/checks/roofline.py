@@ -70,6 +70,31 @@ CHIPS: Dict[str, ChipSpec] = {
     "v6e": ChipSpec("v6e", 918e12, 32, 1640, 90, 12.5),
 }
 
+# jax Device.device_kind spellings -> CHIPS key, longest prefix first
+# ("TPU v5 lite" must resolve before "TPU v5"). The single source both
+# bench.py (training MFU) and serve/server.py (serving MFU) divide by
+# -- two copies of the spec table would let the two MFUs silently
+# disagree the day a new generation lands in only one.
+_DEVICE_KIND_PREFIXES = (
+    ("TPU v5 lite", "v5e"),
+    ("TPU v5e", "v5e"),
+    ("TPU v6 lite", "v6e"),
+    ("TPU v6e", "v6e"),
+    ("TPU v5p", "v5p"),
+    ("TPU v5", "v5p"),
+    ("TPU v4", "v4"),
+)
+
+
+def peak_flops_for_device(device, default=None):
+    """Peak dense bf16 FLOP/s for a jax device, by device_kind prefix;
+    ``default`` for unknown kinds (CPU sim, future chips)."""
+    kind = getattr(device, "device_kind", "")
+    for prefix, key in _DEVICE_KIND_PREFIXES:
+        if kind.startswith(prefix):
+            return CHIPS[key].peak_bf16_flops
+    return default
+
 
 def _ring_collective_s(bytes_full: int, n: int, bw_gbps: float) -> float:
     """Ring all-gather/reduce-scatter time: every chip sends/receives

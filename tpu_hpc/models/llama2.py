@@ -46,11 +46,25 @@ def _identity(x: jax.Array) -> jax.Array:
     return x
 
 
-@functools.lru_cache(maxsize=None)
+# Long-lived processes (the serving engine, multi-config sweeps) keep
+# hitting these module-level caches with fresh keys; unbounded, they
+# grow for the life of the process. The bounds are sized far above any
+# real working set (a server runs ONE config; a sweep runs a handful),
+# so steady state never evicts -- and eviction is SAFE anyway: each
+# entry is recomputed from its key alone. The one subtlety is
+# _make_embed_lookup, whose cache also provides function identity --
+# an evicted-and-rebuilt lookup is a new callable, so a jit tracing it
+# recompiles (correctness unaffected; tests/test_models.py pins both
+# properties).
+_CACHE_MAXSIZE = 64
+
+
+@functools.lru_cache(maxsize=_CACHE_MAXSIZE)
 def _make_embed_lookup(vocab: int, table_dtype: str):
     """table[tokens] with a scatter-free backward (see
     LlamaConfig.iota_embed). Factory keyed on the static (vocab,
-    dtype) so the custom_vjp residual is just the token array."""
+    dtype) so the custom_vjp residual is just the token array AND so
+    repeated traces see the same callable (stable jit cache keys)."""
 
     @jax.custom_vjp
     def lookup(table: jax.Array, tokens: jax.Array) -> jax.Array:
@@ -165,7 +179,7 @@ PRESETS: Dict[str, LlamaConfig] = {
 }
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=_CACHE_MAXSIZE)
 def count_params(cfg: "LlamaConfig") -> int:
     """Total trainable parameters for ``cfg``, via eval_shape of the
     real init (no arrays materialized). The single source both
@@ -182,7 +196,7 @@ def count_params(cfg: "LlamaConfig") -> int:
     )
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=_CACHE_MAXSIZE)
 def count_params_by_part(cfg: "LlamaConfig") -> "Mapping[str, int]":
     """Param counts split by pipeline role: one transformer layer
     (``per_layer``), the token embedding (``embed``), the LM head
@@ -268,7 +282,10 @@ def rope_cos_sin(
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     """Rotate [B, S, H, D] by position. Adjacent-pair convention, fp32
-    rotation, result cast back (parity: apply_rotary_emb :58-100)."""
+    rotation, result cast back (parity: apply_rotary_emb :58-100).
+    ``cos``/``sin`` are [S, D//2] tables shared across the batch, or
+    [B, S, D//2] PER-ROW tables (the serving engine's decode step,
+    where each batch slot sits at its own position)."""
     orig_dtype = x.dtype
     # Adjacent pairs via a trailing [D//2, 2] reshape -- identical
     # values to the x[..., 0::2]/[..., 1::2] formulation but with
@@ -276,8 +293,10 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     xf = x.astype(jnp.float32).reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
     x1 = xf[..., 0]
     x2 = xf[..., 1]
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    # [.., S, D/2] -> [.., S, 1, D/2]: broadcasts over heads either
+    # way, and over batch for the shared-table shape.
+    c = cos[..., :, None, :]
+    s = sin[..., :, None, :]
     r1 = x1 * c - x2 * s
     r2 = x1 * s + x2 * c
     out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)

@@ -116,6 +116,23 @@ def auto_tp_degree(
     )
 
 
+def auto_mesh_axes(
+    n_devices: int, n_heads: int, kv_heads: int, cap: Optional[int] = 4
+) -> "dict[str, int]":
+    """The standard auto-split mesh shape: TP (capped, head-divisible)
+    on ``model``, remaining chips on ``data``. One helper so the bench
+    headline and the serving engine can never drift onto different
+    policies while claiming the same split."""
+    tp = (
+        auto_tp_degree(n_devices, n_heads, kv_heads, cap=cap)
+        if n_devices > 1 else 1
+    )
+    axes = {"data": n_devices // tp}
+    if tp > 1:
+        axes["model"] = tp
+    return axes
+
+
 def validate_tp_degree(
     n_heads: int, kv_heads: int, tp: int
 ) -> None:

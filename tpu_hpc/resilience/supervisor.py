@@ -18,9 +18,12 @@ automatically):
   ``--heartbeat-timeout``, a stale file means the child is wedged in a
   way its own in-process watchdog could not catch (e.g. the whole
   interpreter stuck in C++): the supervisor kills and restarts it.
-* Exit 0 ends the run; ``EXIT_RESUMABLE`` (75) and any other nonzero
-  code restart it (up to ``--max-restarts``), each attempt resuming
-  from the newest checkpoint via the Trainer's own auto-resume.
+* Exit 0 ends the run. ``EXIT_RESUMABLE`` (75, a clean preemption
+  snapshot) restarts WITHOUT consuming the failure budget -- per the
+  signals.py contract it means "nothing is wrong, relaunch me". Any
+  other nonzero code restarts up to ``--max-restarts`` times; every
+  attempt resumes from the newest checkpoint via the Trainer's own
+  auto-resume.
 
 Provenance rules (VERDICT item 9 -- the overwritten OOM dump): every
 attempt logs to an ATTEMPT-UNIQUE path (``run.attempt<N>.log``; if a
@@ -42,7 +45,11 @@ from typing import IO, List, Optional, Sequence, Tuple
 
 from tpu_hpc.resilience.heartbeat import ENV_ATTEMPT, ENV_HEARTBEAT
 from tpu_hpc.resilience.retry import backoff_delays
-from tpu_hpc.resilience.signals import EXIT_HANG, describe_exit
+from tpu_hpc.resilience.signals import (
+    EXIT_HANG,
+    EXIT_RESUMABLE,
+    describe_exit,
+)
 
 
 def unique_attempt_path(log_dir: str, attempt: int) -> str:
@@ -75,17 +82,23 @@ class Supervisor:
         no_restart_on: Sequence[int] = (),
         kill_grace_s: float = 10.0,
         poll_s: float = 0.2,
+        max_preemptions: int = 100,
     ):
         if not cmd:
             raise ValueError("empty command")
         if max_restarts < 0:
             raise ValueError(f"max_restarts {max_restarts} must be >= 0")
+        if max_preemptions < 0:
+            raise ValueError(
+                f"max_preemptions {max_preemptions} must be >= 0"
+            )
         self.cmd = list(cmd)
         self.max_restarts = max_restarts
         self.log_dir = log_dir
         self.heartbeat = heartbeat
         self.heartbeat_timeout = heartbeat_timeout
         self.backoff = backoff
+        self.max_preemptions = max_preemptions
         self.no_restart_on = set(no_restart_on)
         self.kill_grace_s = kill_grace_s
         self.poll_s = poll_s
@@ -207,6 +220,8 @@ class Supervisor:
         )
         try:
             attempt = 0
+            failures = 0
+            preemptions = 0
             while True:
                 self._event(
                     event="attempt_start", attempt=attempt,
@@ -232,13 +247,45 @@ class Supervisor:
                         why="exit code marked non-restartable",
                     )
                     return rc
-                if attempt >= self.max_restarts:
+                if rc == EXIT_RESUMABLE:
+                    # Clean preemption snapshot: "nothing is wrong,
+                    # relaunch me" (signals.py contract) -- restart
+                    # WITHOUT burning the failure budget or the
+                    # escalating backoff (a spot run preempted
+                    # max_restarts+1 times must not be abandoned
+                    # while healthy). Separately GENEROUSLY bounded:
+                    # a preemption cadence faster than the child's
+                    # checkpoint cadence makes zero progress per
+                    # attempt, and an unbounded loop would burn the
+                    # allocation forever.
+                    if preemptions >= self.max_preemptions:
+                        self._event(
+                            event="giving_up", attempt=attempt, rc=rc,
+                            why=f"preemption budget "
+                            f"({self.max_preemptions}) exhausted -- "
+                            "preemption cadence may be outpacing "
+                            "checkpoint cadence",
+                        )
+                        return rc
+                    preemptions += 1
+                    self._event(
+                        event="restarting", next_attempt=attempt + 1,
+                        backoff_s=round(self.backoff, 3),
+                        why="resumable preemption snapshot",
+                    )
+                    time.sleep(self.backoff)
+                    if self._stop_requested:
+                        return rc
+                    attempt += 1
+                    continue
+                if failures >= self.max_restarts:
                     self._event(
                         event="giving_up", attempt=attempt, rc=rc,
                         why=f"restart budget ({self.max_restarts}) "
                         "exhausted",
                     )
                     return rc
+                failures += 1
                 delay = next(delays)
                 self._event(
                     event="restarting", next_attempt=attempt + 1,
@@ -264,8 +311,30 @@ class Supervisor:
 
 
 def run_supervised(cmd: Sequence[str], **kwargs) -> int:
-    """Library entry point (bench.py --supervise uses this)."""
+    """Library entry point (bench.py/tpu_hpc.serve --supervise use
+    this)."""
     return Supervisor(cmd, **kwargs).run()
+
+
+def strip_flag(argv: Sequence[str], flag: str) -> List[str]:
+    """Remove ``flag N`` / ``flag=N`` from an argv copy -- the shared
+    re-exec helper for CLIs that wrap themselves in the supervisor
+    (bench.py --supervise, tpu_hpc.serve --supervise): the supervised
+    child must run the program itself, and a surviving flag would
+    recurse supervisors forever."""
+    out: List[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == flag:
+            skip = True
+            continue
+        if a.startswith(flag + "="):
+            continue
+        out.append(a)
+    return out
 
 
 def _split_argv(
@@ -306,6 +375,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("--backoff", type=float, default=1.0)
     ap.add_argument(
+        "--max-preemptions", type=int, default=100,
+        help="separate generous bound on EXIT_RESUMABLE (75) "
+        "preemption restarts (they never burn --max-restarts); "
+        "exhausting it usually means preemptions outpace checkpoints",
+    )
+    ap.add_argument(
         "--no-restart-on", type=str, default="",
         help="comma-separated exit codes that end the run immediately "
         "(e.g. '2' for usage errors)",
@@ -326,6 +401,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         heartbeat_timeout=args.heartbeat_timeout,
         backoff=args.backoff,
         no_restart_on=no_restart,
+        max_preemptions=args.max_preemptions,
     )
 
 

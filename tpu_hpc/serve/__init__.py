@@ -1,0 +1,33 @@
+"""tpu_hpc.serve: TPU-native batched inference.
+
+The serving counterpart of tpu_hpc.train -- a preallocated,
+mesh-sharded KV cache driven by AOT-compiled prefill/decode programs
+(engine), continuous batching over fixed slots (scheduler), trainer
+checkpoints resharded into the serving layout (weights), TTFT/ITL/
+throughput accounting (metrics), and a local request-replay CLI
+(``python -m tpu_hpc.serve``, server).
+"""
+from tpu_hpc.serve.engine import Engine, ServeConfig
+from tpu_hpc.serve.metrics import ServeMeter
+from tpu_hpc.serve.scheduler import (
+    ContinuousBatcher,
+    Request,
+    replay_requests,
+)
+from tpu_hpc.serve.weights import (
+    load_serving_params,
+    place_params,
+    serving_pspecs,
+)
+
+__all__ = [
+    "ContinuousBatcher",
+    "Engine",
+    "Request",
+    "ServeConfig",
+    "ServeMeter",
+    "load_serving_params",
+    "place_params",
+    "replay_requests",
+    "serving_pspecs",
+]

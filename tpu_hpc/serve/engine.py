@@ -1,0 +1,439 @@
+"""The serving engine: prefill + single-token decode over a KV cache.
+
+Training in this repo is one jitted step over a sharded state; serving
+gets the same treatment. The engine owns ONE preallocated, mesh-sharded
+KV cache (``[layers, slots, seq, kv_heads, head_dim]`` for K and V:
+batch slots shard over ``data``, KV heads over ``model`` -- the same
+Megatron head split ``parallel/tp.py`` gives the training step), and
+exactly two program shapes run in steady state:
+
+  * **prefill** -- full causal attention over one request's prompt,
+    padded up to a bucket length, writing the prompt's K/V into that
+    request's slot rows and returning the first greedy token;
+  * **decode** -- ONE token for EVERY slot: append each token's K/V at
+    its slot's position counter, attend over the cache (length-masked
+    per slot), return the next greedy token per slot.
+
+TPU idiom: both are AOT-lowered and XLA-compiled at engine warmup for
+a small, fixed set of padded shapes (one prefill program per bucket,
+one decode program), so steady-state serving never recompiles -- the
+fixed-shape discipline MPMD pipeline stages use (arXiv:2412.14374),
+applied to inference. The engine dispatches ONLY from its executable
+table; any miss is counted in ``compile_count``, which the recompile
+guard in tests/test_serve.py pins to the warmup count.
+
+The model math is a functional replay of ``models/llama2.py`` over the
+same param tree (flax params are a plain dict; serving needs no module
+machinery): identical dtype promotion (compute-dtype matmuls, fp32
+RMSNorm/RoPE/softmax), identical einsum contractions, so greedy decode
+with the cache is token-exact against the no-cache forward pass -- the
+parity oracle tests/test_serve.py enforces.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_hpc.models import llama2
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Static serving shape: everything a compiled program depends on.
+
+    ``slots``: fixed decode batch width (continuous batching admits and
+    evicts requests at decode-step granularity into these slots; the
+    decode program's shape never changes). ``max_seq_len``: KV-cache
+    capacity per slot (prompt + generated tokens). ``prefill_buckets``:
+    the padded prompt lengths prefill compiles for -- a prompt pads up
+    to the smallest bucket that holds it, so N buckets = N prefill
+    programs, ever. ``cache_dtype``: KV storage dtype (defaults to the
+    model's compute dtype -- storing bf16 halves cache HBM vs fp32 and
+    matches what the attention matmuls would cast to anyway).
+    """
+
+    slots: int = 8
+    max_seq_len: int = 256
+    prefill_buckets: Tuple[int, ...] = (64, 128)
+    cache_dtype: Any = None
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.slots}")
+        if not self.prefill_buckets:
+            raise ValueError("need at least one prefill bucket")
+        bad = [b for b in self.prefill_buckets if b > self.max_seq_len]
+        if bad:
+            raise ValueError(
+                f"prefill buckets {bad} exceed the cache capacity "
+                f"max_seq_len={self.max_seq_len}"
+            )
+        object.__setattr__(
+            self, "prefill_buckets", tuple(sorted(self.prefill_buckets))
+        )
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest compiled bucket holding ``prompt_len`` tokens."""
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt of {prompt_len} tokens exceeds the largest "
+            f"prefill bucket {self.prefill_buckets[-1]}"
+        )
+
+
+def kv_cache_pspec(mesh: Mesh, slots: int, kv_heads: int) -> P:
+    """Cache layout on the serving mesh: slots over ``data``, KV heads
+    over ``model`` (mirrors the training layout -- batch on data,
+    heads on the TP axis), each only when the axis exists, divides,
+    and is wider than 1."""
+    names = set(mesh.axis_names)
+
+    def claim(axis: str, extent: int) -> Optional[str]:
+        if axis in names and mesh.shape[axis] > 1 \
+                and extent % mesh.shape[axis] == 0:
+            return axis
+        return None
+
+    return P(None, claim("data", slots), None, claim("model", kv_heads),
+             None)
+
+
+# ---------------------------------------------------------------------
+# Functional Llama forward over the raw param dict.
+#
+# Replays models/llama2.py's module math exactly (same promotions, same
+# contractions) -- the modules are thin wrappers over these ops, and
+# serving needs the K/V tensors mid-block, which nn.Module hides.
+# ---------------------------------------------------------------------
+
+
+def _dense(x: jax.Array, kernel: jax.Array, dtype) -> jax.Array:
+    """nn.Dense(use_bias=False, dtype=dtype): promote both operands to
+    the compute dtype, then contract the trailing dim."""
+    return jax.lax.dot_general(
+        x.astype(dtype), kernel.astype(dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+    )
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm in fp32 with a learned scale (llama2.RMSNorm)."""
+    xf = x.astype(jnp.float32)
+    normed = xf * jax.lax.rsqrt(
+        jnp.mean(xf * xf, axis=-1, keepdims=True) + eps
+    )
+    return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _embed(params: Dict, tokens: jax.Array, cfg: llama2.LlamaConfig):
+    """Token embedding lookup in the compute dtype. Identical values to
+    both training paths (iota_embed's forward IS a plain gather)."""
+    table = params["tok_embeddings"]["embedding"].astype(cfg.dtype)
+    return jnp.take(table, tokens, axis=0)
+
+
+def _attn_out_proj(h, lp, cfg):
+    b, s = h.shape[0], h.shape[1]
+    return _dense(
+        h.reshape(b, s, cfg.n_heads * cfg.head_dim),
+        lp["attention"]["wo"]["kernel"], cfg.dtype,
+    )
+
+
+def _mlp(x, lp, cfg):
+    gate = _dense(x, lp["feed_forward"]["w1"]["kernel"], cfg.dtype)
+    up = _dense(x, lp["feed_forward"]["w3"]["kernel"], cfg.dtype)
+    return _dense(
+        jax.nn.silu(gate) * up, lp["feed_forward"]["w2"]["kernel"],
+        cfg.dtype,
+    )
+
+
+def _qkv(x, lp, cfg):
+    b, s = x.shape[0], x.shape[1]
+    hd, n_kv = cfg.head_dim, cfg.kv_heads
+    q = _dense(x, lp["attention"]["wq"]["kernel"], cfg.dtype)
+    k = _dense(x, lp["attention"]["wk"]["kernel"], cfg.dtype)
+    v = _dense(x, lp["attention"]["wv"]["kernel"], cfg.dtype)
+    return (
+        q.reshape(b, s, cfg.n_heads, hd),
+        k.reshape(b, s, n_kv, hd),
+        v.reshape(b, s, n_kv, hd),
+    )
+
+
+def _grouped_attention(q, k, v, mask, cfg):
+    """The model's einsum attention with an explicit mask: scores in
+    the compute dtype, fp32 softmax, GQA via the grouped query view
+    (llama2.Attention's no-repeat-KV contraction)."""
+    b, s_q = q.shape[0], q.shape[1]
+    n_kv = cfg.kv_heads
+    groups = cfg.n_heads // n_kv
+    qg = q.reshape(b, s_q, n_kv, groups, cfg.head_dim)
+    scale = cfg.head_dim ** -0.5
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) * scale
+    scores = scores.astype(jnp.float32)
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(b, s_q, cfg.n_heads, cfg.head_dim)
+
+
+def _logits_head(x, params, cfg):
+    x = _rmsnorm(x, params["norm"]["scale"], cfg.norm_eps)
+    return _dense(x, params["output"]["kernel"], cfg.dtype)
+
+
+def make_prefill_fn(cfg: llama2.LlamaConfig, bucket: int, slots: int):
+    """Prefill program for one padded bucket length.
+
+    ``(params, ks, vs, tokens [1, bucket], true_len, slot)`` ->
+    ``(ks, vs, next_token)``: full causal attention over the padded
+    prompt, the prompt's K/V written into slot ``slot`` rows
+    ``[0:bucket)`` (the padded tail is garbage the per-slot length
+    mask never reads), greedy token from the logits at row
+    ``true_len - 1``.
+    """
+    del slots  # shape comes from the cache operand
+
+    def prefill(params, ks, vs, tokens, true_len, slot):
+        x = _embed(params, tokens, cfg)
+        cos, sin = llama2.rope_cos_sin(bucket, cfg.head_dim)
+        causal = jnp.tril(jnp.ones((bucket, bucket), dtype=bool))
+        mask = causal[None, None, None, :, :]
+        for i in range(cfg.n_layers):
+            lp = params[f"layers_{i}"]
+            h = _rmsnorm(
+                x, lp["attention_norm"]["scale"], cfg.norm_eps
+            )
+            q, k, v = _qkv(h, lp, cfg)
+            q = llama2.apply_rope(q, cos, sin)
+            k = llama2.apply_rope(k, cos, sin)
+            ks = jax.lax.dynamic_update_slice(
+                ks, k.astype(ks.dtype)[None], (i, slot, 0, 0, 0)
+            )
+            vs = jax.lax.dynamic_update_slice(
+                vs, v.astype(vs.dtype)[None], (i, slot, 0, 0, 0)
+            )
+            attn = _grouped_attention(
+                q, k.astype(cfg.dtype), v.astype(cfg.dtype), mask, cfg
+            )
+            x = x + _attn_out_proj(attn, lp, cfg)
+            h = _rmsnorm(x, lp["ffn_norm"]["scale"], cfg.norm_eps)
+            x = x + _mlp(h, lp, cfg)
+        last = jax.lax.dynamic_slice(
+            x, (0, true_len - 1, 0), (1, 1, cfg.dim)
+        )
+        logits = _logits_head(last, params, cfg)
+        return ks, vs, jnp.argmax(logits[0, 0], axis=-1).astype(jnp.int32)
+
+    return prefill
+
+
+def make_decode_fn(cfg: llama2.LlamaConfig, cache_len: int):
+    """The single-token decode program over every slot at once.
+
+    ``(params, ks, vs, tokens [slots], pos [slots])`` ->
+    ``(ks, vs, next_tokens [slots])``: each slot's incoming token is
+    embedded, rotated to its own position ``pos[slot]`` (the per-slot
+    position counter feeding RoPE), its K/V appended at that position,
+    and attention runs over cache columns ``<= pos[slot]`` -- columns
+    beyond a slot's length (stale entries from an evicted request, the
+    padded prefill tail) are masked out, which is what makes slot
+    reuse safe.
+    """
+
+    def decode(params, ks, vs, tokens, pos):
+        slots = tokens.shape[0]
+        x = _embed(params, tokens[:, None], cfg)  # [slots, 1, dim]
+        cos, sin = llama2.rope_cos_sin(1, cfg.head_dim, positions=pos)
+        cos, sin = cos[:, None, :], sin[:, None, :]  # [slots, 1, D/2]
+        col = jnp.arange(cache_len)
+        mask = (col[None, :] <= pos[:, None])[:, None, None, None, :]
+        rows = jnp.arange(slots)
+        for i in range(cfg.n_layers):
+            lp = params[f"layers_{i}"]
+            h = _rmsnorm(
+                x, lp["attention_norm"]["scale"], cfg.norm_eps
+            )
+            q, k, v = _qkv(h, lp, cfg)
+            # Per-slot [slots, 1, D/2] tables: each slot rotates to
+            # its own position (apply_rope broadcasts either shape).
+            q = llama2.apply_rope(q, cos, sin)
+            k = llama2.apply_rope(k, cos, sin)
+            ks = ks.at[i, rows, pos].set(k[:, 0].astype(ks.dtype))
+            vs = vs.at[i, rows, pos].set(v[:, 0].astype(vs.dtype))
+            attn = _grouped_attention(
+                q, ks[i].astype(cfg.dtype), vs[i].astype(cfg.dtype),
+                mask, cfg,
+            )
+            x = x + _attn_out_proj(attn, lp, cfg)
+            h = _rmsnorm(x, lp["ffn_norm"]["scale"], cfg.norm_eps)
+            x = x + _mlp(h, lp, cfg)
+        logits = _logits_head(x, params, cfg)  # [slots, 1, vocab]
+        return ks, vs, jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+
+    return decode
+
+
+class Engine:
+    """AOT-compiled prefill/decode over one resident KV cache.
+
+    Owns the sharded cache and the executable table; the scheduler
+    (serve/scheduler.py) drives it one prefill or decode at a time.
+    ``compile_count`` increments on every executable build -- after
+    :meth:`warmup` it must stay put (the zero-recompile guard).
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: llama2.LlamaConfig,
+        serve_cfg: ServeConfig,
+        mesh: Mesh,
+        param_pspecs: Any = None,
+    ):
+        from tpu_hpc.serve.weights import place_params, serving_pspecs
+
+        if cfg.n_heads % cfg.kv_heads:
+            raise ValueError(
+                f"n_heads {cfg.n_heads} must be a multiple of kv_heads "
+                f"{cfg.kv_heads}"
+            )
+        if serve_cfg.max_seq_len > cfg.max_seq_len:
+            raise ValueError(
+                f"cache capacity {serve_cfg.max_seq_len} exceeds the "
+                f"model's max_seq_len {cfg.max_seq_len}"
+            )
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg
+        self.mesh = mesh
+        if param_pspecs is None:
+            param_pspecs = serving_pspecs(params, mesh)
+        self.param_pspecs = param_pspecs
+        self.params = place_params(params, mesh, param_pspecs)
+        self._param_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), param_pspecs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self._rep = NamedSharding(mesh, P())
+
+        cache_dtype = serve_cfg.cache_dtype or cfg.dtype
+        shape = (
+            cfg.n_layers, serve_cfg.slots, serve_cfg.max_seq_len,
+            cfg.kv_heads, cfg.head_dim,
+        )
+        self._cache_sharding = NamedSharding(
+            mesh, kv_cache_pspec(mesh, serve_cfg.slots, cfg.kv_heads)
+        )
+        alloc = jax.jit(
+            lambda: (
+                jnp.zeros(shape, cache_dtype),
+                jnp.zeros(shape, cache_dtype),
+            ),
+            out_shardings=(self._cache_sharding, self._cache_sharding),
+        )
+        self.ks, self.vs = alloc()
+        self.cache_bytes = 2 * math.prod(shape) * jnp.dtype(
+            cache_dtype
+        ).itemsize
+
+        self._execs: Dict[Any, Any] = {}
+        self.compile_count = 0
+
+    # -- executable table ---------------------------------------------
+    def _cache_abstract(self):
+        return jax.ShapeDtypeStruct(
+            self.ks.shape, self.ks.dtype, sharding=self._cache_sharding
+        )
+
+    def _build(self, key):
+        """Lower-and-compile one program shape (counted)."""
+        self.compile_count += 1
+        cache = self._cache_abstract()
+        params_abs = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            self.params, self._param_shardings,
+        )
+        scalar = jax.ShapeDtypeStruct((), jnp.int32, sharding=self._rep)
+        if key[0] == "prefill":
+            bucket = key[1]
+            fn = make_prefill_fn(self.cfg, bucket, self.serve_cfg.slots)
+            tokens = jax.ShapeDtypeStruct(
+                (1, bucket), jnp.int32, sharding=self._rep
+            )
+            args = (params_abs, cache, cache, tokens, scalar, scalar)
+        else:
+            fn = make_decode_fn(self.cfg, self.serve_cfg.max_seq_len)
+            vec = jax.ShapeDtypeStruct(
+                (self.serve_cfg.slots,), jnp.int32, sharding=self._rep
+            )
+            args = (params_abs, cache, cache, vec, vec)
+        jitted = jax.jit(
+            fn,
+            donate_argnums=(1, 2),  # the cache is engine-resident
+            out_shardings=(
+                self._cache_sharding, self._cache_sharding, self._rep
+            ),
+        )
+        return jitted.lower(*args).compile()
+
+    def _get_exec(self, key):
+        if key not in self._execs:
+            self._execs[key] = self._build(key)
+        return self._execs[key]
+
+    def warmup(self) -> int:
+        """Compile every steady-state program shape up front: one
+        prefill per bucket + the decode step. Returns the executable
+        count -- after this, ``compile_count`` must never move."""
+        for b in self.serve_cfg.prefill_buckets:
+            self._get_exec(("prefill", b))
+        self._get_exec(("decode",))
+        return self.compile_count
+
+    # -- serving ops ----------------------------------------------------
+    def _rep_arr(self, value, dtype=jnp.int32):
+        return jax.device_put(jnp.asarray(value, dtype), self._rep)
+
+    def prefill(self, slot: int, prompt: Sequence[int]) -> int:
+        """Run one request's prompt through the bucketed prefill
+        program, writing its K/V into ``slot``; returns the first
+        greedy token."""
+        n = len(prompt)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if not 0 <= slot < self.serve_cfg.slots:
+            raise ValueError(f"slot {slot} out of range")
+        bucket = self.serve_cfg.bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = np.asarray(prompt, np.int32)
+        exec_ = self._get_exec(("prefill", bucket))
+        self.ks, self.vs, tok = exec_(
+            self.params, self.ks, self.vs,
+            self._rep_arr(padded), self._rep_arr(n), self._rep_arr(slot),
+        )
+        return int(tok)
+
+    def decode(
+        self, tokens: Sequence[int], positions: Sequence[int]
+    ) -> np.ndarray:
+        """One decode step for every slot: ``tokens[s]`` enters at
+        position ``positions[s]``. Returns the next greedy token per
+        slot (inactive slots produce garbage the scheduler ignores --
+        their mask still bounds what they read)."""
+        exec_ = self._get_exec(("decode",))
+        self.ks, self.vs, toks = exec_(
+            self.params, self.ks, self.vs,
+            self._rep_arr(np.asarray(tokens, np.int32)),
+            self._rep_arr(np.asarray(positions, np.int32)),
+        )
+        return np.asarray(toks)

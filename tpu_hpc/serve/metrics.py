@@ -1,0 +1,160 @@
+"""Serving metrics: TTFT, inter-token latency, tokens/s/chip.
+
+The serving counterparts of train/metrics.ThroughputMeter, recorded in
+the same JSONL discipline the Trainer uses (append-only, one ``event``
+field per record) so one consumer reads both training and serving
+artifacts. Latency quantiles are reported in milliseconds (the unit
+operators alarm on); throughput is global and per-chip.
+
+MFU for serving divides by the FORWARD-only 2N FLOPs/token estimate
+(train/metrics.mfu(mode="inference")) -- the 6N training convention
+would understate serving utilization 3x.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from tpu_hpc.train.metrics import mfu
+
+
+@dataclasses.dataclass
+class _Trace:
+    t_submit: float               # entered the queue
+    t_admit: Optional[float] = None  # got a slot (prefill started)
+    t_first: Optional[float] = None
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    t_done: Optional[float] = None
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class ServeMeter:
+    """Per-request latency traces + run-level throughput.
+
+    Wire it into a ContinuousBatcher; call :meth:`summary` after the
+    drain. ``metrics_path`` (optional) appends one JSONL record per
+    finished request plus one ``serve_summary`` record -- the Trainer's
+    run-log discipline applied to serving.
+    """
+
+    def __init__(self, metrics_path: Optional[str] = None):
+        self.metrics_path = metrics_path
+        self.traces: Dict[str, _Trace] = {}
+        self.prefill_tokens = 0  # padded prompt tokens forwarded
+        self._t0 = time.perf_counter()
+
+    # -- batcher callbacks --------------------------------------------
+    def submitted(self, rid: str) -> None:
+        self.traces[rid] = _Trace(t_submit=time.perf_counter())
+
+    def admitted(self, rid: str, prefill_tokens: int = 0) -> None:
+        # TTFT is measured from SUBMISSION: an oversubscribed replay
+        # must show its queue wait in the quantiles operators alarm
+        # on, not hide it between submit and slot admission. Callers
+        # that never signal submission (direct engine drivers) still
+        # get a trace anchored here.
+        t = time.perf_counter()
+        trace = self.traces.get(rid)
+        if trace is None:
+            trace = self.traces[rid] = _Trace(t_submit=t)
+        trace.t_admit = t
+        # Prefill forwards this many (padded-bucket) tokens through
+        # the model; serving MFU must count them -- the generated
+        # token count alone would understate the FLOPs actually done
+        # several-fold at long-prompt/short-output mixes.
+        self.prefill_tokens += prefill_tokens
+
+    def token(self, rid: str, first: bool = False) -> None:
+        t = time.perf_counter()
+        trace = self.traces[rid]
+        if first:
+            trace.t_first = t
+        trace.token_times.append(t)
+
+    def finished(self, rid: str) -> None:
+        trace = self.traces[rid]
+        trace.t_done = time.perf_counter()
+        self._append({
+            "event": "request",
+            "time": time.time(),
+            "rid": rid,
+            "ttft_ms": 1e3 * (trace.t_first - trace.t_submit),
+            "queue_ms": 1e3 * (
+                (trace.t_admit or trace.t_submit) - trace.t_submit
+            ),
+            "tokens": len(trace.token_times),
+            "total_ms": 1e3 * (trace.t_done - trace.t_submit),
+        })
+
+    # -- aggregation ---------------------------------------------------
+    def summary(
+        self,
+        n_devices: int = 1,
+        n_params: Optional[int] = None,
+        peak_flops_per_device: Optional[float] = None,
+    ) -> Dict[str, float]:
+        """TTFT/ITL quantiles (ms), tokens/s (global and per chip --
+        GENERATED tokens, the number operators provision against),
+        and -- when ``n_params``+``peak_flops_per_device`` are given --
+        serving MFU on the forward-only 2N estimate over ALL tokens
+        the model forwarded (padded prefill + generated): utilization
+        measures work done, not work delivered."""
+        wall = time.perf_counter() - self._t0
+        ttfts = sorted(
+            t.t_first - t.t_submit
+            for t in self.traces.values() if t.t_first is not None
+        )
+        itls: List[float] = []
+        total_tokens = 0
+        for t in self.traces.values():
+            total_tokens += len(t.token_times)
+            itls.extend(
+                b - a for a, b in zip(t.token_times, t.token_times[1:])
+            )
+        itls.sort()
+        tokens_per_s = total_tokens / wall if wall > 0 else 0.0
+        out = {
+            "requests": len(self.traces),
+            "tokens": total_tokens,
+            "wall_s": wall,
+            "tokens_per_s": tokens_per_s,
+            "tokens_per_s_per_chip": tokens_per_s / n_devices,
+            "ttft_ms_p50": 1e3 * _quantile(ttfts, 0.50),
+            "ttft_ms_p95": 1e3 * _quantile(ttfts, 0.95),
+            "itl_ms_p50": 1e3 * _quantile(itls, 0.50),
+            "itl_ms_p95": 1e3 * _quantile(itls, 0.95),
+            "prefill_tokens": self.prefill_tokens,
+        }
+        if n_params is not None and peak_flops_per_device:
+            forwarded_per_s = (
+                (total_tokens + self.prefill_tokens) / wall
+                if wall > 0 else 0.0
+            )
+            out["serve_mfu"] = mfu(
+                forwarded_per_s, n_params, n_devices,
+                peak_flops_per_device, mode="inference",
+            )
+        return out
+
+    def write_summary(self, summary: Dict) -> None:
+        self._append({
+            "event": "serve_summary", "time": time.time(), **summary
+        })
+
+    def _append(self, record: Dict) -> None:
+        if not self.metrics_path:
+            return
+        parent = os.path.dirname(self.metrics_path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(self.metrics_path, "a") as f:
+            f.write(json.dumps(record) + "\n")

@@ -1,0 +1,290 @@
+"""`python -m tpu_hpc.serve` -- local request-replay serving run.
+
+Brings up the engine on whatever chips are visible (simulated CPU mesh
+included: TPU_HPC_SIM_DEVICES=8 works exactly like the test suite),
+replays a deterministic synthetic request mix through the continuous
+batcher, and emits the serving metrics record -- TTFT/ITL quantiles,
+tokens/s/chip, serving MFU -- as one JSON line on stdout plus optional
+JSONL traces. The serving analogue of bench.py's training contract.
+
+Resilience: ``--supervise N`` re-execs under
+tpu_hpc.resilience.supervisor with N bounded restarts (same contract
+bench.py --supervise uses), and the batcher ticks the supervisor's
+heartbeat file at decode-step granularity, so a wedged decode step is
+detected and the run restarted instead of hanging the allocation.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from tpu_hpc.models import llama2
+
+
+def peak_flops_per_chip(device) -> Optional[float]:
+    """Peak dense bf16 FLOP/s from the single spec table in
+    checks/roofline.py (shared with bench.py's training MFU). None
+    for unknown kinds: a CPU-sim "serving MFU" would be meaningless
+    noise, so the summary omits it instead."""
+    from tpu_hpc.checks.roofline import peak_flops_for_device
+
+    return peak_flops_for_device(device, default=None)
+
+
+def tiny_config(vocab_size: int = 512) -> llama2.LlamaConfig:
+    """The 8-device-sim-sized model the replay server defaults to."""
+    import jax.numpy as jnp
+
+    return llama2.LlamaConfig(
+        dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        vocab_size=vocab_size, multiple_of=32, max_seq_len=512,
+        dtype=jnp.bfloat16,
+    )
+
+
+def build_serving_mesh(n_devices: int, cfg: llama2.LlamaConfig):
+    """Serving mesh: TP capped at 4 over ``model`` (head divisibility
+    validated), remaining chips over ``data`` for batch slots -- the
+    same auto split bench.py's training headline uses
+    (tp.auto_mesh_axes is the single policy both call)."""
+    from tpu_hpc.parallel import tp
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(axes=tp.auto_mesh_axes(
+        n_devices, cfg.n_heads, cfg.kv_heads, cap=4
+    )))
+
+
+def run_replay(
+    cfg: llama2.LlamaConfig,
+    serve_cfg,
+    n_requests: int,
+    prompt_lens: Sequence[int],
+    max_new_tokens: int,
+    checkpoint_dir: Optional[str] = None,
+    metrics_path: Optional[str] = None,
+    seed: int = 0,
+) -> dict:
+    """Engine bring-up + warmup + replay; returns the summary dict."""
+    import jax
+
+    from tpu_hpc.serve.engine import Engine
+    from tpu_hpc.serve.metrics import ServeMeter
+    from tpu_hpc.serve.scheduler import ContinuousBatcher, replay_requests
+    from tpu_hpc.serve.weights import load_serving_params
+    from tpu_hpc.resilience.heartbeat import Heartbeat
+
+    mesh = build_serving_mesh(jax.device_count(), cfg)
+    if checkpoint_dir:
+        params = load_serving_params(checkpoint_dir, cfg, mesh)
+    else:
+        params = llama2.init_llama(jax.random.key(seed), cfg)
+    engine = Engine(params, cfg, serve_cfg, mesh)
+    n_programs = engine.warmup()
+
+    meter = ServeMeter(metrics_path=metrics_path)
+    batcher = ContinuousBatcher(engine, meter=meter)
+    requests = replay_requests(
+        n_requests, cfg.vocab_size, prompt_lens, max_new_tokens,
+        seed=seed,
+    )
+    heartbeat = Heartbeat.from_env()
+    tick = None
+    if heartbeat is not None:
+        # Throttle to ~1 write per 2s of progress: decode steps on
+        # real chips run at millisecond cadence, and a per-step
+        # atomic-rename file write would turn the liveness signal
+        # into measurable I/O on the serving hot loop.
+        import time as _time
+
+        last = [0.0]
+
+        def tick(step):
+            now = _time.monotonic()
+            if now - last[0] >= 2.0:
+                last[0] = now
+                heartbeat.tick(step)
+
+    batcher.run(requests, tick=tick)
+
+    peak = peak_flops_per_chip(jax.devices()[0])
+    summary = meter.summary(
+        n_devices=jax.device_count(),
+        n_params=llama2.count_params(cfg),
+        peak_flops_per_device=peak,
+    )
+    summary.update(
+        mesh={k: int(v) for k, v in mesh.shape.items()},
+        slots=serve_cfg.slots,
+        prefill_buckets=list(serve_cfg.prefill_buckets),
+        cache_bytes=engine.cache_bytes,
+        compiled_programs=n_programs,
+        recompiles=engine.compile_count - n_programs,
+        batcher=dict(batcher.stats),
+    )
+    meter.write_summary(summary)
+    return summary
+
+
+def _last_json_line(log_dir: str) -> Optional[str]:
+    """The newest attempt log's final JSON line (the child's summary
+    record), or None when no attempt log holds one."""
+    import glob
+
+    logs = sorted(
+        glob.glob(os.path.join(log_dir, "run.attempt*.log")),
+        key=os.path.getmtime,
+    )
+    for path in reversed(logs):
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in reversed(lines):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    json.loads(line)
+                except ValueError:
+                    continue
+                return line
+    return None
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    # allow_abbrev=False: --supervise is stripped by exact name before
+    # re-exec (same recursion guard as bench.py).
+    ap = argparse.ArgumentParser(
+        prog="tpu_hpc.serve",
+        description=__doc__.split("\n")[0],
+        allow_abbrev=False,
+    )
+    ap.add_argument(
+        "--model", type=str, default="tiny",
+        choices=("tiny", *sorted(llama2.PRESETS)),
+        help="model architecture (tiny = the 8-device-sim config)",
+    )
+    ap.add_argument("--vocab", type=int, default=512,
+                    help="vocab size for --model tiny")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="fixed decode batch width")
+    ap.add_argument("--max-seq-len", type=int, default=None,
+                    help="KV-cache capacity per slot "
+                    "(default: largest bucket + max-new)")
+    ap.add_argument(
+        "--buckets", type=str, default="16,32",
+        help="comma-separated padded prefill lengths",
+    )
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument(
+        "--prompt-lens", type=str, default="9,14,27",
+        help="comma-separated prompt lengths the replay mix cycles",
+    )
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--checkpoint-dir", type=str, default=None,
+        help="restore params from the newest trainer checkpoint here "
+        "(serve/weights.py resharding); default: random init",
+    )
+    ap.add_argument(
+        "--metrics", type=str, default=None,
+        help="append per-request + summary JSONL records here",
+    )
+    ap.add_argument(
+        "--sim-devices", type=int, default=0,
+        help="force an N-device simulated CPU mesh (development mode)",
+    )
+    ap.add_argument(
+        "--supervise", type=int, default=0, metavar="N",
+        help="re-launch under the resilience supervisor with N "
+        "bounded restarts (heartbeat ticked at decode-step "
+        "granularity; a stale heartbeat kills and restarts a wedged "
+        "child)",
+    )
+    ap.add_argument(
+        "--heartbeat-timeout", type=float, default=600.0,
+        help="seconds of heartbeat staleness before the supervisor "
+        "restarts the child (0 = off); must cover backend bring-up "
+        "+ checkpoint restore + engine warmup",
+    )
+    args = ap.parse_args(argv)
+
+    if args.supervise:
+        from tpu_hpc.resilience.supervisor import (
+            run_supervised,
+            strip_flag,
+        )
+
+        child_args = strip_flag(
+            list(sys.argv[1:] if argv is None else argv), "--supervise"
+        )
+        log_dir = os.environ.get(
+            "TPU_HPC_SUPERVISE_LOGS", "serve_logs"
+        )
+        rc = run_supervised(
+            [sys.executable, "-m", "tpu_hpc.serve", *child_args],
+            max_restarts=args.supervise,
+            log_dir=log_dir,
+            heartbeat=os.path.join(log_dir, "heartbeat.json"),
+            heartbeat_timeout=args.heartbeat_timeout,
+        )
+        if rc == 0:
+            # The supervisor redirected the child's stdout into its
+            # attempt log; re-emit the summary so the one-JSON-line-
+            # on-stdout contract (this module's docstring) survives
+            # supervision -- a pipeline `... --supervise 2 | jq`
+            # must not read empty output.
+            record = _last_json_line(log_dir)
+            if record is not None:
+                print(record)
+        return rc
+
+    if args.sim_devices:
+        from tpu_hpc.runtime import sim
+
+        sim.force_sim_devices(args.sim_devices)
+
+    if args.model == "tiny":
+        cfg = tiny_config(args.vocab)
+    else:
+        cfg = llama2.PRESETS[args.model]
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    prompt_lens = tuple(int(p) for p in args.prompt_lens.split(","))
+    too_long = [p for p in prompt_lens if p > max(buckets)]
+    if too_long:
+        ap.error(
+            f"prompt lens {too_long} exceed the largest bucket "
+            f"{max(buckets)}"
+        )
+    # `is not None`, not truthiness: an explicit --max-seq-len 0 must
+    # fail capacity validation loudly, not silently take the default.
+    max_seq = (
+        args.max_seq_len if args.max_seq_len is not None
+        else max(buckets) + args.max_new
+    )
+    if max_seq > cfg.max_seq_len:
+        ap.error(
+            f"cache capacity {max_seq} exceeds the model's "
+            f"max_seq_len {cfg.max_seq_len}"
+        )
+    from tpu_hpc.serve.engine import ServeConfig
+
+    serve_cfg = ServeConfig(
+        slots=args.slots, max_seq_len=max_seq, prefill_buckets=buckets
+    )
+    summary = run_replay(
+        cfg, serve_cfg, args.requests, prompt_lens, args.max_new,
+        checkpoint_dir=args.checkpoint_dir, metrics_path=args.metrics,
+        seed=args.seed,
+    )
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""Train -> serve weight flow: restore a trainer checkpoint, reshard
+into the serving layout.
+
+Training shards params for UPDATE bandwidth (FSDP over ``data`` +
+Megatron TP over ``model`` -- parallel/hybrid.py); serving wants them
+laid out for DECODE latency: TP over ``model`` only (the Megatron
+column/row split keeps one collective per block), fully replicated
+over ``data`` so every batch-slot shard has its weights local. The
+transfer between the two layouts is exactly the resharding problem of
+checkpoint portability (arXiv:2112.01075), and the mechanism is the
+one this repo already has: restore against an abstract template whose
+leaves carry the TARGET shardings, and orbax/XLA move the bytes.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_hpc.models import llama2
+from tpu_hpc.parallel import tp
+from tpu_hpc.parallel.plans import pspec_tree
+
+
+def serving_pspecs(params: Any, mesh: Mesh) -> Any:
+    """The serving param plan: Megatron TP over ``model`` when the
+    mesh has that axis (llama_rules -- identical col/row split to
+    training, so the per-block collective signature carries over),
+    everything replicated otherwise. No FSDP: decode is
+    latency-bound, and gathering params per token would put the full
+    weight traffic on every step."""
+    if "model" in mesh.axis_names and mesh.shape["model"] > 1:
+        return pspec_tree(params, tp.llama_rules("model"), default=P())
+    return jax.tree.map(lambda _: P(), params)
+
+
+def place_params(params: Any, mesh: Mesh, specs: Any) -> Any:
+    """Reshard a param tree onto the serving mesh per ``specs`` via a
+    jitted identity (fresh buffers -- safe next to donation, same
+    reasoning as the Trainer's placement)."""
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.jit(lambda t: t, out_shardings=shardings)(params)
+
+
+def abstract_train_state(
+    cfg: llama2.LlamaConfig,
+    mesh: Mesh,
+    param_specs: Any,
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    moments_dtype: str = "float32",
+):
+    """Abstract TrainState template whose param leaves carry the
+    SERVING shardings -- restore against it and the checkpoint's
+    FSDPxTP training shards land directly in the serving layout (no
+    intermediate full-replica materialization). The optimizer mirrors
+    the Trainer's construction (make_adamw is the shared single
+    source) purely for tree-structure parity with what ``fit`` saved;
+    the restored moments are dropped by the caller -- but they DO
+    transit HBM during the restore, so their template shardings are
+    the maximally sharded plan (param TP specs + FSDP over ``data``):
+    a replicated template would pull the full fp32 AdamW state
+    (~8 bytes/param) into every chip and OOM exactly the real-size
+    checkpoints this loader exists for."""
+    from tpu_hpc.parallel import hybrid
+    from tpu_hpc.parallel.plans import derived_pspecs
+    from tpu_hpc.train.trainer import TrainState, make_adamw
+
+    abstract_params = jax.eval_shape(
+        lambda: llama2.init_llama(jax.random.key(0), cfg)
+    )
+    rep = NamedSharding(mesh, P())
+
+    def with_sharding(tree, specs):
+        return jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=NamedSharding(mesh, s)
+            ),
+            tree, specs,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+        )
+
+    optimizer = make_adamw(learning_rate, weight_decay, moments_dtype)
+    opt_abstract = jax.eval_shape(optimizer.init, abstract_params)
+    moment_base = param_specs
+    if "data" in mesh.axis_names and mesh.shape["data"] > 1:
+        moment_base = hybrid.fsdp_extend(
+            param_specs, abstract_params,
+            data_axis="data", data_size=mesh.shape["data"],
+        )
+    opt_specs = derived_pspecs(
+        opt_abstract, abstract_params, moment_base
+    )
+    import jax.numpy as jnp
+
+    return TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+        params=with_sharding(abstract_params, param_specs),
+        opt_state=with_sharding(opt_abstract, opt_specs),
+        model_state={},
+    )
+
+
+def load_serving_params(
+    checkpoint_dir: str,
+    cfg: llama2.LlamaConfig,
+    mesh: Mesh,
+    param_specs: Optional[Any] = None,
+    **trainer_opt_kwargs,
+) -> Any:
+    """Newest trainer checkpoint -> params in the serving layout.
+
+    Uses ``ckpt.restore_latest`` (torn-snapshot fallback and retry
+    included), so a serving relaunch inherits the same self-healing
+    restore path training has. Returns the params tree only; raises
+    FileNotFoundError when the directory holds no restorable step.
+    """
+    from tpu_hpc.ckpt import CheckpointManager
+
+    abstract_params = jax.eval_shape(
+        lambda: llama2.init_llama(jax.random.key(0), cfg)
+    )
+    if param_specs is None:
+        param_specs = serving_pspecs(abstract_params, mesh)
+    template = abstract_train_state(
+        cfg, mesh, param_specs, **trainer_opt_kwargs
+    )
+    mgr = CheckpointManager(checkpoint_dir, async_save=False)
+    try:
+        restored = mgr.restore_latest(template)
+    finally:
+        mgr.close()
+    if restored is None:
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {checkpoint_dir!r}"
+        )
+    return restored.params

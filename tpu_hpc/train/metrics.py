@@ -129,14 +129,24 @@ def mfu(
     n_devices: int,
     peak_flops_per_device: float,
     attn_flops_per_token: float = 0.0,
+    mode: str = "train",
 ) -> float:
     """Model FLOPs utilization: achieved / peak.
 
-    Uses the standard 6N FLOPs/token estimate for dense transformers
-    (fwd 2N + bwd 4N) plus optional explicit attention FLOPs. This is
-    the >=40% target metric on the 7B hybrid (BASELINE.md).
+    ``mode="train"`` (the default) uses the standard 6N FLOPs/token
+    estimate for dense transformers (fwd 2N + bwd 4N) -- the >=40%
+    target metric on the 7B hybrid (BASELINE.md). ``mode="inference"``
+    uses the forward-only 2N estimate: a decode step runs no backward,
+    so judging serving throughput against 6N would understate its
+    utilization 3x (tpu_hpc.serve reports this mode). Optional explicit
+    attention FLOPs add on either way.
     """
-    flops_per_token = 6.0 * n_params + attn_flops_per_token
+    factors = {"train": 6.0, "inference": 2.0}
+    if mode not in factors:
+        raise ValueError(
+            f"unknown mfu mode {mode!r} (one of {sorted(factors)})"
+        )
+    flops_per_token = factors[mode] * n_params + attn_flops_per_token
     achieved = tokens_per_s * flops_per_token
     return achieved / (peak_flops_per_device * n_devices)
 
