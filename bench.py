@@ -64,6 +64,32 @@ def flash_blocks_record(attn, block_q, block_k, block_q_bwd, block_k_bwd):
     }
 
 
+def comm_mode_mesh(comm_mode: str, n_dev: int, n_slices: int = 1):
+    """Mesh spec for a manual comm-mode run: ``(mesh_spec, batch_axes,
+    dp_extent)``.
+
+    Manual gradient-sync modes are DDP-family (replicated params), so
+    the whole mesh is data parallelism. ``hierarchical`` needs the two
+    fabric tiers as separate axes -- the shared construction policy
+    (dcn resolution, validity, slice-aligned ``dcn_axes`` routing on
+    real multi-slice hardware) lives in ``runtime.mesh.two_tier_spec``;
+    the rejection here just names the CLI lever, because a record
+    claiming "hierarchical" while silently measuring something else
+    would poison the sweep."""
+    from tpu_hpc.runtime import MeshSpec, two_tier_spec
+
+    if comm_mode == "hierarchical":
+        try:
+            spec = two_tier_spec(n_dev, n_slices, inner_axis="data")
+        except ValueError as e:
+            raise ValueError(
+                f"--comm-mode hierarchical: {e} -- use "
+                "bucketed_overlap or flat on this topology"
+            ) from None
+        return spec, ("dcn", "data"), n_dev
+    return MeshSpec(axes={"data": n_dev}), ("data",), n_dev
+
+
 def bench_model_cfg(seq_len: int = 2048, remat: bool = False):
     """THE bench architecture: the ~170M-param Llama every llama-family
     workload runs, sized to single-chip v5e HBM. One factory so the
@@ -83,6 +109,7 @@ def bench_llama(
     seq_len: int = 2048, grad_accum_steps: int = 1,
     moments_dtype: str = "float32",
     block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
+    comm_mode: str = "flat",
 ) -> dict:
     """Best measured single-chip config (v5e) -- what the CLI runs by
     default (the *function* defaults are the unaccumulated round-2
@@ -121,19 +148,46 @@ def bench_llama(
         if attn == "xla":
             return None  # the model's einsum path (XLA-fused)
         # Pallas flash (GQA in-kernel, no repeated KV); multi-chip
-        # runs it under shard_map with heads on the TP axis.
+        # runs it under shard_map with heads on the TP axis. Manual
+        # comm modes run the WHOLE forward per-shard inside one
+        # shard_map (comm.overlap), so they take the bare batch-local
+        # closure (wrap=False): nesting a second shard_map over the
+        # same mesh would fail to trace (the same batch-local idiom
+        # bench_llama_pp's stages use), and the shared factory keeps
+        # comm-mode rows on the identical kernel config as flat rows.
         return tp.make_tp_flash_attn_fn(
             mesh, "data", "model" if tp_size > 1 else None,
             block_q=block_q, block_k=block_k,
             block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
+            wrap=(comm_mode == "flat"),
         )
 
-    axes = tp.auto_mesh_axes(
-        n_dev, model_cfg.n_heads, model_cfg.kv_heads, cap=4
-    )
-    dp_size = axes["data"]
+    from jax.sharding import PartitionSpec as P
+
+    batch_pspec = P("data")
+    if comm_mode != "flat":
+        # Manual gradient-sync modes (tpu_hpc.comm.overlap) are
+        # DDP-family: replicated params, batch over the whole data
+        # axis (both tiers of it in hierarchical mode). FSDP/TP
+        # layouts keep GSPMD's fused collectives
+        # (fsdp.validate_grad_sync_mode rejects them loudly), so the
+        # comm-mode rows measure pure-DP sync strategy, attributable
+        # via the record's comm_mode field.
+        from tpu_hpc.runtime.mesh import slice_groups
+
+        mesh_spec, batch_axes, dp_size = comm_mode_mesh(
+            comm_mode, n_dev, len(slice_groups(jax.devices()))
+        )
+        batch_pspec = P(batch_axes)
+        axes = mesh_spec.resolved_sizes(n_dev)
+    else:
+        axes = tp.auto_mesh_axes(
+            n_dev, model_cfg.n_heads, model_cfg.kv_heads, cap=4
+        )
+        dp_size = axes["data"]
+        mesh_spec = MeshSpec(axes=axes)
     tp_size = axes.get("model", 1)
-    mesh = build_mesh(MeshSpec(axes=axes))
+    mesh = build_mesh(mesh_spec)
 
     params = llama2.init_llama(jax.random.key(0), model_cfg)
     if tp_size > 1:
@@ -141,7 +195,7 @@ def bench_llama(
             params, tp.llama_rules(), data_size=dp_size
         )
         constrain = tp.sp_constrain(mesh, dp_axis="data", sp_axis="model")
-    elif dp_size > 1:
+    elif dp_size > 1 and comm_mode == "flat":
         specs = fsdp.param_pspecs(params, axis="data", axis_size=dp_size)
         constrain = lambda x: x  # noqa: E731
     else:
@@ -156,6 +210,7 @@ def bench_llama(
         weight_decay=0.1,
         grad_accum_steps=grad_accum_steps,
         adam_moments_dtype=moments_dtype,
+        comm_mode=comm_mode,
     )
     ds = datasets.TokenStream(
         vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
@@ -165,7 +220,7 @@ def bench_llama(
         llama2.make_forward(
             model_cfg, constrain, make_attn_fn(mesh, tp_size)
         ),
-        params, param_pspecs=specs,
+        params, param_pspecs=specs, batch_pspec=batch_pspec,
     )
     result = trainer.fit(ds)
     summary = result["epochs"][-1]
@@ -190,6 +245,9 @@ def bench_llama(
         # Effective attention config: rows from the CLI and from
         # programmatic callers must be distinguishable (ADVICE r5).
         "attn": attn,
+        # Gradient-sync strategy: BENCH JSONLs must be able to
+        # attribute a step-time delta to the comm layer, not guess it.
+        "comm_mode": comm_mode,
         **flash_blocks_record(
             attn, block_q, block_k, block_q_bwd, block_k_bwd
         ),
@@ -287,6 +345,7 @@ def bench_llama_long(
     moments_dtype: str = "float32",
     block_q: int = 512, block_k: int = 1024,
     block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
+    comm_mode: str = "flat",
 ) -> dict:
     """Long-context Llama: seq 8192 (4x the headline bench) -- the
     long-sequence regime the SP family exists for. Same harness as
@@ -303,6 +362,7 @@ def bench_llama_long(
         seq_len=seq_len, grad_accum_steps=grad_accum_steps,
         moments_dtype=moments_dtype,
         block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
+        comm_mode=comm_mode,
     )
     rec["metric"] = f"llama2_seq{seq_len}_tokens_per_s_per_chip"
     return rec
@@ -704,6 +764,8 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
          ["--workload", "llama-pp", "--pp-schedule", "gpipe"]),
         ("llama-pp interleaved-1f1b",
          ["--workload", "llama-pp", "--pp-schedule", "interleaved-1f1b"]),
+        ("llama dp bucketed-overlap sync",
+         ["--workload", "llama", "--comm-mode", "bucketed_overlap"]),
         ("llama-long seq 8192", ["--workload", "llama-long"]),
         ("serve (continuous batching)", ["--workload", "serve"]),
         ("unet ddp", ["--workload", "unet"]),
@@ -856,6 +918,18 @@ def main(argv=None) -> int:
         "unaccumulated",
     )
     ap.add_argument(
+        "--comm-mode",
+        choices=("flat", "hierarchical", "bucketed_overlap"),
+        default="flat",
+        help="gradient-sync strategy (config.comm_mode): flat = "
+        "GSPMD's fused collectives; bucketed_overlap = explicit "
+        "DDP-style size-capped bucket reductions inside shard_map; "
+        "hierarchical = bucketed + two-phase ICI/DCN decomposition. "
+        "Manual modes run the pure-DP replicated-params recipe; the "
+        "record carries comm_mode so BENCH JSONLs can attribute "
+        "step-time deltas (llama/llama-long workloads)",
+    )
+    ap.add_argument(
         "--moments-dtype", choices=("float32", "bfloat16"),
         default="float32",
         help="AdamW moment storage dtype (bfloat16 halves optimizer-"
@@ -880,6 +954,19 @@ def main(argv=None) -> int:
         args.workload = "serve"
     elif args.workload is None:
         args.workload = "llama"
+    if args.comm_mode != "flat" and (
+        args.all or args.workload not in ("llama", "llama-long")
+    ):
+        # Only the llama/llama-long workloads consume the gradient-sync
+        # knob; running any other with it silently flat would emit rows
+        # a comm-mode sweep cannot tell apart from the real thing.
+        ap.error(
+            f"--comm-mode {args.comm_mode} is only consumed by the "
+            "llama/llama-long workloads; "
+            + ("--all runs its own fixed comm-mode row"
+               if args.all else
+               f"--workload {args.workload} would silently run flat")
+        )
     if args.supervise:
         from tpu_hpc.resilience.supervisor import (
             run_supervised,
@@ -920,6 +1007,7 @@ def main(argv=None) -> int:
             grad_accum_steps=accum,
             moments_dtype=args.moments_dtype,
             block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
+            comm_mode=args.comm_mode,
         )
     elif args.workload == "llama-sp":
         batch, accum = resolve_batch_accum(
@@ -950,6 +1038,7 @@ def main(argv=None) -> int:
             moments_dtype=args.moments_dtype,
             block_q=args.block_q, block_k=args.block_k,
             block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
+            comm_mode=args.comm_mode,
         )
     elif args.workload == "serve":
         rec = bench_serve(

@@ -76,6 +76,8 @@ SLOW_NODEIDS = frozenset(nodeid for nodeid, _ in [
     ("tests/test_pp.py::TestStashBackward::test_grads_match_oracle", "12s"),
     ("tests/test_pp.py::TestStashBackward::test_ppxdp_grads_match_oracle", "13s"),
     ("tests/test_pp.py::TestStashBackward::test_stash_ring_wraparound", "9s"),
+    ("tests/test_overlap.py::TestTrainerCommMode::test_bucketed_with_grad_accum_matches_flat", "10s"),
+    ("tests/test_overlap.py::TestTrainerCommMode::test_flat_mode_no_collective_creep", "14s"),
     ("tests/test_pp.py::test_grads_match_oracle[1f1b]", "10s"),
     ("tests/test_precision.py::test_trainer_preserves_param_dtype_through_updates", "31s"),
     ("tests/test_precision.py::test_unet_vit_param_dtype_follows_config", "10s"),

@@ -155,6 +155,42 @@ def test_records_carry_effective_flash_blocks(bench):
     assert bench.flash_blocks_record("xla", 512, 1024, None, None) == {}
 
 
+def test_comm_mode_routes_to_bench_llama(bench, monkeypatch):
+    """--comm-mode must reach the workload (and through it the
+    Trainer's gradient-sync layer); defaulting silently to flat would
+    make every comm-mode sweep measure the same thing."""
+    seen = {}
+
+    def fake_bench_llama(steps, remat, batch, attn, block_q=512,
+                         block_k=1024, **kw):
+        seen.update(comm_mode=kw.get("comm_mode"))
+        return {"metric": "m", "value": 1, "unit": "u",
+                "vs_baseline": 1}
+
+    monkeypatch.setattr(bench, "bench_llama", fake_bench_llama)
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    rc = bench.main(["--comm-mode", "bucketed_overlap"])
+    assert rc == 0
+    assert seen == {"comm_mode": "bucketed_overlap"}
+
+
+def test_llama_records_carry_comm_mode(bench):
+    """Training records must be attributable to their gradient-sync
+    strategy: bench_llama (and llama-long through it) records
+    comm_mode in every JSON row, defaulting to the flat GSPMD path."""
+    import inspect
+
+    sig = inspect.signature(bench.bench_llama)
+    assert sig.parameters["comm_mode"].default == "flat"
+    assert (
+        inspect.signature(bench.bench_llama_long)
+        .parameters["comm_mode"].default == "flat"
+    )
+    src = pathlib.Path(bench.__file__).read_text()
+    # The record literally carries the effective mode (not a constant).
+    assert '"comm_mode": comm_mode' in src
+
+
 def test_serve_record_schema_matches_training_benches(bench):
     """--serve artifacts must land in the same record schema every
     training workload emits (metric/value/unit/vs_baseline), with the

@@ -20,9 +20,13 @@ from tpu_hpc.comm import (
     ring_shift,
 )
 from tpu_hpc.comm.bench import (
+    ALL_OPS,
     CommBenchmark,
+    HIER_OPS,
+    OVERLAP_OPS,
     bus_bandwidth_gb_s,
     run_comm_bench,
+    two_phase_bytes,
     write_csv,
 )
 
@@ -133,6 +137,120 @@ class TestBench:
         assert len(recs) == 1
         captured = capsys.readouterr()
         assert "busbw_GB_s" in captured.out
+
+
+class TestBenchHierOverlap:
+    """The comm-performance layer's ops in the benchmark: hierarchical
+    rows carry two-phase byte accounting (the DCN column is the whole
+    point), overlap rows ride the flat axis, and the CLI emits CSV +
+    JSONL with --op filtering."""
+
+    @pytest.fixture(scope="class")
+    def mesh_dcn(self, devices):
+        from tpu_hpc.runtime import MeshSpec, build_mesh
+
+        return build_mesh(MeshSpec(axes={"dcn": 2, "ici": 4}))
+
+    def test_two_phase_bytes_math(self):
+        # 2x4 dcn x ici, per-shard payload S=1000 bytes.
+        ici, dcn = two_phase_bytes("hier_all_reduce", 1000, 2, 4)
+        assert ici == pytest.approx(2 * 1000 * 3 / 4)   # RS + AG on S
+        assert dcn == pytest.approx(2 * 250 * 1 / 2)    # AR on S/4
+        ici, dcn = two_phase_bytes("hier_all_gather", 1000, 2, 4)
+        assert dcn == pytest.approx(1000)               # one remote copy
+        assert ici == pytest.approx(1000 * 2 * 3)       # redistribute
+        ici, dcn = two_phase_bytes("hier_reduce_scatter", 1000, 2, 4)
+        assert ici == pytest.approx(8000 * 3 / 4)       # scatter on n*S
+        assert dcn == pytest.approx(1000)
+        with pytest.raises(ValueError, match="two-phase"):
+            two_phase_bytes("all_reduce", 1000, 2, 4)
+
+    def test_hier_records_carry_phase_fields(self, mesh_dcn):
+        b = CommBenchmark(
+            mesh=mesh_dcn, axis="ici", dcn_axis="dcn",
+            sizes=[1000], warmup=0, iters=1, ops=HIER_OPS,
+        )
+        recs = b.run()
+        assert len(recs) == 3
+        for r in recs:
+            assert r["world_size"] == 8
+            assert (r["n_dcn"], r["n_ici"]) == (2, 4)
+            assert r["dcn_bytes_per_shard"] < r["ici_bytes_per_shard"]
+            assert 0 < r["dcn_fraction"] < 0.5
+            assert r["busbw_GB_s"] > 0
+        ar = next(r for r in recs if r["op"] == "hier_all_reduce")
+        # DCN wire bytes: 2 * (S / n_ici) * (n_dcn - 1) / n_dcn.
+        assert ar["dcn_bytes_per_shard"] == round(
+            2 * (ar["bytes_per_shard"] / 4) * (1 / 2)
+        )
+
+    def test_overlap_ops_produce_rows(self, mesh8):
+        b = CommBenchmark(
+            mesh=mesh8, sizes=[1000], warmup=0, iters=1,
+            ops=OVERLAP_OPS,
+        )
+        recs = b.run()
+        assert [r["op"] for r in recs] == list(OVERLAP_OPS)
+        for r in recs:
+            assert r["busbw_GB_s"] > 0 and r["world_size"] == 8
+
+    def test_hier_op_without_dcn_axis_rejected(self, mesh8):
+        b = CommBenchmark(
+            mesh=mesh8, sizes=[10], warmup=0, iters=1,
+            ops=("hier_all_reduce",),
+        )
+        with pytest.raises(ValueError, match="dcn_axis"):
+            b.run()
+
+    def test_run_comm_bench_writes_csv_and_jsonl(self, devices, tmp_path):
+        import json
+
+        out = tmp_path / "comm.csv"
+        recs = run_comm_bench(
+            sizes=[100], warmup=0, iters=1,
+            ops=("all_reduce", "hier_all_reduce", "ppermute_all_gather"),
+            output=str(out),
+        )
+        assert {r["op"] for r in recs} == {
+            "all_reduce", "hier_all_reduce", "ppermute_all_gather"
+        }
+        text = out.read_text()
+        # One superset CSV schema: flat rows leave phase cells empty.
+        assert "dcn_bytes_per_shard" in text
+        assert "hier_all_reduce" in text
+        lines = (tmp_path / "comm.jsonl").read_text().splitlines()
+        assert len(lines) == 3
+        hier = [
+            json.loads(l) for l in lines
+        ]
+        hr = next(r for r in hier if r["op"] == "hier_all_reduce")
+        assert hr["n_dcn"] == 2 and hr["n_ici"] == 4
+
+    def test_cli_op_filter(self, devices, tmp_path, capsys):
+        import json
+
+        from tpu_hpc.comm import bench as bench_mod
+
+        out = tmp_path / "f.csv"
+        bench_mod.main([
+            "--op", "hier_all_gather", "--op", "broadcast",
+            "--sizes", "64", "--warmup", "0", "--iters", "1",
+            "--output", str(out),
+        ])
+        recs = [
+            json.loads(l)
+            for l in (tmp_path / "f.jsonl").read_text().splitlines()
+        ]
+        assert {r["op"] for r in recs} == {"hier_all_gather", "broadcast"}
+        assert "wrote" in capsys.readouterr().out
+
+    def test_unknown_op_rejected(self):
+        with pytest.raises(ValueError, match="unknown ops"):
+            run_comm_bench(sizes=[10], ops=("warp_drive",))
+
+    def test_default_cli_ops_cover_the_new_families(self):
+        assert set(HIER_OPS) <= set(ALL_OPS)
+        assert set(OVERLAP_OPS) <= set(ALL_OPS)
 
 
 class TestEnvCheck:

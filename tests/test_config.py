@@ -71,3 +71,19 @@ def test_mesh_spec_dcn():
 def test_mesh_spec_rejects_bad_dcn():
     with pytest.raises(ValueError, match="dcn_data_parallel"):
         TrainingConfig(dcn_data_parallel=0).mesh_spec()
+
+
+def test_comm_mode_fields(tmp_path):
+    c = TrainingConfig()
+    assert c.comm_mode == "flat"
+    assert c.comm_bucket_mb == 25
+    # CLI plumbing (the bench sweeps pass these through).
+    c2 = TrainingConfig.from_args(
+        ["--comm-mode", "bucketed_overlap", "--comm-bucket-mb", "8"]
+    )
+    assert c2.comm_mode == "bucketed_overlap"
+    assert c2.comm_bucket_mb == 8
+    # YAML roundtrip keeps the comm layer in the run snapshot.
+    p = tmp_path / "cfg.yaml"
+    c2.to_yaml(str(p))
+    assert TrainingConfig.from_yaml(str(p)).comm_mode == "bucketed_overlap"

@@ -1,0 +1,180 @@
+"""Parity + HLO-decomposition guards for the DCN-aware hierarchical
+collectives (comm/hierarchical.py).
+
+Every two-phase op must produce the SAME global values as the flat
+one-axis primitive on the 8-device sim mesh (2 x 4 dcn x ici): the
+decomposition is a wire-level optimization, never a semantics change.
+The HLO guards then pin the decomposition itself -- exactly one ICI
+reduce-scatter, one DCN all-reduce, one ICI all-gather for the
+hierarchical all-reduce -- via checks/hlo.py, so a refactor that
+silently collapses the phases back into a flat collective (or doubles
+them) fails here, not in a DCN-saturated profile later.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_hpc.checks import hlo
+from tpu_hpc.comm import hierarchical as hc
+from tpu_hpc.comm import primitives
+from tpu_hpc.runtime import MeshSpec, build_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh_dcn(devices):
+    """The 2 x 4 dcn x ici mesh: two emulated slices of four chips."""
+    return build_mesh(MeshSpec(axes={"dcn": 2, "ici": 4}))
+
+
+def _hier(mesh, x, *spec):
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+class TestParity:
+    """Hierarchical vs flat, same global input -> same global output.
+    (Values are placement-independent: AR/AG outputs are replicated,
+    RS output is a well-defined global array.)"""
+
+    def test_all_reduce(self, mesh_dcn, mesh8):
+        x = jnp.arange(64.0).reshape(32, 2)
+        out = hc.hier_all_reduce(mesh_dcn)(_hier(mesh_dcn, x, ("dcn", "ici")))
+        ref = primitives.all_reduce(mesh8, "data")(_hier(mesh8, x, "data"))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+    def test_all_reduce_nondivisible_leading_dim(self, mesh_dcn, mesh8):
+        # Local shard [3, 5]: 3 % n_ici(4) != 0 -- exercises the
+        # zero-pad + slice-back path around the ICI scatter phase.
+        x = jnp.arange(120.0).reshape(24, 5)
+        out = hc.hier_all_reduce(mesh_dcn)(_hier(mesh_dcn, x, ("dcn", "ici")))
+        ref = primitives.all_reduce(mesh8, "data")(_hier(mesh8, x, "data"))
+        assert out.shape == (3, 5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+    def test_all_gather(self, mesh_dcn, mesh8):
+        # Odd per-shard extent (5): the gather phases have no
+        # divisibility constraint, and the local reorder must still
+        # restore combined-axis (dcn-slowest) order.
+        x = jnp.arange(40.0)
+        out = hc.hier_all_gather(mesh_dcn)(_hier(mesh_dcn, x, ("dcn", "ici")))
+        ref = primitives.all_gather(mesh8, "data")(_hier(mesh8, x, "data"))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_reduce_scatter(self, mesh_dcn, mesh8):
+        x = jnp.arange(48.0).reshape(16, 3)
+        out = hc.hier_reduce_scatter(mesh_dcn)(_hier(mesh_dcn, x))
+        ref = primitives.reduce_scatter(mesh8, "data")(_hier(mesh8, x))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+        # NCCL convention: replicated input, each copy a contribution.
+        np.testing.assert_allclose(np.asarray(out), 8.0 * np.asarray(x))
+
+    def test_reduce_scatter_nondivisible_rejected(self, mesh_dcn):
+        # Same contract as the flat op: output slices must be whole.
+        with pytest.raises(ValueError, match="must divide"):
+            hc.hier_reduce_scatter(mesh_dcn)(
+                _hier(mesh_dcn, jnp.arange(12.0))
+            )
+
+    def test_bf16_matches_fp32_flat_reference(self, mesh_dcn, mesh8):
+        # bf16 payloads ride the same decomposition; parity against
+        # the fp32 flat reference within bf16 resolution (the sum of
+        # 8 shards of O(1) values rounds at ~2^-8 relative).
+        x32 = jax.random.normal(jax.random.key(0), (32, 4))
+        x16 = x32.astype(jnp.bfloat16)
+        out = hc.hier_all_reduce(mesh_dcn)(
+            _hier(mesh_dcn, x16, ("dcn", "ici"))
+        )
+        assert out.dtype == jnp.bfloat16
+        ref = primitives.all_reduce(mesh8, "data")(_hier(mesh8, x32, "data"))
+        # atol covers cancellation: a sum of 8 bf16-rounded O(1) terms
+        # landing near zero carries absolute error ~8 * 2^-8.
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32), np.asarray(ref),
+            rtol=2e-2, atol=5e-2,
+        )
+
+
+class TestDegenerateAxes:
+    def test_dcn_1_degrades_to_flat_ici_op(self, devices, mesh8):
+        # A single slice must run the plain ICI collective -- no
+        # phantom DCN phase, no crash (the single-slice default).
+        mesh = build_mesh(MeshSpec(axes={"dcn": 1, "ici": 8}))
+        x = jnp.arange(24.0).reshape(8, 3)
+        out = hc.hier_all_reduce(mesh)(_hier(mesh, x, ("dcn", "ici")))
+        ref = primitives.all_reduce(mesh8, "data")(_hier(mesh8, x, "data"))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+        og = hc.hier_all_gather(mesh)(_hier(mesh, x, ("dcn", "ici")))
+        np.testing.assert_allclose(np.asarray(og), np.asarray(x))
+        xr = jnp.arange(16.0)
+        orr = hc.hier_reduce_scatter(mesh)(_hier(mesh, xr))
+        np.testing.assert_allclose(np.asarray(orr), 8.0 * np.asarray(xr))
+
+    def test_dcn_1_lowers_without_scatter_phases(self, devices):
+        mesh = build_mesh(MeshSpec(axes={"dcn": 1, "ici": 8}))
+        counts = hlo.collective_counts(
+            hlo.lowered_text(hc.hier_all_reduce(mesh), jnp.arange(16.0))
+        )
+        assert counts["all-reduce"] == 1, counts
+        assert counts["reduce-scatter"] == 0, counts
+        assert counts["all-gather"] == 0, counts
+
+    def test_ici_1_degrades_to_pure_dcn_op(self, devices):
+        # ICI extent 1 (pure cross-slice axis): the flat DCN op.
+        mesh = build_mesh(
+            MeshSpec(axes={"dcn": 2, "ici": 1}), devices=devices[:2]
+        )
+        x = jnp.arange(8.0)
+        out = hc.hier_all_reduce(mesh)(_hier(mesh, x, ("dcn", "ici")))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(x.reshape(2, 4).sum(0))
+        )
+
+
+class TestHLOGuard:
+    """Pin the decomposition in lowered StableHLO (backend-independent,
+    pre-legalization): the program IS N ici-subgroup phases + 1 dcn
+    phase, with replica-group shapes proving which axis each phase
+    reduces over (2 groups of 4 = ICI subgroups; 4 groups of 2 = DCN
+    pairs on the 2x4 mesh)."""
+
+    def test_all_reduce_is_rs_ar_ag(self, mesh_dcn):
+        x = jnp.arange(64.0)
+        text = hlo.lowered_text(hc.hier_all_reduce(mesh_dcn), x)
+        counts = hlo.collective_counts(text)
+        assert counts == {
+            "all-gather": 1,
+            "all-reduce": 1,
+            "reduce-scatter": 1,
+            "collective-permute": 0,
+            "all-to-all": 0,
+        }, counts
+        # Phase axes: the scatter/gather ride ICI (groups of n_ici=4),
+        # the all-reduce crosses DCN (groups of n_dcn=2).
+        assert hlo.collective_group_shapes(text, "reduce-scatter") == [(2, 4)]
+        assert hlo.collective_group_shapes(text, "all-reduce") == [(4, 2)]
+        assert hlo.collective_group_shapes(text, "all-gather") == [(2, 4)]
+
+    def test_all_gather_is_two_gathers(self, mesh_dcn):
+        text = hlo.lowered_text(hc.hier_all_gather(mesh_dcn), jnp.arange(8.0))
+        counts = hlo.collective_counts(text)
+        assert counts["all-gather"] == 2, counts
+        assert counts["all-reduce"] == 0, counts
+        assert counts["reduce-scatter"] == 0, counts
+        # DCN phase first (on the small shard), then ICI.
+        assert sorted(
+            hlo.collective_group_shapes(text, "all-gather")
+        ) == [(2, 4), (4, 2)]
+
+    def test_reduce_scatter_is_two_scatters(self, mesh_dcn):
+        text = hlo.lowered_text(
+            hc.hier_reduce_scatter(mesh_dcn), jnp.arange(32.0)
+        )
+        counts = hlo.collective_counts(text)
+        assert counts["reduce-scatter"] == 2, counts
+        assert counts["all-reduce"] == 0, counts
+        assert counts["all-gather"] == 0, counts
+        assert sorted(
+            hlo.collective_group_shapes(text, "reduce-scatter")
+        ) == [(2, 4), (4, 2)]

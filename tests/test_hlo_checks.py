@@ -1,0 +1,125 @@
+"""checks/hlo.py: the collective-counting instrument, plus the
+broadcast HLO-cost pin it exists to make cheap.
+
+The counter must read both dialects (lowered StableHLO for shard_map
+programs, compiled HLO for GSPMD-inserted collectives) and report
+replica-group shapes without depending on device numbering -- the
+hierarchical decomposition guards in test_hierarchical.py are built
+on exactly these properties.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_hpc.checks import hlo
+from tpu_hpc.comm import primitives
+
+
+class TestCollectiveCounts:
+    def test_stablehlo_spelling(self, mesh8):
+        text = hlo.lowered_text(
+            primitives.all_reduce(mesh8, "data"), jnp.arange(8.0)
+        )
+        assert "stablehlo.all_reduce" in text
+        counts = hlo.collective_counts(text)
+        assert counts["all-reduce"] == 1
+        assert sum(counts.values()) == 1
+
+    def test_compiled_hlo_spelling(self, mesh8):
+        x = jax.device_put(
+            jnp.arange(8.0), NamedSharding(mesh8, P("data"))
+        )
+        text = hlo.compiled_text(primitives.all_reduce(mesh8, "data"), x)
+        counts = hlo.collective_counts(text)
+        assert counts["all-reduce"] == 1, counts
+
+    def test_counts_cover_the_fit_report_list(self):
+        # Single source: the fit report's signature list IS this list.
+        from tpu_hpc.checks.fit import _COLLECTIVES
+
+        assert tuple(_COLLECTIVES) == hlo.COLLECTIVE_OPS
+
+    def test_group_shapes_stablehlo(self, mesh8):
+        text = hlo.lowered_text(
+            primitives.all_reduce(mesh8, "data"), jnp.arange(8.0)
+        )
+        assert hlo.collective_group_shapes(text, "all-reduce") == [(1, 8)]
+
+    def test_group_shapes_compiled(self, mesh8):
+        x = jax.device_put(
+            jnp.arange(8.0), NamedSharding(mesh8, P("data"))
+        )
+        text = hlo.compiled_text(primitives.all_reduce(mesh8, "data"), x)
+        shapes = hlo.collective_group_shapes(text, "all-reduce")
+        assert shapes and shapes[0] == (1, 8), shapes
+
+    def test_no_collectives_counts_zero(self):
+        text = hlo.lowered_text(lambda x: x * 2.0, jnp.arange(4.0))
+        assert sum(hlo.collective_counts(text).values()) == 0
+
+    def test_group_shapes_iota_form(self):
+        # Newer XLA on large meshes prints replica groups in the iota
+        # form instead of a dense id list; the shape is in the literal.
+        text = (
+            "%ar = f32[8] all-reduce-start(f32[8] %p), "
+            "replica_groups=[2,4]<=[8], to_apply=%add\n"
+        )
+        assert hlo.collective_group_shapes(text, "all-reduce") == [(2, 4)]
+
+    def test_group_shapes_no_neighbor_bleed(self):
+        # An op with no replica_groups of its own (collective-permute
+        # uses source_target_pairs) must report (1, 0) even when a
+        # grouped collective follows in the same program -- the search
+        # window is bounded by the next collective mention.
+        text = (
+            "%cp = f32[4] collective-permute(f32[4] %p), "
+            "source_target_pairs={{0,1},{1,0}}\n"
+            "%ag = f32[8] all-gather(f32[4] %cp), "
+            "replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}\n"
+        )
+        assert hlo.collective_group_shapes(
+            text, "collective-permute"
+        ) == [(1, 0)]
+        assert hlo.collective_group_shapes(text, "all-gather") == [(2, 4)]
+
+
+class TestBroadcastCost:
+    """Satellite pin: primitives.broadcast builds its contribution with
+    a jnp.where mask over the full payload -- the cost question is
+    whether that lowers to ONE masked psum or degenerates into a psum
+    per root candidate. Pinned: exactly one all-reduce, zero other
+    collectives, in lowered AND compiled form, independent of the
+    axis size (8 here vs 4 below)."""
+
+    def test_one_psum_lowered(self, mesh8):
+        text = hlo.lowered_text(
+            primitives.broadcast(mesh8, "data", root=3), jnp.arange(16.0)
+        )
+        counts = hlo.collective_counts(text)
+        assert counts["all-reduce"] == 1, counts
+        assert sum(counts.values()) == 1, counts
+
+    def test_one_psum_compiled(self, mesh8):
+        x = jax.device_put(
+            jnp.arange(16.0), NamedSharding(mesh8, P("data"))
+        )
+        text = hlo.compiled_text(
+            primitives.broadcast(mesh8, "data", root=3), x
+        )
+        counts = hlo.collective_counts(text)
+        assert counts["all-reduce"] == 1, counts
+        assert sum(counts.values()) == 1, counts
+
+    @pytest.mark.parametrize("root", [0, 2])
+    def test_cost_independent_of_axis_size_and_root(self, devices, root):
+        from tpu_hpc.runtime import MeshSpec, build_mesh
+
+        mesh4 = build_mesh(MeshSpec(axes={"data": 4}), devices=devices[:4])
+        text = hlo.lowered_text(
+            primitives.broadcast(mesh4, "data", root=root),
+            jnp.arange(8.0),
+        )
+        counts = hlo.collective_counts(text)
+        assert counts["all-reduce"] == 1, counts
+        assert sum(counts.values()) == 1, counts

@@ -77,6 +77,14 @@ def _install_jax_compat() -> None:
 
         _jax.lax.axis_size = axis_size
 
+    # jax.P / jax.NamedSharding graduated to top-level aliases after
+    # 0.4.x; env_check's all_reduce_smoke (and current-API user code)
+    # spells them the new way.
+    if not hasattr(_jax, "P"):
+        _jax.P = _jax.sharding.PartitionSpec
+    if not hasattr(_jax, "NamedSharding"):
+        _jax.NamedSharding = _jax.sharding.NamedSharding
+
     # The *_with_path family graduated from jax.tree_util to jax.tree
     # after 0.4.x; alias the originals.
     for _name in (

@@ -1,1 +1,7 @@
 from tpu_hpc.checks.env_check import check_environment, main  # noqa: F401
+from tpu_hpc.checks.hlo import (  # noqa: F401
+    collective_counts,
+    collective_group_shapes,
+    compiled_text,
+    lowered_text,
+)

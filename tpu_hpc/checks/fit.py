@@ -48,14 +48,10 @@ GIB = 1024 ** 3
 
 # Collectives worth reporting from the compiled module (the comm
 # signature of the plan; parity with reading NCCL_DEBUG=INFO logs,
-# /root/reference/docs/guide/nccl_tuning.md:153-173).
-_COLLECTIVES = (
-    "all-gather",
-    "all-reduce",
-    "reduce-scatter",
-    "collective-permute",
-    "all-to-all",
-)
+# /root/reference/docs/guide/nccl_tuning.md:153-173). Single-sourced
+# with the HLO counting helper so the fit report and the comm-guard
+# tests can never disagree on what counts as a collective.
+from tpu_hpc.checks.hlo import COLLECTIVE_OPS as _COLLECTIVES  # noqa: E402
 
 
 def _leaf_bytes_per_chip(leaf, spec: P, mesh_axes: Dict[str, int]) -> int:

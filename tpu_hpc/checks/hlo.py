@@ -1,0 +1,137 @@
+"""Collective-op counting in lowered/compiled programs.
+
+The comm-signature tests pin each strategy's collective *kinds* by
+grepping compiled HLO; this module is the shared, slightly sharper
+instrument: per-kind counts plus replica-group shapes, over either
+
+* **lowered StableHLO** (``lowered_text``) -- backend-independent,
+  pre-optimization. The right view for ``shard_map`` programs, whose
+  collectives are explicit in the traced module: a decomposition
+  guard ("hierarchical all-reduce = one ICI reduce-scatter + one DCN
+  all-reduce + one ICI all-gather") pins the *program*, immune to
+  backend legalization (CPU may rewrite reduce-scatter into
+  all-reduce + slice at compile time).
+* **compiled HLO** (``compiled_text``) -- post-SPMD-partitioning. The
+  only view that sees collectives GSPMD *inserts* for jit+sharding
+  programs (the scanned train step), at the cost of backend-dependent
+  spellings (sync + ``-start`` async forms are both counted).
+
+Replica-group shapes distinguish the phases of a hierarchical op
+without depending on exact device numbering: on a (dcn=2, ici=4)
+mesh the ICI-phase op carries ``tensor<2x4xi64>`` groups (2 groups of
+4) and the DCN-phase op ``tensor<4x2xi64>`` (4 groups of 2), whatever
+the device assignment.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+import jax
+
+# Canonical collective kinds, HLO spelling (single-sourced with the
+# fit report's signature list -- see checks/fit.py _COLLECTIVES).
+COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# StableHLO spells the same ops with underscores; collective-permute's
+# paired start/done form is collective_permute in both dialects.
+def _stablehlo_name(op: str) -> str:
+    return op.replace("-", "_")
+
+
+def collective_counts(text: str) -> Dict[str, int]:
+    """Per-kind collective counts in an HLO or StableHLO module text.
+
+    Counts both dialect spellings (``all-reduce(`` / ``all-reduce-start(``
+    in HLO, ``stablehlo.all_reduce`` in StableHLO), so the same helper
+    reads ``lowered_text`` and ``compiled_text`` output. A module that
+    mixes dialects never occurs in practice; the sum is still correct
+    if it did.
+    """
+    counts = {}
+    for op in COLLECTIVE_OPS:
+        n_hlo = text.count(f"{op}(") + text.count(f"{op}-start(")
+        n_shlo = text.count(f"stablehlo.{_stablehlo_name(op)}")
+        counts[op] = n_hlo + n_shlo
+    return counts
+
+
+def lowered_text(fn, *args) -> str:
+    """Pre-optimization StableHLO of ``jit(fn)`` on ``args`` -- explicit
+    (shard_map) collectives only; GSPMD has not run yet."""
+    return jax.jit(fn).lower(*args).as_text()
+
+
+def compiled_text(fn, *args) -> str:
+    """Post-compile HLO of ``jit(fn)`` on ``args`` -- includes the
+    collectives the SPMD partitioner inserted."""
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+# "replica_groups = dense<...> : tensor<GxSxi64>" -- the tensor type
+# carries (group count x group size) directly, no need to parse ids.
+_STABLEHLO_GROUPS = re.compile(
+    r"replica_groups\s*=\s*dense<[^>]*>\s*:\s*tensor<(\d+)x(\d+)xi64>"
+)
+# Compiled HLO: replica_groups={{0,1,2,3},{4,5,6,7}}
+_HLO_GROUPS = re.compile(r"replica_groups=\{(\{[0-9, ]+\}(?:,\{[0-9, ]+\})*)\}")
+# Compiled HLO, iota form (newer XLA on large meshes, where the dense
+# id list would be enormous): replica_groups=[2,4]<=[8] is 2 groups
+# of 4 -- the shape is in the literal, no ids to parse.
+_HLO_IOTA_GROUPS = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[")
+
+
+def collective_group_shapes(text: str, op: str) -> List[Tuple[int, int]]:
+    """(n_groups, group_size) of each ``op`` occurrence, in program
+    order -- the axis-structure fingerprint of a decomposition.
+
+    Looks at the text from each op mention up to the NEXT collective
+    mention (of any kind) for its replica_groups attribute -- bounded
+    so an occurrence that carries none (collective-permute's
+    source_target_pairs, an empty ``replica_groups={}``) can never be
+    attributed the groups of a neighboring op; such occurrences report
+    (1, 0) meaning "unspecified".
+    """
+    shapes: List[Tuple[int, int]] = []
+    names = (f"stablehlo.{_stablehlo_name(op)}", f"{op}(", f"{op}-start(")
+    spans = sorted(
+        m.start() for name in names for m in re.finditer(re.escape(name), text)
+    )
+    all_names = [
+        n for o in COLLECTIVE_OPS
+        for n in (f"stablehlo.{_stablehlo_name(o)}", f"{o}(", f"{o}-start(")
+    ]
+    all_spans = sorted(
+        m.start() for n in all_names for m in re.finditer(re.escape(n), text)
+    )
+    for start in spans:
+        # Window bounded by the NEXT collective mention, so a grouped
+        # neighbor can never be misattributed; the byte cap only
+        # guards against pathological scans, sized so even a dense id
+        # literal for thousands of devices fits before its tensor type.
+        nxt = next((s for s in all_spans if s > start), len(text))
+        window = text[start:min(start + 200_000, nxt)]
+        m = _STABLEHLO_GROUPS.search(window)
+        if m:
+            shapes.append((int(m.group(1)), int(m.group(2))))
+            continue
+        m = _HLO_IOTA_GROUPS.search(window)
+        if m:
+            shapes.append((int(m.group(1)), int(m.group(2))))
+            continue
+        m = _HLO_GROUPS.search(window)
+        if m:
+            groups = m.group(1).split("},{")
+            sizes = {len(g.strip("{}").split(",")) for g in groups}
+            shapes.append(
+                (len(groups), sizes.pop() if len(sizes) == 1 else 0)
+            )
+            continue
+        shapes.append((1, 0))
+    return shapes

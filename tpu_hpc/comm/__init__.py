@@ -6,4 +6,19 @@ from tpu_hpc.comm.primitives import (  # noqa: F401
     reduce_scatter,
     ring_shift,
 )
+from tpu_hpc.comm.hierarchical import (  # noqa: F401
+    all_gather_two_phase,
+    hier_all_gather,
+    hier_all_reduce,
+    hier_reduce_scatter,
+    psum_two_phase,
+    reduce_scatter_two_phase,
+)
+from tpu_hpc.comm.overlap import (  # noqa: F401
+    gather_matmul,
+    make_pipelined_gather_matmul,
+    make_synced_value_and_grad,
+    ppermute_all_gather,
+    ring_all_gather,
+)
 from tpu_hpc.comm.bench import CommBenchmark, run_comm_bench  # noqa: F401

@@ -8,6 +8,21 @@ Parity with /root/reference/tests/torch_comm_bench.py:
   * CSV output with a full environment-metadata header (:137-194)
   * CLI flags for sizes/warmup/bench/output             (:253-267)
 
+Beyond the port, the comm-performance layer's ops are benched too:
+  * hierarchical (two-phase) all-reduce / all-gather / reduce-scatter
+    over a (dcn x ici) mesh (comm.hierarchical), with two-phase
+    bus-bandwidth accounting: each record carries the per-device wire
+    bytes of the ICI and DCN phases separately, because the whole
+    point of the decomposition is that the DCN share shrinks by
+    ~n_ici while the flat op ships the full payload cross-slice.
+  * the overlap building blocks (comm.overlap): the ppermute ring
+    all-gather and the collective-matmul-style gather_matmul (whose
+    time includes the overlapped partial matmuls -- its busbw row is
+    a lower bound on the gather throughput, by design).
+
+Records land as CSV (metadata header + rows) AND JSONL (one record
+per line, the BENCH-artifact format) when an output path is given.
+
 The "barrier" on TPU is ``block_until_ready`` on the input (ensures
 async dispatch has drained) before starting the clock, and on the
 output before stopping it -- the same wall-clock bracketing as the
@@ -25,22 +40,53 @@ from __future__ import annotations
 import csv
 import dataclasses
 import io
+import json
+import os
 import socket
+import sys
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_hpc.comm import primitives
+from tpu_hpc.comm import hierarchical, overlap, primitives
 
 DEFAULT_SIZES = tuple(10**k for k in range(3, 9))  # torch_comm_bench.py:174
 OPS = (
     "broadcast", "all_reduce", "all_gather", "reduce_scatter",
     "ring_shift", "all_to_all",
 )
+# Two-phase decompositions: need a (dcn x ici) mesh (comm.hierarchical).
+HIER_OPS = ("hier_all_reduce", "hier_all_gather", "hier_reduce_scatter")
+# Comm/compute-overlap building blocks (comm.overlap); run on the flat
+# axis like the classic ops.
+OVERLAP_OPS = ("ppermute_all_gather", "gather_matmul")
+ALL_OPS = OPS + HIER_OPS + OVERLAP_OPS
+
+# gather_matmul's fixed output width: the benched payload is the
+# sharded weight [K/n, N]; K scales with the requested element count.
+_GM_COLS = 128
+_GM_ROWS_PER_SHARD = 8
+
+# busbw factor class of each op (NCCL-tests convention, applied to the
+# per-shard payload): the hierarchical/overlap ops reuse their flat
+# op's factor so their rows are directly comparable to the flat rows.
+_BUSBW_BASE = {
+    "broadcast": "broadcast",
+    "all_reduce": "all_reduce",
+    "all_gather": "all_gather",
+    "reduce_scatter": "reduce_scatter",
+    "ring_shift": "ring_shift",
+    "all_to_all": "all_to_all",
+    "hier_all_reduce": "all_reduce",
+    "hier_all_gather": "all_gather",
+    "hier_reduce_scatter": "reduce_scatter",
+    "ppermute_all_gather": "all_gather",
+    "gather_matmul": "all_gather",
+}
 
 
 def bus_bandwidth_gb_s(op: str, bytes_per_shard: int, n: int, t: float) -> float:
@@ -48,7 +94,10 @@ def bus_bandwidth_gb_s(op: str, bytes_per_shard: int, n: int, t: float) -> float
 
     broadcast: size/t. all-reduce: 2(n-1)/n * size/t. all-gather and
     reduce-scatter move (n-1)/n * size: the standard NCCL-tests busbw
-    factors, applied unchanged to ICI.
+    factors, applied unchanged to ICI. Hierarchical/overlap ops use
+    their flat op's factor over the TOTAL axis extent (comparability
+    with the flat row; the phase split is reported separately by
+    :func:`two_phase_bytes`).
     """
     if t <= 0:
         return float("inf")
@@ -59,13 +108,48 @@ def bus_bandwidth_gb_s(op: str, bytes_per_shard: int, n: int, t: float) -> float
         "reduce_scatter": (n - 1) / n,
         "ring_shift": 1.0,
         "all_to_all": (n - 1) / n,
-    }[op]
+    }[_BUSBW_BASE[op]]
     return factor * bytes_per_shard / t / 1e9
+
+
+def two_phase_bytes(
+    op: str, bytes_per_shard: int, n_dcn: int, n_ici: int
+) -> Tuple[float, float]:
+    """Per-device wire bytes of each phase of a hierarchical op:
+    ``(ici_bytes, dcn_bytes)``.
+
+    S = per-shard payload bytes; the decompositions are in
+    comm.hierarchical. The headline number is the DCN column: the
+    flat op ships its FULL cross-slice share over DCN, the two-phase
+    op only the 1/n_ici-reduced shard (all-reduce) or exactly one
+    copy of each remote shard (all-gather).
+
+      hier_all_reduce:     ICI 2*S*(n_ici-1)/n_ici   (RS + AG on S)
+                           DCN 2*(S/n_ici)*(n_dcn-1)/n_dcn
+      hier_all_gather:     ICI S*n_dcn*(n_ici-1)     (redistribute)
+                           DCN S*(n_dcn-1)           (one copy each)
+      hier_reduce_scatter: ICI n*S*(n_ici-1)/n_ici   (scatter on n*S)
+                           DCN S*(n_dcn-1)           (1/n_ici chunk)
+    """
+    s = float(bytes_per_shard)
+    if op == "hier_all_reduce":
+        return (
+            2.0 * s * (n_ici - 1) / n_ici,
+            2.0 * (s / n_ici) * (n_dcn - 1) / n_dcn,
+        )
+    if op == "hier_all_gather":
+        return s * n_dcn * (n_ici - 1), s * (n_dcn - 1)
+    if op == "hier_reduce_scatter":
+        n = n_dcn * n_ici
+        return n * s * (n_ici - 1) / n_ici, s * (n_dcn - 1)
+    raise ValueError(f"not a two-phase op: {op}")
 
 
 @dataclasses.dataclass
 class CommBenchmark:
-    """Configurable collective benchmark over one mesh axis."""
+    """Configurable collective benchmark over one mesh axis (flat and
+    overlap ops) or a (dcn x ici) axis pair (hierarchical ops, with
+    ``dcn_axis`` naming the outer tier)."""
 
     mesh: Mesh
     axis: str = "data"
@@ -74,51 +158,95 @@ class CommBenchmark:
     iters: int = 20  # :256
     ops: Sequence[str] = OPS
     dtype: str = "float32"
+    dcn_axis: Optional[str] = None
+
+    def _world(self, op: str) -> int:
+        n = self.mesh.shape[self.axis]
+        if op in HIER_OPS:
+            return n * self.mesh.shape[self.dcn_axis]
+        return n
+
+    def _fn_for(self, op: str):
+        if op in HIER_OPS:
+            if self.dcn_axis is None:
+                raise ValueError(
+                    f"{op} needs dcn_axis= (a two-tier mesh); got a "
+                    "flat single-axis benchmark"
+                )
+            return getattr(hierarchical, op)(
+                self.mesh, self.dcn_axis, self.axis
+            )
+        if op == "ppermute_all_gather":
+            return overlap.ppermute_all_gather(self.mesh, self.axis)
+        if op == "gather_matmul":
+            return overlap.make_pipelined_gather_matmul(self.mesh, self.axis)
+        return getattr(primitives, op)(self.mesh, self.axis)
 
     def _input_for(self, op: str, n_elements: int):
-        """Build the benchmark payload. ``n_elements`` is the per-shard
-        element count (matching the reference, where every rank holds
-        `size` elements)."""
-        n = self.mesh.shape[self.axis]
+        """Build the benchmark payload: ``(args, bytes_per_shard)``.
+        ``n_elements`` is the per-shard element count (matching the
+        reference, where every rank holds `size` elements)."""
+        n = self._world(op)
         dt = jnp.dtype(self.dtype)
-        if op in ("broadcast", "all_reduce", "all_gather", "ring_shift"):
-            # globally [n*size], sharded over axis: each device holds `size`.
+        data_spec = (
+            P((self.dcn_axis, self.axis)) if op in HIER_OPS
+            else P(self.axis)
+        )
+        if op in (
+            "broadcast", "all_reduce", "all_gather", "ring_shift",
+            "hier_all_reduce", "hier_all_gather", "ppermute_all_gather",
+        ):
+            # globally [n*size], sharded over the axis (pair): each
+            # device holds `size`.
             x = jnp.arange(n * n_elements, dtype=dt)
-            return jax.device_put(x, NamedSharding(self.mesh, P(self.axis)))
-        elif op == "reduce_scatter":
+            x = jax.device_put(x, NamedSharding(self.mesh, data_spec))
+            return (x,), x.nbytes // n
+        elif op in ("reduce_scatter", "hier_reduce_scatter"):
             # replicated [n*size] input; output sharded.
             x = jnp.arange(n * n_elements, dtype=dt)
-            return jax.device_put(x, NamedSharding(self.mesh, P()))
+            x = jax.device_put(x, NamedSharding(self.mesh, P()))
+            return (x,), x.nbytes // n
         elif op == "all_to_all":
             # The Ulysses building block: [n, inner] sharded on dim 0
             # in, dim 1 out; each device still holds ~``size`` elements
             # (inner rounded up so the n-way column split is exact).
             inner = -(-n_elements // n) * n
             x = jnp.arange(n * inner, dtype=dt).reshape(n, inner)
-            return jax.device_put(x, NamedSharding(self.mesh, P(self.axis)))
+            x = jax.device_put(x, NamedSharding(self.mesh, P(self.axis)))
+            return (x,), x.nbytes // n
+        elif op == "gather_matmul":
+            # FSDP forward shape: x batch-sharded [n*rows, K], weight
+            # dim-0-sharded [K, cols]; the benched payload is the
+            # weight shard (what the ring gathers).
+            k_shard = max(-(-n_elements // _GM_COLS), 1)
+            k = n * k_shard
+            w = jnp.arange(k * _GM_COLS, dtype=dt).reshape(k, _GM_COLS)
+            x = jnp.ones((n * _GM_ROWS_PER_SHARD, k), dtype=dt)
+            w = jax.device_put(w, NamedSharding(self.mesh, P(self.axis)))
+            x = jax.device_put(x, NamedSharding(self.mesh, P(self.axis)))
+            return (x, w), w.nbytes // n
         raise ValueError(op)
 
     def run(self) -> List[Dict]:
-        n = self.mesh.shape[self.axis]
         records = []
         for op in self.ops:
-            fn = getattr(primitives, op)(self.mesh, self.axis)
+            fn = self._fn_for(op)
+            n = self._world(op)
             for size in self.sizes:
-                x = self._input_for(op, size)
-                x.block_until_ready()
+                args, nbytes = self._input_for(op, size)
+                for a in args:
+                    a.block_until_ready()
                 for _ in range(self.warmup):
-                    fn(x).block_until_ready()
+                    fn(*args).block_until_ready()
                 times = []
                 for _ in range(self.iters):
-                    x.block_until_ready()  # barrier (ref :44-46)
+                    for a in args:  # barrier (ref :44-46)
+                        a.block_until_ready()
                     t0 = time.perf_counter()
-                    out = fn(x)
+                    out = fn(*args)
                     out.block_until_ready()  # synchronize (ref :52-56)
                     times.append(time.perf_counter() - t0)
                 times = np.asarray(times)
-                # Per-shard payload from the actual array (all_to_all
-                # rounds the element count up to an n-divisible size).
-                nbytes = x.nbytes // n
                 rec = {
                     "op": op,
                     "size_elements": size,
@@ -132,6 +260,21 @@ class CommBenchmark:
                         op, nbytes, n, float(times.mean())
                     ),
                 }
+                if op in HIER_OPS:
+                    n_dcn = self.mesh.shape[self.dcn_axis]
+                    n_ici = self.mesh.shape[self.axis]
+                    ici_b, dcn_b = two_phase_bytes(
+                        op, nbytes, n_dcn, n_ici
+                    )
+                    rec.update({
+                        "n_dcn": n_dcn,
+                        "n_ici": n_ici,
+                        "ici_bytes_per_shard": round(ici_b),
+                        "dcn_bytes_per_shard": round(dcn_b),
+                        "dcn_fraction": round(
+                            dcn_b / (dcn_b + ici_b), 6
+                        ) if (dcn_b + ici_b) else 0.0,
+                    })
                 records.append(rec)
         return records
 
@@ -152,6 +295,18 @@ def _env_metadata(mesh: Mesh) -> Dict[str, str]:
     }
 
 
+def _fieldnames(records: List[Dict]) -> List[str]:
+    """Union of record keys in first-seen order: hierarchical records
+    carry phase columns the flat rows lack, and DictWriter must see
+    one superset schema (missing cells stay empty)."""
+    names: List[str] = []
+    for r in records:
+        for k in r:
+            if k not in names:
+                names.append(k)
+    return names
+
+
 def write_csv(records: List[Dict], mesh: Mesh, path: Optional[str]) -> str:
     """Write benchmark CSV (metadata as comment lines, then rows).
     Returns the CSV text. Rank-0-only output is implicit: call from
@@ -160,7 +315,7 @@ def write_csv(records: List[Dict], mesh: Mesh, path: Optional[str]) -> str:
     for k, v in _env_metadata(mesh).items():
         buf.write(f"# {k}: {v}\n")
     if records:
-        w = csv.DictWriter(buf, fieldnames=list(records[0].keys()))
+        w = csv.DictWriter(buf, fieldnames=_fieldnames(records))
         w.writeheader()
         w.writerows(records)
     text = buf.getvalue()
@@ -168,6 +323,16 @@ def write_csv(records: List[Dict], mesh: Mesh, path: Optional[str]) -> str:
         with open(path, "w") as f:
             f.write(text)
     return text
+
+
+def write_jsonl(records: List[Dict], path: str) -> None:
+    """One JSON record per line -- the BENCH-artifact format, so comm
+    rows can ride next to training/serving rows in the same tooling."""
+    if jax.process_index() != 0:
+        return
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
 
 
 def run_comm_bench(
@@ -178,46 +343,156 @@ def run_comm_bench(
     iters: int = 20,
     ops: Sequence[str] = OPS,
     output: Optional[str] = None,
+    dcn: Optional[int] = None,
+    hier_mesh: Optional[Mesh] = None,
 ) -> List[Dict]:
     """One-call benchmark entry (the ``init_processes`` analogue,
-    torch_comm_bench.py:144-251)."""
-    if mesh is None:
-        from tpu_hpc.runtime import MeshSpec, build_mesh
+    torch_comm_bench.py:144-251).
 
-        mesh = build_mesh(MeshSpec(axes={axis: -1}))
-    bench = CommBenchmark(
-        mesh=mesh, axis=axis, sizes=sizes, warmup=warmup, iters=iters, ops=ops
-    )
-    records = bench.run()
-    text = write_csv(records, mesh, output)
-    if jax.process_index() == 0 and output is None:
-        print(text)
+    Flat and overlap ops run over ``axis`` of ``mesh`` (built over all
+    devices when None); hierarchical ops run over ``hier_mesh`` (a
+    ``{dcn: dcn, ici: rest}`` mesh built on demand -- the 8-device sim
+    gives the 2x4 dcn x ici shape the parity tests pin). ``dcn=None``
+    resolves to the physical slice count on multi-slice hardware (the
+    only extent the fabric supports) and an emulated 2 elsewhere; on
+    real slices the mesh routes through ``MeshSpec.dcn_axes`` ->
+    ``build_hybrid_mesh`` so the "dcn" axis is partitioned by physical
+    ``slice_index`` -- the dcn-bytes columns must label actual DCN
+    traffic, and a plain two-axis ``jax.make_mesh`` over a multi-slice
+    device set crashes outright. With ``output=...`` the records land
+    as CSV there plus JSONL at the same stem; without, the CSV text
+    prints to stdout.
+    """
+    unknown = [op for op in ops if op not in ALL_OPS]
+    if unknown:
+        raise ValueError(f"unknown ops {unknown}; choose from {ALL_OPS}")
+    flat_ops = [op for op in ops if op not in HIER_OPS]
+    hier_ops = [op for op in ops if op in HIER_OPS]
+    records: List[Dict] = []
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+
+    if flat_ops:
+        if mesh is None:
+            mesh = build_mesh(MeshSpec(axes={axis: -1}))
+        records += CommBenchmark(
+            mesh=mesh, axis=axis, sizes=sizes, warmup=warmup,
+            iters=iters, ops=flat_ops,
+        ).run()
+    if hier_ops:
+        if hier_mesh is None:
+            from tpu_hpc.runtime.mesh import slice_groups, two_tier_spec
+
+            # Follow the flat mesh's extent when one was given: rows
+            # from two different world sizes in one artifact would
+            # make every cross-op busbw comparison apples-to-oranges.
+            # The construction policy itself (dcn resolution,
+            # validity, slice-aligned dcn_axes routing on real
+            # multi-slice hardware) is runtime.mesh.two_tier_spec --
+            # single-sourced with bench.py's --comm-mode path.
+            n_dev = jax.device_count() if mesh is None else mesh.size
+            n_slices = len(slice_groups(jax.devices()))
+            if n_slices > 1 and n_dev != jax.device_count():
+                print(
+                    f"comm.bench: skipping {hier_ops} -- the "
+                    "hierarchical mesh needs the whole multi-slice "
+                    f"device set (slice-aligned dcn axis), but the "
+                    f"flat mesh spans only {n_dev} of "
+                    f"{jax.device_count()} devices",
+                    file=sys.stderr,
+                )
+                hier_ops = []
+            else:
+                try:
+                    # build_mesh is inside the skip handler too: an
+                    # explicit --dcn that disagrees with the physical
+                    # slice count raises in build_hybrid_mesh, and the
+                    # already-measured flat rows must still be written.
+                    hier_mesh = build_mesh(
+                        two_tier_spec(n_dev, n_slices, dcn=dcn),
+                        devices=None if n_dev == jax.device_count()
+                        else jax.devices()[:n_dev],
+                    )
+                except ValueError as e:
+                    print(
+                        f"comm.bench: skipping {hier_ops} -- {e}",
+                        file=sys.stderr,
+                    )
+                    hier_ops = []
+        if hier_ops:
+            records += CommBenchmark(
+                mesh=hier_mesh, axis="ici", dcn_axis="dcn",
+                sizes=sizes, warmup=warmup, iters=iters, ops=hier_ops,
+            ).run()
+    meta_mesh = mesh if mesh is not None else hier_mesh
+    if meta_mesh is None:
+        # Every requested op was skipped (hier-only request with no
+        # buildable two-tier mesh): nothing measured, nothing to
+        # write -- the skip notice above already said why.
+        return records
+    if output:
+        # --output x.jsonl must not have the JSONL overwrite the CSV
+        # just written to the same path: the two artifacts always land
+        # at <stem>.csv and <stem>.jsonl.
+        stem, ext = os.path.splitext(output)
+        csv_path = stem + ".csv" if ext == ".jsonl" else output
+        jsonl_path = stem + ".jsonl"
+        write_csv(records, meta_mesh, csv_path)
+        write_jsonl(records, jsonl_path)
+        if jax.process_index() == 0:
+            print(f"comm bench: wrote {csv_path} and {jsonl_path}")
+    else:
+        text = write_csv(records, meta_mesh, None)
+        if jax.process_index() == 0:
+            print(text)
     return records
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     import argparse
 
-    p = argparse.ArgumentParser(description="XLA collective benchmark over ICI")
+    p = argparse.ArgumentParser(
+        description="XLA collective benchmark over ICI/DCN"
+    )
     p.add_argument("--sizes", type=int, nargs="+", default=list(DEFAULT_SIZES))
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--iters", type=int, default=20)
-    p.add_argument("--ops", nargs="+", default=list(OPS), choices=OPS)
-    p.add_argument("--output", type=str, default=None)
+    p.add_argument("--ops", nargs="+", default=list(ALL_OPS), choices=ALL_OPS)
+    p.add_argument(
+        "--op", action="append", default=None, choices=ALL_OPS,
+        metavar="OP",
+        help="bench only this op (repeatable); overrides --ops",
+    )
+    p.add_argument(
+        "--output", type=str, default="comm_bench.csv",
+        help="CSV path; a JSONL lands at the same stem ('-' = print "
+        "CSV to stdout only)",
+    )
     p.add_argument("--axis-size", type=int, default=-1)
+    p.add_argument(
+        "--dcn", type=int, default=None,
+        help="DCN (outer-tier) extent for the hierarchical ops' "
+        "(dcn x ici) mesh; default: the physical slice count on "
+        "multi-slice hardware, else an emulated 2 (CPU sim / single "
+        "slice)",
+    )
     args = p.parse_args(argv)
 
     from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
 
     init_distributed()
-    mesh = build_mesh(MeshSpec(axes={"data": args.axis_size}))
+    ops = tuple(args.op) if args.op else tuple(args.ops)
+    mesh = None
+    if any(op not in HIER_OPS for op in ops):
+        mesh = build_mesh(MeshSpec(axes={"data": args.axis_size}))
+    output = None if args.output == "-" else args.output
     run_comm_bench(
         mesh,
         sizes=args.sizes,
         warmup=args.warmup,
         iters=args.iters,
-        ops=args.ops,
-        output=args.output,
+        ops=ops,
+        output=output,
+        dcn=args.dcn,
     )
 
 

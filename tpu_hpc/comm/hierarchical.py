@@ -1,0 +1,235 @@
+"""DCN-aware hierarchical collectives: two-phase decompositions.
+
+The paper's scaling premise is that the cross-node fabric (Slingshot
+there, DCN here) is the bottleneck while the intra-node fabric (NVLink
+there, ICI here) has bandwidth to spare. A flat collective over a
+combined (dcn x ici) axis pushes the FULL payload through DCN; the
+hierarchical decompositions here push only the 1/n_ici-reduced shard
+through DCN and keep the bulk on ICI -- the standard two-level
+algorithm family ("Collective Communication for 100k+ GPUs",
+arxiv.org/pdf/2510.20171; portable redistribution,
+arxiv.org/pdf/2112.01075).
+
+Mesh contract: a mesh with TWO named axes for the same logical data
+axis -- the DCN (cross-slice) component varying slowest and the ICI
+(intra-slice) component fastest. On real multi-slice hardware declare
+the DCN axis via ``dcn_axes`` (``MeshSpec(axes={'dcn': 1, 'ici': n},
+dcn_axes={'dcn': n_slices})``) so ``runtime.mesh.build_hybrid_mesh``
+partitions it by physical ``slice_index``; on CPU sim / a single
+slice, plain separate axes emulate the tiers (``MeshSpec(axes={'dcn':
+2, 'ici': 4})`` on the 8-device sim mesh). Data sharded
+``P((dcn_axis, ici_axis))`` then matches a flat ``P(combined)``
+layout shard-for-shard, so every decomposition here is numerically
+parity-testable against the flat one-axis primitives in
+:mod:`tpu_hpc.comm.primitives`.
+
+Decompositions (per-device payload S, n = n_dcn * n_ici):
+
+==================  =======================================  ==========
+op                  phases                                   DCN bytes
+==================  =======================================  ==========
+all-reduce          ICI reduce-scatter -> DCN all-reduce     2S(n_dcn-1)
+                    on the S/n_ici shard -> ICI all-gather   / (n_dcn
+                                                             * n_ici)
+all-gather          DCN all-gather of the local shard ->     S(n_dcn-1)
+                    ICI all-gather -> local reorder
+reduce-scatter      local reorder -> ICI reduce-scatter ->   ~S(n_dcn-1)
+                    DCN reduce-scatter on the 1/n_ici chunk  / (n_dcn
+                                                             * n_ici)
+==================  =======================================  ==========
+
+vs. the flat op, whose DCN traffic carries the full (un-reduced)
+payload of every remote slice. A size-1 DCN axis degrades every op to
+the flat single-axis ICI collective (no phantom phases, no crash);
+likewise a size-1 ICI axis runs the pure DCN op.
+
+The in-``shard_map`` phase functions (``psum_two_phase`` etc.) are the
+building blocks other manual-mode programs compose (bucketed gradient
+sync in :mod:`tpu_hpc.comm.overlap`); the ``hier_*`` wrappers jit a
+standalone one-op program matching the ``primitives.py`` calling
+convention, which is what the comm benchmark times and the parity
+tests pin.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+# Canonical axis names for a two-level data mesh. Callers may use any
+# names (the trainer's hierarchical mode syncs over whatever two axes
+# the batch pspec declares, outer = DCN); these are the convention the
+# benchmarks and tests use.
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def _axis_sizes(mesh: Mesh, dcn_axis: str, ici_axis: str) -> Tuple[int, int]:
+    return mesh.shape[dcn_axis], mesh.shape[ici_axis]
+
+
+def _pad_leading(x, multiple: int):
+    """Zero-pad dim 0 to a multiple (for the ICI scatter phase);
+    returns (padded, original_length)."""
+    lead = x.shape[0]
+    pad = (-lead) % multiple
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0
+        )
+    return x, lead
+
+
+# ---------------------------------------------------------------------------
+# In-shard_map phase compositions (compose these inside your own
+# shard_map program; zeros-padding keeps non-divisible leading dims
+# legal for the scatter phases).
+# ---------------------------------------------------------------------------
+
+def psum_two_phase(x, dcn_axis: str, ici_axis: str, *, n_dcn: int, n_ici: int):
+    """All-reduce ``x`` over (dcn x ici) as ICI reduce-scatter -> DCN
+    all-reduce on the 1/n_ici shard -> ICI all-gather.
+
+    Equivalent to ``psum(x, (dcn_axis, ici_axis))`` but only the
+    reduced S/n_ici shard crosses DCN. Degenerate axes collapse to the
+    flat single-axis psum.
+    """
+    if n_dcn == 1:
+        return jax.lax.psum(x, ici_axis)
+    if n_ici == 1:
+        return jax.lax.psum(x, dcn_axis)
+    x, lead = _pad_leading(x, n_ici)
+    y = jax.lax.psum_scatter(x, ici_axis, tiled=True)
+    y = jax.lax.psum(y, dcn_axis)
+    out = jax.lax.all_gather(y, ici_axis, tiled=True)
+    return out[:lead] if out.shape[0] != lead else out
+
+
+def all_gather_two_phase(
+    x, dcn_axis: str, ici_axis: str, *, n_dcn: int, n_ici: int
+):
+    """Gather shards over (dcn x ici) into the flat combined-axis order
+    (DCN slowest), pulling each shard over DCN exactly once.
+
+    DCN phase first: every device fetches only its ICI-position's
+    remote shards ((n_dcn-1) x S bytes over DCN, 1/n_ici of what a
+    flat gather ships per-device); the ICI phase then redistributes
+    intra-slice. The two stacked gather dims come out ICI-major, so a
+    local swapaxes (free: no communication) restores the DCN-slowest
+    combined order the flat op produces.
+    """
+    if n_dcn == 1:
+        return jax.lax.all_gather(x, ici_axis, tiled=True)
+    if n_ici == 1:
+        return jax.lax.all_gather(x, dcn_axis, tiled=True)
+    y = jax.lax.all_gather(x, dcn_axis)            # [n_dcn, S, ...]
+    z = jax.lax.all_gather(y, ici_axis)            # [n_ici, n_dcn, S, ...]
+    z = jnp.swapaxes(z, 0, 1)                      # [n_dcn, n_ici, S, ...]
+    return z.reshape((n_dcn * n_ici * x.shape[0],) + x.shape[1:])
+
+
+def reduce_scatter_two_phase(
+    x, dcn_axis: str, ici_axis: str, *, n_dcn: int, n_ici: int
+):
+    """Reduce-scatter ``x`` (each device's full-size contribution) so
+    device (d, i) ends with the fully-summed combined-order slice
+    d * n_ici + i; only the 1/n_ici ICI-reduced chunk crosses DCN.
+
+    A local block transpose (ICI-major) precedes the ICI scatter so
+    that the two scatter phases compose into the flat combined-axis
+    slice assignment. Requires dim 0 divisible by n_dcn * n_ici (same
+    contract as the flat op -- the output slice sizes must be whole).
+    """
+    if n_dcn == 1:
+        return jax.lax.psum_scatter(x, ici_axis, tiled=True)
+    if n_ici == 1:
+        return jax.lax.psum_scatter(x, dcn_axis, tiled=True)
+    m = x.shape[0]
+    n = n_dcn * n_ici
+    if m % n:
+        raise ValueError(
+            f"reduce-scatter payload dim 0 ({m}) must divide by the "
+            f"total axis size {n} (= {dcn_axis} {n_dcn} x {ici_axis} "
+            f"{n_ici}); the scattered slices must be whole"
+        )
+    blocks = x.reshape((n_dcn, n_ici, m // n) + x.shape[1:])
+    xt = jnp.swapaxes(blocks, 0, 1).reshape((m,) + x.shape[1:])
+    y = jax.lax.psum_scatter(xt, ici_axis, tiled=True)
+    return jax.lax.psum_scatter(y, dcn_axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Standalone jitted programs, matching the primitives.py convention:
+# hier_all_reduce(mesh)(x) etc. These are what comm.bench times and
+# the parity/HLO-guard tests pin.
+# ---------------------------------------------------------------------------
+
+def _two_axis_program(mesh: Mesh, body, in_spec, out_spec):
+    # check_vma=False for the same reason as primitives._one_axis_program:
+    # single-op programs where the declared out_spec is ground truth.
+    f = jax.shard_map(
+        body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+        check_vma=False,
+    )
+    return jax.jit(f)
+
+
+def hier_all_reduce(
+    mesh: Mesh, dcn_axis: str = DCN_AXIS, ici_axis: str = ICI_AXIS
+):
+    """Hierarchical all-reduce over the (dcn x ici) data axis.
+
+    Input sharded ``P((dcn_axis, ici_axis))`` (the flat combined-axis
+    layout); output replicated, equal to ``primitives.all_reduce`` on
+    the same global array. Lowers to exactly one ICI reduce-scatter,
+    one DCN all-reduce, one ICI all-gather (pinned by the HLO guard
+    tests via checks/hlo.py). Non-divisible leading dims are
+    zero-padded for the scatter phase and sliced back after the
+    gather.
+    """
+    n_dcn, n_ici = _axis_sizes(mesh, dcn_axis, ici_axis)
+
+    def body(x):
+        return psum_two_phase(
+            x, dcn_axis, ici_axis, n_dcn=n_dcn, n_ici=n_ici
+        )
+
+    return _two_axis_program(mesh, body, P((dcn_axis, ici_axis)), P())
+
+
+def hier_all_gather(
+    mesh: Mesh, dcn_axis: str = DCN_AXIS, ici_axis: str = ICI_AXIS
+):
+    """Hierarchical all-gather: DCN phase on the local shard, ICI phase
+    for the intra-slice redistribution, local reorder to combined-axis
+    order. Input ``P((dcn_axis, ici_axis))``; output replicated,
+    matching ``primitives.all_gather`` on the same global array."""
+    n_dcn, n_ici = _axis_sizes(mesh, dcn_axis, ici_axis)
+
+    def body(x):
+        return all_gather_two_phase(
+            x, dcn_axis, ici_axis, n_dcn=n_dcn, n_ici=n_ici
+        )
+
+    return _two_axis_program(mesh, body, P((dcn_axis, ici_axis)), P())
+
+
+def hier_reduce_scatter(
+    mesh: Mesh, dcn_axis: str = DCN_AXIS, ici_axis: str = ICI_AXIS
+):
+    """Hierarchical reduce-scatter: ICI scatter first (on the locally
+    reordered payload), DCN scatter on the 1/n_ici chunk. Input
+    replicated (each device's copy is its contribution, the NCCL
+    convention the flat op uses); output sharded
+    ``P((dcn_axis, ici_axis))``, matching ``primitives.reduce_scatter``
+    on the same global array."""
+    n_dcn, n_ici = _axis_sizes(mesh, dcn_axis, ici_axis)
+
+    def body(x):
+        return reduce_scatter_two_phase(
+            x, dcn_axis, ici_axis, n_dcn=n_dcn, n_ici=n_ici
+        )
+
+    return _two_axis_program(mesh, body, P(), P((dcn_axis, ici_axis)))

@@ -1,0 +1,355 @@
+"""Communication/compute overlap: bucketed gradient sync + pipelined
+parameter gathers.
+
+Two latency-hiding idioms from the reference's world, done TPU-natively:
+
+* **Bucketed gradient synchronization** -- the DDP gradient-bucketing
+  idiom (the reference's DDP wraps grads into ~25 MB buckets and
+  all-reduces each as backward produces it). Under GSPMD the gradient
+  reduction is one fused collective XLA schedules where it likes;
+  here the step computes per-shard gradients explicitly inside
+  ``shard_map`` and reduces them in size-capped buckets -- separate
+  collectives the latency-hiding scheduler can overlap with the
+  remaining backward compute, and (in hierarchical mode) whose ICI
+  and DCN phases pipeline across buckets: bucket k's DCN hop rides
+  behind bucket k+1's ICI reduce-scatter.
+* **ppermute-pipelined all-gather / gather-matmul** -- the
+  collective-matmul decomposition (Wang et al.): an FSDP-style
+  parameter gather fused into the consuming matmul as a ring of
+  ``ppermute`` hops, each hop overlapped with the partial matmul of
+  the shard already in hand. ``y = x @ W`` with ``W`` sharded over the
+  data axis never materializes the gathered ``W``.
+
+The bucketed sync is what the Trainer's ``comm_mode`` modes
+("bucketed_overlap", "hierarchical") run; the standalone program
+wrappers at the bottom are what ``tpu_hpc.comm.bench`` times.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_hpc.comm.hierarchical import psum_two_phase
+
+# DDP's default bucket cap; the same size works here (big enough to
+# amortize per-collective latency, small enough that several buckets
+# pipeline within one backward).
+DEFAULT_BUCKET_BYTES = 25 * 2 ** 20
+
+
+def sync_axes_from_batch_pspec(batch_pspec) -> Tuple[str, ...]:
+    """The mesh axes a gradient sync must reduce over: every axis the
+    batch's leading dim shards across. ``P('data')`` -> ('data',);
+    ``P(('dcn', 'data'))`` -> ('dcn', 'data') -- for hierarchical
+    mode the outer name is the DCN tier, matching the mesh layout
+    convention (DCN component slowest)."""
+    leaves = jax.tree.leaves(
+        batch_pspec, is_leaf=lambda x: isinstance(x, P)
+    )
+    axes: List[str] = []
+    for spec in leaves:
+        if len(spec) == 0 or spec[0] is None:
+            continue
+        entry = spec[0]
+        for name in (entry if isinstance(entry, tuple) else (entry,)):
+            if name not in axes:
+                axes.append(name)
+    if not axes:
+        raise ValueError(
+            f"batch pspec {batch_pspec} shards the batch over no mesh "
+            "axis; manual gradient sync has nothing to reduce over"
+        )
+    return tuple(axes)
+
+
+def assign_buckets(leaves: Sequence[Any], bucket_bytes: int) -> List[List[int]]:
+    """Partition leaf indices into size-capped, dtype-homogeneous
+    buckets, walking the tree in REVERSE traversal order -- the DDP
+    convention: backward produces gradients for the last layers first,
+    so reverse-order buckets fill (and their collectives launch) while
+    earlier layers are still differentiating.
+
+    Every bucket holds >= 1 leaf (a single leaf larger than the cap
+    gets its own bucket); dtype changes always cut a bucket (the
+    flattened bucket payload is one concatenated vector).
+    """
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be > 0, got {bucket_bytes}")
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i in reversed(range(len(leaves))):
+        leaf = leaves[i]
+        nbytes = int(math.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+        if cur and (
+            jnp.dtype(leaf.dtype) != cur_dtype
+            or cur_bytes + nbytes > bucket_bytes
+        ):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+        cur_dtype = jnp.dtype(leaf.dtype)
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def make_bucket_sync(
+    template: Any,
+    mesh: Mesh,
+    sync_axes: Tuple[str, ...],
+    mode: str = "bucketed_overlap",
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+) -> Callable[[Any], Any]:
+    """Build the in-``shard_map`` gradient-mean: per-bucket psum over
+    ``sync_axes`` divided by the total extent (gradient of the global
+    mean = mean of per-shard gradients).
+
+    ``mode="bucketed_overlap"``: one flat psum per bucket over all
+    sync axes. ``mode="hierarchical"``: each bucket reduces via the
+    two-phase decomposition (``sync_axes`` = (dcn, ici), outer first)
+    -- 1/n_ici of every bucket crosses DCN, and distinct buckets'
+    phases pipeline. The returned callable must run INSIDE a
+    shard_map over ``mesh`` (it calls ``jax.lax`` collectives).
+    """
+    if mode == "hierarchical" and len(sync_axes) != 2:
+        raise ValueError(
+            f"hierarchical sync needs exactly two sync axes "
+            f"(dcn, ici); the batch shards over {sync_axes}"
+        )
+    leaves, treedef = jax.tree.flatten(template)
+    buckets = assign_buckets(leaves, bucket_bytes)
+    n_total = math.prod(mesh.shape[a] for a in sync_axes)
+    if mode == "hierarchical":
+        n_dcn, n_ici = (mesh.shape[a] for a in sync_axes)
+
+    def sync(grads):
+        flat = jax.tree.leaves(grads)
+        out: List[Any] = [None] * len(flat)
+        for bucket in buckets:
+            vec = jnp.concatenate([flat[i].reshape(-1) for i in bucket])
+            if mode == "hierarchical":
+                vec = psum_two_phase(
+                    vec, sync_axes[0], sync_axes[1],
+                    n_dcn=n_dcn, n_ici=n_ici,
+                )
+            else:
+                vec = jax.lax.psum(
+                    vec,
+                    sync_axes if len(sync_axes) > 1 else sync_axes[0],
+                )
+            vec = vec / n_total
+            offset = 0
+            for i in bucket:
+                size = flat[i].size
+                out[i] = vec[offset:offset + size].reshape(flat[i].shape)
+                offset += size
+        return treedef.unflatten(out)
+
+    return sync
+
+
+def make_synced_value_and_grad(
+    forward: Callable,
+    mesh: Mesh,
+    batch_pspec,
+    params_template: Any,
+    mode: str,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+) -> Callable:
+    """A drop-in for the step's ``value_and_grad`` that owns gradient
+    synchronization instead of leaving it to GSPMD.
+
+    Runs forward/backward inside one ``shard_map`` over the mesh:
+    params replicated (validated by the caller --
+    ``fsdp.validate_grad_sync_mode``), batch sharded per
+    ``batch_pspec``, gradients per-shard until the bucketed sync
+    reduces them IN the same program -- so XLA sees backward compute
+    and bucket collectives together and its latency-hiding scheduler
+    can overlap them. Loss and aux/model-state leaves are
+    ``pmean``-ed over the sync axes, making the returned values
+    global exactly like the GSPMD path's (mean of per-shard means ==
+    global-batch mean at equal shard sizes); non-inexact leaves are
+    rejected at trace time (no reduction is universally correct for
+    them). The replicated step rng gets the shard index folded in, so
+    rng-consuming forwards draw decorrelated randomness per shard.
+
+    Signature of the returned fn: ``(params, model_state, batch,
+    rng) -> ((loss, (new_model_state, aux)), grads)`` -- the contract
+    ``train.trainer.make_step_fn`` consumes for both the plain and
+    grad-accumulated branches (psum is linear, so syncing each
+    microbatch's gradient and summing equals syncing the sum).
+    """
+    sync_axes = sync_axes_from_batch_pspec(batch_pspec)
+    sync = make_bucket_sync(
+        params_template, mesh, sync_axes, mode, bucket_bytes
+    )
+
+    def _mean_inexact(tree):
+        def leaf(a):
+            a = jnp.asarray(a)
+            if not jnp.issubdtype(a.dtype, jnp.inexact):
+                raise ValueError(
+                    "manual comm modes cannot return non-inexact "
+                    f"aux/model-state leaves (got {a.dtype}): the "
+                    "per-shard value of an integer metric is not the "
+                    "global one, and no reduction is universally "
+                    "correct (a batch count wants psum, a replicated "
+                    "step counter wants identity) -- return it as a "
+                    "float, or run comm_mode='flat'"
+                )
+            return jax.lax.pmean(a, sync_axes)
+
+        return jax.tree.map(leaf, tree)
+
+    def inner(params, ms, batch, rng):
+        # The step rng arrives replicated; fold in the shard's linear
+        # position so rng-consuming forwards (dropout, noise) draw
+        # decorrelated randomness per shard instead of the identical
+        # mask on every data shard. Not bit-identical to the flat
+        # path's single global-batch draw -- the step-identity pin
+        # holds for rng-free forwards (the llama parity tests);
+        # rng-consuming models get the training-correct property
+        # (independent draws across the batch) in both modes.
+        idx = jax.lax.axis_index(sync_axes[0])
+        for ax in sync_axes[1:]:
+            idx = idx * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+        rng = jax.random.fold_in(rng, idx)
+
+        def loss_fn(p):
+            loss, new_ms, aux = forward(p, ms, batch, rng)
+            return loss, (new_ms, aux)
+
+        (loss, (new_ms, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        grads = sync(grads)
+        loss = jax.lax.pmean(loss, sync_axes)
+        return (loss, (_mean_inexact(new_ms), _mean_inexact(aux))), grads
+
+    # check_vma=False: loss/grads are replicated by construction (the
+    # explicit psum/pmean above IS the ground truth), same rationale
+    # as the single-op programs in primitives.py.
+    shard_mapped = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(), P(), batch_pspec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def synced_value_and_grad(params, ms, batch, rng):
+        return shard_mapped(params, ms, batch, rng)
+
+    return synced_value_and_grad
+
+
+# ---------------------------------------------------------------------------
+# ppermute-pipelined all-gather and collective-matmul-style gather-matmul
+# ---------------------------------------------------------------------------
+
+def ring_all_gather(x, axis: str, n: int):
+    """In-``shard_map`` ring all-gather: n-1 neighbor ``ppermute`` hops,
+    each hop's transfer overlappable with consuming compute (every hop
+    moves only the shard payload, never the gathered whole). Output is
+    the tiled gather in combined-axis order, bitwise equal to
+    ``jax.lax.all_gather(x, axis, tiled=True)``."""
+    if n == 1:
+        return x
+    me = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    buf = jnp.zeros((n,) + x.shape, x.dtype)
+    buf = jax.lax.dynamic_update_index_in_dim(buf, x, me, axis=0)
+
+    def hop(carry, t):
+        buf, cur = carry
+        cur = jax.lax.ppermute(cur, axis, perm)
+        # After t forward hops this device holds shard (me - t) mod n.
+        buf = jax.lax.dynamic_update_index_in_dim(
+            buf, cur, (me - t) % n, axis=0
+        )
+        return (buf, cur), None
+
+    (buf, _), _ = jax.lax.scan(hop, (buf, x), jnp.arange(1, n))
+    return buf.reshape((n * x.shape[0],) + x.shape[1:])
+
+
+def gather_matmul(x, w_shard, axis: str, n: int):
+    """In-``shard_map`` collective matmul: ``y = x @ W`` with ``W``
+    sharded over ``axis`` on dim 0 (the FSDP layout), computed as a
+    ring -- multiply the shard in hand while the next shard's
+    ``ppermute`` is in flight. ``x`` is the local activation
+    ``[..., K]`` (full contraction dim); ``w_shard`` is ``[K/n, N]``.
+    The gathered ``[K, N]`` weight never materializes: peak memory is
+    one shard, and each hop hides behind one partial matmul --
+    the per-layer FSDP gather overlapped with that layer's compute.
+    """
+    k_shard = w_shard.shape[0]
+    if n == 1:
+        return x @ w_shard
+    me = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def partial(acc, w_cur, t):
+        # After t hops the resident shard is (me - t) mod n: contract
+        # it against the matching K-slice of x.
+        j = (me - t) % n
+        xs = jax.lax.dynamic_slice_in_dim(
+            x, j * k_shard, k_shard, axis=x.ndim - 1
+        )
+        return acc + jnp.tensordot(xs, w_cur, axes=((x.ndim - 1,), (0,)))
+
+    acc0 = partial(
+        jnp.zeros(x.shape[:-1] + (w_shard.shape[1],),
+                  jnp.result_type(x.dtype, w_shard.dtype)),
+        w_shard, 0,
+    )
+
+    def hop(carry, t):
+        acc, w_cur = carry
+        w_nxt = jax.lax.ppermute(w_cur, axis, perm)
+        # acc uses w_nxt only after the permute lands; the dot for the
+        # PREVIOUS shard already issued, so the hop rides behind it.
+        return (partial(acc, w_nxt, t), w_nxt), None
+
+    (acc, _), _ = jax.lax.scan(hop, (acc0, w_shard), jnp.arange(1, n))
+    return acc
+
+
+def ppermute_all_gather(mesh: Mesh, axis: str):
+    """Standalone jitted ring all-gather program (primitives.py
+    convention): input sharded ``P(axis)``, output replicated --
+    the benchmark's view of the overlap building block."""
+    n = mesh.shape[axis]
+
+    def body(x):
+        return ring_all_gather(x, axis, n)
+
+    f = jax.shard_map(
+        body, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False
+    )
+    return jax.jit(f)
+
+
+def make_pipelined_gather_matmul(mesh: Mesh, axis: str):
+    """Standalone jitted collective-matmul program: ``(x, w) -> x @ W``
+    with ``x`` batch-sharded and ``w`` dim-0-sharded over ``axis``
+    (the FSDP forward shape); output batch-sharded. Lowers to ring
+    ``collective-permute`` hops and partial dots -- zero all-gathers
+    (pinned by the HLO tests)."""
+    n = mesh.shape[axis]
+
+    def body(x, w):
+        return gather_matmul(x, w, axis, n)
+
+    f = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False,
+    )
+    return jax.jit(f)

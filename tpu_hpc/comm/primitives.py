@@ -74,6 +74,13 @@ def broadcast(mesh: Mesh, axis: str, root: int = 0):
     Implemented as a masked psum: zero all non-root shards, sum. On a
     ring this lowers to the same bandwidth class as NCCL's tree/ring
     broadcast and stays a single fused XLA collective.
+
+    HLO cost (pinned by tests/test_hlo_checks.py via
+    checks.hlo.collective_counts): the ``jnp.where`` mask is one
+    elementwise select over the local payload and the program carries
+    exactly ONE all-reduce -- the masking is per-shard predication on
+    ``axis_index``, NOT a psum per root candidate, so cost does not
+    scale with the axis size.
     """
     def body(x):
         idx = jax.lax.axis_index(axis)

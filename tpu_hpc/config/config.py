@@ -82,6 +82,24 @@ class TrainingConfig:
     profile_start_step: int = 3
     profile_num_steps: int = 5
 
+    # Gradient-sync strategy (the comm-performance layer,
+    # tpu_hpc.comm): "flat" = GSPMD's fused collectives (the default,
+    # byte-identical to the pre-comm_mode trainer); "bucketed_overlap"
+    # = explicit per-shard grads inside shard_map, reduced in
+    # size-capped buckets (DDP bucketing TPU-natively -- separate
+    # collectives the latency-hiding scheduler overlaps with backward
+    # compute); "hierarchical" = bucketed + each bucket reduced as ICI
+    # reduce-scatter -> DCN all-reduce -> ICI all-gather, so only the
+    # 1/n_ici shard crosses DCN (needs a two-axis data mesh, batch
+    # sharded P((dcn, data)) with the DCN axis outer). Manual modes
+    # require replicated params (DDP-style); FSDP/TP-sharded plans
+    # keep "flat" (fsdp.validate_grad_sync_mode enforces this).
+    comm_mode: str = "flat"
+    # Bucket size cap for the manual comm modes, in MiB (DDP's 25 MiB
+    # default: big enough to amortize collective launch latency, small
+    # enough that buckets pipeline within one backward).
+    comm_bucket_mb: int = 25
+
     # Run metrics log: when set, host 0 appends one JSON line per
     # epoch chunk (loss, throughput, step time) plus a run-start
     # record with env metadata -- the reference's append-only

@@ -26,6 +26,54 @@ from jax.sharding import PartitionSpec as P
 
 import jax
 
+# Gradient-sync modes (config.comm_mode; the comm-performance layer,
+# tpu_hpc.comm.overlap/hierarchical):
+#   flat             -> GSPMD's fused collectives, any sharding plan
+#   bucketed_overlap -> explicit shard_map grads, size-capped bucket
+#                       psums (DDP bucketing, overlappable)
+#   hierarchical     -> bucketed + two-phase ICI/DCN decomposition
+# The manual modes are DDP-family: they reduce the RAW per-shard
+# gradient, which only equals the gradient contribution when params
+# are replicated over the sync axes. FSDP-sharded plans keep "flat"
+# (their gather/reduce-scatter dance belongs to GSPMD); HYBRID_SHARD's
+# cross-island reduction is exactly what "hierarchical" replaces when
+# the params are otherwise replicated.
+GRAD_SYNC_MODES = ("flat", "hierarchical", "bucketed_overlap")
+
+
+def validate_grad_sync_mode(mode: str, param_pspecs) -> str:
+    """Check a comm_mode against a sharding plan; returns the mode.
+
+    Manual modes (anything but "flat") compute per-shard gradients
+    inside a whole-mesh ``shard_map`` with params replicated -- a
+    spec tree that shards any param dim would make that program read
+    1/n-th of each tensor as if it were the whole thing. Rejecting
+    loudly here beats the silently-wrong gradients it would train on.
+    """
+    if mode not in GRAD_SYNC_MODES:
+        raise ValueError(
+            f"unknown comm_mode {mode!r}; expected one of "
+            f"{GRAD_SYNC_MODES}"
+        )
+    if mode == "flat":
+        return mode
+    sharded = [
+        spec
+        for spec in jax.tree.leaves(
+            param_pspecs, is_leaf=lambda x: isinstance(x, P)
+        )
+        if any(entry is not None for entry in spec)
+    ]
+    if sharded:
+        raise ValueError(
+            f"comm_mode {mode!r} needs fully replicated params "
+            f"(DDP-style), but the plan shards {len(sharded)} "
+            "tensor(s) -- FSDP/TP layouts rely on GSPMD's fused "
+            "gather/scatter; use comm_mode='flat' for them (or "
+            "dp.param_pspecs for a manual-sync run)"
+        )
+    return mode
+
 
 def _choose_dim(shape, divisor: int, exclude: tuple = ()) -> int | None:
     """Pick the largest dim divisible by the axis size (prefer dim 0 on

@@ -71,3 +71,14 @@ def hybrid_pspecs(
     recipe as one spec tree."""
     tp_specs = pspec_tree(params, tp_rules, default=P())
     return fsdp_extend(tp_specs, params, data_axis, data_size, min_size)
+
+
+# Gradient-sync modes (config.comm_mode): hybrid FSDPxTP spec trees
+# claim dims by design, so the manual DDP-family modes
+# (bucketed_overlap / hierarchical, tpu_hpc.comm.overlap) are rejected
+# for them by fsdp.validate_grad_sync_mode -- the single validation
+# entry the Trainer runs on every plan, hybrid included (pinned by
+# tests/test_overlap.py). Hybrid plans get their DCN savings from mesh
+# topology instead: keep TP inside the slice and let GSPMD's fused
+# collectives ride the hierarchy the mesh layout encodes
+# (build_hybrid_mesh).

@@ -159,6 +159,7 @@ def make_tp_flash_attn_fn(
     block_k: int = 512,
     block_q_bwd: Optional[int] = None,
     block_k_bwd: Optional[int] = None,
+    wrap: bool = True,
 ) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
     """The Pallas flash kernel under tensor parallelism: heads shard
     over ``tp_axis``, batch over ``dp_axis``, full sequence per shard.
@@ -170,6 +171,13 @@ def make_tp_flash_attn_fn(
     per-head SDPA sharding, tensor_parallel_vit.py:107-123). GQA is
     handled in-kernel (no KV repeat), so kv_heads only need to divide
     ``tp_axis`` -- validate with :func:`validate_tp_degree`.
+
+    ``wrap=False`` returns the bare batch-local closure without the
+    ``shard_map`` wrapper -- for callers whose whole forward already
+    runs inside one ``shard_map`` over the same mesh (the manual
+    comm-mode step, the PP stages), where nesting a second manual
+    sharding would fail to trace. One factory either way, so every
+    caller measures the same kernel configuration.
 
     The production attention path for hybrid FSDPxTP training: the
     XLA einsum attention materialises per-layer [B,H,S,S] score
@@ -187,7 +195,7 @@ def make_tp_flash_attn_fn(
         )
         return out
 
-    if mesh.size == 1:
+    if not wrap or mesh.size == 1:
         return flash
     tp_size = mesh.shape.get(tp_axis, 1) if tp_axis else 1
     spec = P(

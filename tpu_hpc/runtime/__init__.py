@@ -13,5 +13,6 @@ from tpu_hpc.runtime.mesh import (  # noqa: F401
     local_batch_size,
     named_sharding,
     slice_groups,
+    two_tier_spec,
 )
 from tpu_hpc.runtime.topology import device_summary, topology_report  # noqa: F401

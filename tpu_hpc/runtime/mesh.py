@@ -272,6 +272,47 @@ def build_hybrid_mesh(
     return Mesh(arr, names)
 
 
+def two_tier_spec(
+    n_dev: int,
+    n_slices: int,
+    dcn: Optional[int] = None,
+    inner_axis: str = "ici",
+    dcn_axis: str = "dcn",
+) -> MeshSpec:
+    """Spec for a two-tier (dcn x ici) data mesh -- THE construction
+    policy for everything that runs the hierarchical collectives
+    (comm.hierarchical), shared so the resolution/validation/routing
+    can never drift between callers (bench.py's --comm-mode path and
+    tpu_hpc.comm.bench build from here).
+
+    ``dcn=None`` resolves to the physical slice count when there is
+    more than one slice, else an emulated 2 (CPU sim / single slice:
+    the decomposition still compiles and parity-checks; the DCN win
+    needs real slices). On real multi-slice hardware the dcn axis is
+    declared via ``dcn_axes`` so :func:`build_hybrid_mesh` partitions
+    it by physical ``slice_index`` -- a plain two-axis mesh does not
+    survive ``jax.make_mesh`` on a multi-slice device set, and would
+    not align the axis named "dcn" with slice boundaries even where
+    it built. Topologies that cannot split into dcn x (ici >= 2)
+    raise -- measuring something else while claiming "hierarchical"
+    would poison any sweep built on the result.
+    """
+    if dcn is None:
+        dcn = n_slices if n_slices > 1 else 2
+    if dcn < 2 or n_dev % dcn or n_dev // dcn < 2:
+        raise ValueError(
+            f"no two-tier ({dcn_axis} x {inner_axis}>=2) mesh from "
+            f"{n_dev} device(s) with {dcn_axis}={dcn} across "
+            f"{n_slices} physical slice(s)"
+        )
+    if n_slices > 1:
+        return MeshSpec(
+            axes={dcn_axis: 1, inner_axis: n_dev // dcn},
+            dcn_axes={dcn_axis: dcn},
+        )
+    return MeshSpec(axes={dcn_axis: dcn, inner_axis: n_dev // dcn})
+
+
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
     """Shorthand: ``named_sharding(mesh, 'data', None)``."""
     return NamedSharding(mesh, PartitionSpec(*spec))

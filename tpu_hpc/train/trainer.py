@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_hpc.config import TrainingConfig
 from tpu_hpc.logging_ import get_logger
+from tpu_hpc.parallel.fsdp import validate_grad_sync_mode
 from tpu_hpc.parallel.plans import derived_pspecs, shardings_for
 from tpu_hpc.resilience.faults import fault_plan_from_env
 from tpu_hpc.resilience.heartbeat import (
@@ -228,6 +229,7 @@ def make_step_fn(
     grad_accum: int = 1,
     microbatch_constrain: Optional[Callable[[Any], Any]] = None,
     log_grad_norm: bool = False,
+    value_and_grad_fn: Optional[Callable] = None,
 ) -> Callable[[Any, Any], Tuple[Any, Dict]]:
     """The training-step body as a free function: forward, backward,
     optimizer update. The Trainer jits this; checks/fit.py AOT-lowers
@@ -244,21 +246,32 @@ def make_step_fn(
     re-pins each [A, B/A, ...] microbatched tree to the batch sharding
     (leading dim replicated); without it the reshape leaves microbatch
     rows spread over only a fraction of the data axis.
+
+    ``value_and_grad_fn`` overrides how the (global) loss and gradient
+    are computed from ``(params, model_state, batch, rng)`` -- the
+    hook the manual comm modes use
+    (comm.overlap.make_synced_value_and_grad: per-shard grads inside
+    shard_map + explicit bucketed sync). Default None = plain
+    ``jax.value_and_grad`` with GSPMD owning the collectives, the
+    byte-identical flat path. Under grad accumulation the override
+    runs per microbatch (psum is linear: syncing each microbatch's
+    gradient and summing equals syncing the sum).
     """
+    if value_and_grad_fn is None:
+        def value_and_grad_fn(params, ms, batch, rng):
+            def loss_fn(p):
+                loss, new_ms, aux = forward(p, ms, batch, rng)
+                return loss, (new_ms, aux)
+
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
     def step(state: "TrainState", batch) -> Tuple["TrainState", Dict]:
         step_rng = jax.random.fold_in(jax.random.key(seed), state.step)
 
         if grad_accum == 1:
-            def loss_fn(p):
-                loss, new_ms, aux = forward(
-                    p, state.model_state, batch, step_rng
-                )
-                return loss, (new_ms, aux)
-
-            (loss, (new_ms, aux)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(state.params)
+            (loss, (new_ms, aux)), grads = value_and_grad_fn(
+                state.params, state.model_state, batch, step_rng
+            )
         else:
             micro = jax.tree.map(
                 lambda a: a.reshape(
@@ -274,14 +287,9 @@ def make_step_fn(
                 ms, gsum, lsum = carry
                 i, mb = xs
                 rng = jax.random.fold_in(step_rng, i)
-
-                def loss_fn(p):
-                    loss, new_ms, aux = forward(p, ms, mb, rng)
-                    return loss, (new_ms, aux)
-
-                (loss, (new_ms, aux)), g = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params)
+                (loss, (new_ms, aux)), g = value_and_grad_fn(
+                    params, ms, mb, rng
+                )
                 gsum = jax.tree.map(jnp.add, gsum, g)
                 return (new_ms, gsum, lsum + loss), aux
 
@@ -488,11 +496,31 @@ class Trainer:
                 mesh, self.batch_sharding
             )
 
+        # Gradient-sync strategy (cfg.comm_mode, the comm-performance
+        # layer): flat keeps GSPMD's fused collectives -- the step
+        # program is byte-identical to a trainer that predates the
+        # knob (pinned by the HLO no-creep test). Manual modes swap in
+        # an explicit value_and_grad: per-shard grads inside shard_map
+        # + bucketed (optionally two-phase ICI/DCN) reduction.
+        comm_mode = validate_grad_sync_mode(
+            getattr(cfg, "comm_mode", "flat"), self.param_pspecs
+        )
+        value_and_grad_fn = None
+        if comm_mode != "flat":
+            from tpu_hpc.comm import overlap
+
+            value_and_grad_fn = overlap.make_synced_value_and_grad(
+                forward, mesh, batch_pspec, self.state.params,
+                comm_mode,
+                bucket_bytes=cfg.comm_bucket_mb * 2 ** 20,
+            )
+
         self._step_impl = make_step_fn(
             forward, self.optimizer, cfg.seed,
             grad_accum=grad_accum,
             microbatch_constrain=micro_constrain,
             log_grad_norm=cfg.max_grad_norm > 0,
+            value_and_grad_fn=value_and_grad_fn,
         )
         # Pin the output state to the planned layout. Without this the
         # compiler may propagate a *different* layout through the update
@@ -939,9 +967,14 @@ class Trainer:
                             done + i, cfg.global_batch_size
                         )
                         last_metrics = self.train_step(batch)
-                # Chunk barrier INSIDE the productive window: the
-                # dispatched work isn't done until the fetch lands.
-                float(jax.device_get(last_metrics["loss"]))
+                # ONE host fetch per chunk, INSIDE the productive
+                # window: it is both the chunk barrier (the dispatched
+                # work isn't done until the fetch lands) and the
+                # source for the log line and JSONL record below --
+                # fetching loss for the barrier, loss again for the
+                # log, and grad_norm separately cost three device
+                # round trips per chunk.
+                last_metrics = jax.device_get(last_metrics)
             self.meter.end_batch(chunk * cfg.global_batch_size)
             done += chunk
             if self._watchdog is not None:
@@ -951,7 +984,7 @@ class Trainer:
             summary = self.meter.epoch_summary(skip_first=0)
             run_summaries.append(summary)
             if jax.process_index() == 0:
-                loss = float(jax.device_get(last_metrics["loss"]))
+                loss = float(last_metrics["loss"])
                 self.logger.info(
                     "epoch %d | loss %.5f | %.1f items/s global | "
                     "%.1f items/s/device | %.3fs/step",
@@ -972,9 +1005,7 @@ class Trainer:
                     "s_per_step": summary["total_s"] / max(chunk, 1),
                 }
                 if "grad_norm" in last_metrics:
-                    rec["grad_norm"] = float(
-                        jax.device_get(last_metrics["grad_norm"])
-                    )
+                    rec["grad_norm"] = float(last_metrics["grad_norm"])
                 self._append_metrics(rec)
             # Fault injection (no-op unless TPU_HPC_FAULTS is set):
             # fires BEFORE the periodic save so a kill at step N
