@@ -797,8 +797,15 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
                     or "unit" not in rec:
                 raise ValueError(f"not a bench record: {line[:120]!r}")
         except (ValueError, IndexError):
-            rec = {"metric": name, "value": None, "unit": "FAILED",
-                   "vs_baseline": None, "error": err[-300:]}
+            from tpu_hpc.obs import stamp
+
+            # Failure rows keep the bench schema too: the sweep JSONL
+            # must validate end to end even when a family died.
+            rec = stamp({
+                "event": "bench", "metric": name, "value": None,
+                "unit": "FAILED", "vs_baseline": None,
+                "error": err[-300:],
+            })
         rec["workload"] = name
         raw.append(rec)
         rows.append(
@@ -1047,7 +1054,12 @@ def main(argv=None) -> int:
         )
     else:
         rec = bench_unet(args.steps)
-    print(json.dumps(rec))
+    # Every bench line is a schema-stamped ``bench`` event -- the same
+    # record discipline the train/serve JSONL sinks follow, so one
+    # validator (tpu_hpc.obs.schema) and one report cover all three.
+    from tpu_hpc.obs import get_bus
+
+    print(json.dumps(get_bus().emit_record({"event": "bench", **rec})))
     return 0
 
 

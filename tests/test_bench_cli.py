@@ -215,6 +215,29 @@ def test_serve_record_schema_matches_training_benches(bench):
     assert no_mfu["vs_baseline"] is None
 
 
+def test_emitted_record_is_schema_stamped(bench, monkeypatch, capsys):
+    """PR 4: the one JSON line bench prints is a ``bench`` event in
+    the unified telemetry schema -- same validator as the train and
+    serve JSONL sinks."""
+    from tpu_hpc.obs import validate_record
+
+    monkeypatch.setattr(
+        bench, "bench_serve",
+        lambda **kw: {"metric": "serve_tokens_per_s_per_chip",
+                      "value": 1, "unit": "tokens/s/chip",
+                      "vs_baseline": None},
+    )
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    assert bench.main(["--workload", "serve"]) == 0
+    import json
+
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    validate_record(rec)
+    assert rec["event"] == "bench"
+    assert rec["schema_version"] == 1
+    assert rec["run_id"] and rec["host"]
+
+
 def test_serve_mode_routes_flags(bench, monkeypatch):
     """Both spellings (--serve and --workload serve) reach bench_serve
     with the serve-specific knobs."""

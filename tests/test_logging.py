@@ -1,0 +1,33 @@
+"""logging_.get_logger: per-call level honored after first configure.
+
+Regression (PR 4 satellite): ``basicConfig`` runs once, and the old
+implementation dropped the ``level`` argument of every call after it
+-- ``get_logger(name, DEBUG)`` in a worker was a silent no-op once any
+module had logged first.
+"""
+import logging
+
+from tpu_hpc.logging_ import get_logger
+
+
+def test_level_honored_after_first_configure():
+    # First call configures the root handler (whatever level).
+    get_logger("tpu_hpc.lvltest")
+    # A LATER explicit level must take effect on that logger...
+    lg = get_logger("tpu_hpc.lvltest", logging.DEBUG)
+    assert lg.level == logging.DEBUG
+    assert lg.isEnabledFor(logging.DEBUG)
+    # ...and be revisable.
+    assert get_logger(
+        "tpu_hpc.lvltest", logging.WARNING
+    ).level == logging.WARNING
+
+
+def test_default_call_does_not_clobber_explicit_level():
+    get_logger("tpu_hpc.lvltest2", logging.DEBUG)
+    lg = get_logger("tpu_hpc.lvltest2")  # no level: leave it alone
+    assert lg.level == logging.DEBUG
+
+
+def test_same_logger_object_returned():
+    assert get_logger("tpu_hpc.same") is get_logger("tpu_hpc.same")

@@ -49,6 +49,55 @@ def test_context_manager_stops_on_error(tmp_path):
     assert not prof.active
 
 
+def test_exhausted_loop_inside_window_closes_trace(tmp_path):
+    """Regression (PR 4 satellite): a loop that ends INSIDE the trace
+    window (step never reaches start+num) used to leak the open
+    jax.profiler trace for the life of the process -- blocking every
+    later start_trace. The class is now its own context manager."""
+    with TrainingProfiler(
+        str(tmp_path / "a"), start_step=0, num_steps=100
+    ) as prof:
+        prof.step(0)
+        assert prof.active
+        jnp.ones(8).block_until_ready()
+        # loop exhausts here, far short of step 100
+    assert not prof.active
+    # The leaked-trace symptom: a second profiler could not start. It
+    # can now, proving the first really closed.
+    with TrainingProfiler(
+        str(tmp_path / "b"), start_step=0, num_steps=1
+    ) as prof2:
+        prof2.step(0)
+        assert prof2.active
+        jnp.ones(8).block_until_ready()
+    assert not prof2.active
+
+
+def test_stop_clears_active_even_when_stop_trace_raises(
+    tmp_path, monkeypatch
+):
+    """A stop_trace that raises (full disk mid-write) must still mark
+    the profiler inactive, or every later stop re-raises on an
+    already-dead trace."""
+    prof = TrainingProfiler(str(tmp_path), start_step=0, num_steps=5)
+    prof.step(0)
+    assert prof.active
+    real_stop = jax.profiler.stop_trace
+
+    def boom():
+        real_stop()
+        raise OSError("disk full")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", boom)
+    try:
+        prof.stop()
+    except OSError:
+        pass
+    assert not prof.active
+    monkeypatch.setattr(jax.profiler, "stop_trace", real_stop)
+    prof.stop()  # idempotent now, must not re-raise
+
+
 def test_trainer_profile_flag(tmp_path, mesh8):
     from tpu_hpc.config import TrainingConfig
     from tpu_hpc.models import datasets

@@ -403,6 +403,11 @@ class TestServeMetrics:
         ]
         events = [r["event"] for r in records]
         assert events == ["request", "request", "serve_summary"]
+        # PR 4: serving records ride the unified telemetry schema --
+        # the same validator covers train, serve, and bench sinks.
+        from tpu_hpc.obs import validate_file
+
+        assert validate_file(path) == 3
         for r in records[:2]:
             # TTFT from SUBMISSION: the queue wait is inside it.
             assert r["ttft_ms"] >= r["queue_ms"] > 0

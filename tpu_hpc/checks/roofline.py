@@ -86,14 +86,25 @@ _DEVICE_KIND_PREFIXES = (
 )
 
 
-def peak_flops_for_device(device, default=None):
-    """Peak dense bf16 FLOP/s for a jax device, by device_kind prefix;
-    ``default`` for unknown kinds (CPU sim, future chips)."""
-    kind = getattr(device, "device_kind", "")
+def peak_flops_for_kind(kind: str, default=None):
+    """Peak dense bf16 FLOP/s for a ``Device.device_kind`` string, by
+    longest-prefix match; ``default`` for unknown kinds (CPU sim,
+    future chips). The string-keyed variant exists for consumers that
+    only hold a recorded kind, not a live device -- the obs report
+    resolves the ``device_kind`` a run_start record stamped, possibly
+    on a machine with no TPU at all."""
     for prefix, key in _DEVICE_KIND_PREFIXES:
         if kind.startswith(prefix):
             return CHIPS[key].peak_bf16_flops
     return default
+
+
+def peak_flops_for_device(device, default=None):
+    """Peak dense bf16 FLOP/s for a jax device, by device_kind prefix;
+    ``default`` for unknown kinds (CPU sim, future chips)."""
+    return peak_flops_for_kind(
+        getattr(device, "device_kind", ""), default
+    )
 
 
 def _ring_collective_s(bytes_full: int, n: int, bw_gbps: float) -> float:

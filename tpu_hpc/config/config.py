@@ -107,6 +107,13 @@ class TrainingConfig:
     # (scripts/main.py:381-397, tests/torch_comm_bench.py:137-194)
     # as structured JSONL. "" = off.
     metrics_path: str = ""
+    # Model cost for post-hoc MFU: FLOPs one training step spends per
+    # item (per token for LLMs -- the 6N estimate -- per sample
+    # otherwise). Stamped into the run_start record's config, it lets
+    # ``python -m tpu_hpc.obs.report`` compute run MFU from the JSONL
+    # alone, on a machine with no TPU attached. 0 = unknown (the
+    # report says so instead of guessing).
+    model_flops_per_item: float = 0.0
 
     @classmethod
     def from_yaml(cls, path: str) -> "TrainingConfig":

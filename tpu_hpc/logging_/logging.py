@@ -4,19 +4,35 @@ from __future__ import annotations
 
 import logging
 import sys
+from typing import Optional
 
 _FORMAT = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
 _configured = False
 
 
-def get_logger(name: str = "tpu_hpc", level: int = logging.INFO) -> logging.Logger:
+def get_logger(
+    name: str = "tpu_hpc", level: Optional[int] = None
+) -> logging.Logger:
     """Process-safe logger; basicConfig applied once (parity with the
-    import-time basicConfig at utils/logging.py:19-23, but lazy)."""
+    import-time basicConfig at utils/logging.py:19-23, but lazy).
+
+    ``level`` is honored on EVERY call, not just the configuring one:
+    an explicit level sets that logger's own level, while the default
+    (None) leaves the logger inheriting -- so ``get_logger()`` after a
+    ``get_logger(name, DEBUG)`` cannot silently clobber the earlier
+    request (the old per-first-call-only behavior dropped every level
+    after the first ``basicConfig``)."""
     global _configured
     if not _configured:
-        logging.basicConfig(level=level, format=_FORMAT, stream=sys.stdout)
+        logging.basicConfig(
+            level=logging.INFO if level is None else level,
+            format=_FORMAT, stream=sys.stdout,
+        )
         _configured = True
-    return logging.getLogger(name)
+    logger = logging.getLogger(name)
+    if level is not None:
+        logger.setLevel(level)
+    return logger
 
 
 def host_log(msg: str, *args, logger: logging.Logger | None = None) -> None:

@@ -1,0 +1,66 @@
+"""tpu_hpc.obs -- the unified telemetry spine.
+
+Every subsystem (train, serve, resilience, bench) emits into ONE
+schema-stamped JSONL discipline:
+
+  schema.py    the record schema: required/optional fields per event
+               kind, ``schema_version`` on every record, a validator.
+  events.py    the structured event bus: JSONL sink + bounded in-memory
+               flight-recorder ring dumped on SIGTERM / watchdog fire /
+               injected fault.
+  spans.py     nestable span timers (also emit
+               jax.profiler.TraceAnnotation, so XProf and the JSONL
+               agree on where time went).
+  registry.py  counters / gauges / histograms with JSONL snapshots and
+               Prometheus text exposition.
+  stall.py     rolling step-time watermark detector (straggler / stall
+               flagging; feeds the heartbeat file).
+  report.py    ``python -m tpu_hpc.obs.report run.jsonl`` -- goodput /
+               MFU / step-time-breakdown report from a run's JSONL.
+"""
+from tpu_hpc.obs.events import (  # noqa: F401
+    ENV_EVENTS,
+    ENV_FLIGHT_DIR,
+    ENV_RUN_ID,
+    EventBus,
+    dump_flight,
+    get_bus,
+    set_bus,
+)
+from tpu_hpc.obs.registry import (  # noqa: F401
+    ENV_PROM_FILE,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from tpu_hpc.obs.schema import (  # noqa: F401
+    SCHEMA_VERSION,
+    SchemaError,
+    stamp,
+    validate_file,
+    validate_record,
+)
+from tpu_hpc.obs.spans import emit_span, span  # noqa: F401
+from tpu_hpc.obs.stall import StallDetector  # noqa: F401
+
+__all__ = [
+    "ENV_EVENTS",
+    "ENV_FLIGHT_DIR",
+    "ENV_PROM_FILE",
+    "ENV_RUN_ID",
+    "EventBus",
+    "MetricsRegistry",
+    "SCHEMA_VERSION",
+    "SchemaError",
+    "StallDetector",
+    "dump_flight",
+    "emit_span",
+    "get_bus",
+    "get_registry",
+    "set_bus",
+    "set_registry",
+    "span",
+    "stamp",
+    "validate_file",
+    "validate_record",
+]

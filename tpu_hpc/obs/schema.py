@@ -1,0 +1,202 @@
+"""The one record schema every telemetry sink speaks.
+
+Before this module, three subsystems emitted JSONL with three ad-hoc
+shapes: the Trainer's run log (train/trainer.py), the serving meter
+(serve/metrics.py), and bench.py's record lines. A consumer (the
+goodput report, a dashboard, the driver) had to know which producer
+wrote which file. Now every record carries ``schema_version`` and an
+``event`` kind with a declared field contract, and one validator
+covers all of them -- the "structured events with a schema" discipline
+the fleet-scale observability literature treats as table stakes
+(arxiv 2510.20171's attribution pipelines start from exactly this).
+
+Contract:
+
+* every record is a flat-ish JSON object with ``schema_version``,
+  ``event`` and ``time`` (wall clock, seconds);
+* ``run_id`` / ``host`` / ``pid`` / ``attempt`` / ``step`` are common
+  optional provenance fields (the event bus stamps the first three);
+* each event kind declares required fields plus either a closed set of
+  optional fields or ``open=True`` (kinds that carry user-named aux
+  metrics -- eval records, serve summaries, bench rows);
+* :func:`validate_record` / :func:`validate_file` fail loudly on an
+  unknown kind, a missing required field, or (for closed kinds) an
+  unknown field -- a producer drifting off-schema breaks a test, not
+  a dashboard three weeks later.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, Mapping, Tuple
+
+SCHEMA_VERSION = 1
+
+# Stamped on every record.
+COMMON_REQUIRED: Tuple[str, ...] = ("schema_version", "event", "time")
+# Provenance fields any record may carry.
+COMMON_OPTIONAL: Tuple[str, ...] = (
+    "run_id", "host", "pid", "attempt", "step", "seq",
+)
+
+
+class SchemaError(ValueError):
+    """A record violates the telemetry schema."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EventSpec:
+    """Field contract for one event kind. ``open=True`` permits extra
+    fields (kinds that carry user-named metrics); closed kinds reject
+    anything outside required+optional+common."""
+
+    required: Tuple[str, ...]
+    optional: Tuple[str, ...] = ()
+    open: bool = False
+
+
+EVENTS: Dict[str, EventSpec] = {
+    # -- training run log (train/trainer.py) --
+    "run_start": EventSpec((
+        "start_step", "total_steps", "n_devices", "n_processes",
+        "device_kind", "jax_version", "config",
+    )),
+    "epoch": EventSpec(
+        ("epoch", "step", "loss", "items_per_s",
+         "items_per_s_per_device", "s_per_step"),
+        optional=("grad_norm",),
+    ),
+    "eval": EventSpec(("step", "n_steps", "loss"), open=True),
+    "run_end": EventSpec((
+        "step", "preempted", "attempt", "resumed_from_step", "goodput",
+    )),
+    # -- the telemetry spine itself (obs/) --
+    "span": EventSpec(
+        ("name", "dur_s"), optional=("parent", "depth", "n"),
+    ),
+    "metrics": EventSpec(("metrics",)),
+    "stall": EventSpec(("step", "step_s", "watermark_s", "ratio")),
+    "fault": EventSpec(("kind",)),
+    "flight_dump": EventSpec(("reason", "n_events")),
+    # -- serving (serve/metrics.py) --
+    "request": EventSpec(
+        ("rid", "ttft_ms", "queue_ms", "tokens", "total_ms"),
+    ),
+    "serve_summary": EventSpec(
+        ("requests", "tokens", "wall_s", "tokens_per_s",
+         "tokens_per_s_per_chip", "ttft_ms_p50", "ttft_ms_p95",
+         "itl_ms_p50", "itl_ms_p95", "prefill_tokens"),
+        open=True,
+    ),
+    # -- bench.py record lines (metric/value/unit + workload extras) --
+    "bench": EventSpec(("metric", "value", "unit"), open=True),
+    # -- supervisor attempt log (resilience/supervisor.py) --
+    "attempt_start": EventSpec(("attempt", "cmd")),
+    "attempt_end": EventSpec(
+        ("attempt", "rc", "meaning", "reason", "duration_s", "log"),
+    ),
+    "restarting": EventSpec(
+        ("next_attempt", "backoff_s"), optional=("why",),
+    ),
+    "giving_up": EventSpec(("attempt", "rc", "why")),
+    "heartbeat_stall": EventSpec(("attempt", "timeout_s")),
+}
+
+
+def stamp(
+    record: Mapping,
+    *,
+    run_id: str | None = None,
+    host: str | None = None,
+    pid: int | None = None,
+) -> dict:
+    """Return a copy of ``record`` with ``schema_version``/``time`` (and
+    the provenance fields, when given) filled in -- existing values are
+    never overwritten, so producers that already carry a wall-clock
+    ``time`` keep it."""
+    rec = dict(record)
+    rec.setdefault("schema_version", SCHEMA_VERSION)
+    rec.setdefault("time", time.time())
+    if run_id is not None:
+        rec.setdefault("run_id", run_id)
+    if host is not None:
+        rec.setdefault("host", host)
+    if pid is not None:
+        rec.setdefault("pid", pid)
+    return rec
+
+
+def validate_record(record) -> dict:
+    """Validate one record against the schema; returns it unchanged.
+
+    Raises :class:`SchemaError` on: non-dict input, a missing/wrong
+    ``schema_version``, an unknown ``event`` kind, a missing required
+    field, or -- for closed kinds -- an unknown field.
+    """
+    if not isinstance(record, dict):
+        raise SchemaError(f"record is {type(record).__name__}, not an object")
+    ver = record.get("schema_version")
+    if ver != SCHEMA_VERSION:
+        raise SchemaError(
+            f"schema_version {ver!r} != {SCHEMA_VERSION} "
+            f"(event {record.get('event')!r})"
+        )
+    event = record.get("event")
+    spec = EVENTS.get(event)
+    if spec is None:
+        raise SchemaError(
+            f"unknown event kind {event!r} "
+            f"(known: {', '.join(sorted(EVENTS))})"
+        )
+    missing = [
+        f for f in (*COMMON_REQUIRED, *spec.required) if f not in record
+    ]
+    if missing:
+        raise SchemaError(f"event {event!r} missing required {missing}")
+    if not spec.open:
+        allowed = {
+            *COMMON_REQUIRED, *COMMON_OPTIONAL,
+            *spec.required, *spec.optional,
+        }
+        unknown = sorted(set(record) - allowed)
+        if unknown:
+            raise SchemaError(
+                f"event {event!r} carries unknown fields {unknown} "
+                "(closed kind; extend EventSpec.optional or mark open)"
+            )
+    return record
+
+
+def load_records(path: str, validate: bool = True) -> list:
+    """Parse (and by default schema-validate) a JSONL file, raising
+    :class:`SchemaError` naming the first bad line. The ONE
+    parse-and-validate loop -- the report and the validator must not
+    drift in what they accept."""
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise SchemaError(
+                    f"{path}:{lineno}: not JSON ({e})"
+                ) from None
+            if validate:
+                try:
+                    validate_record(rec)
+                except SchemaError as e:
+                    raise SchemaError(
+                        f"{path}:{lineno}: {e}"
+                    ) from None
+            records.append(rec)
+    return records
+
+
+def validate_file(path: str) -> int:
+    """Validate every JSONL record in ``path``; returns the record
+    count."""
+    return len(load_records(path, validate=True))

@@ -72,13 +72,31 @@ class TrainingProfiler:
         return contextlib.nullcontext()
 
     def stop(self) -> None:
+        """Close an open trace. ``active`` is cleared even when
+        ``stop_trace`` itself raises (a full disk mid-write): a stop
+        that failed must not make every later stop re-raise on an
+        already-dead trace, which is what leaked the open trace the
+        finally-guarantee exists for."""
         if self.active:
-            jax.profiler.stop_trace()
-            self.active = False
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self.active = False
             self.logger.info(
                 "profiler: trace written to %s (open with TensorBoard "
                 "or xprof)", self.log_dir,
             )
+
+    # Context-manager form: ``with TrainingProfiler(...) as prof``
+    # guarantees the trace is closed when the loop exhausts inside the
+    # window or an exception unwinds through it -- an open
+    # jax.profiler.start_trace otherwise leaks for the life of the
+    # process (and blocks any later trace from starting).
+    def __enter__(self) -> "TrainingProfiler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
 
 
 @contextlib.contextmanager

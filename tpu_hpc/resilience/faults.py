@@ -59,12 +59,41 @@ class FaultPlan:
     stall_s: float = 3600.0
     on_attempt: int = 0
     attempt: int = 0
+    # Telemetry one-shot latch (mutable contents are legal on a
+    # frozen dataclass): ``step >= N`` keeps re-matching on every
+    # later progress point, and a re-fired stall must re-sleep -- but
+    # it must NOT re-emit a fault event per chunk, flooding the ring
+    # and the report's fault list.
+    _announced: set = dataclasses.field(
+        default_factory=set, compare=False, repr=False,
+    )
 
     @property
     def active(self) -> bool:
         """Injection is scoped to one restart ordinal: the fault fires
         once, and the relaunched attempt runs clean."""
         return self.attempt == self.on_attempt
+
+    def _announce(self, kind: str, step: int, dump: bool) -> None:
+        """Record the injection in the telemetry spine: a ``fault``
+        event into the bus ring (so post-hoc forensics see the cause
+        next to its effects), plus -- for faults the process will not
+        survive -- a flight-recorder dump NOW, while there is still a
+        process to write it. Best-effort: injection must fire even if
+        telemetry is broken (that may be what's under test). One
+        event per fault kind, however often the ``step >= N`` match
+        re-fires."""
+        if kind in self._announced:
+            return
+        self._announced.add(kind)
+        try:
+            from tpu_hpc.obs import dump_flight, get_bus
+
+            get_bus().emit("fault", kind=kind, step=step)
+            if dump:
+                dump_flight(f"fault_{kind}")
+        except Exception:  # pragma: no cover - diagnostics only
+            pass
 
     def on_step(self, step: int) -> None:
         """Called from the training loop at each progress point."""
@@ -74,6 +103,9 @@ class FaultPlan:
             self.stall_at_step is not None
             and step >= self.stall_at_step
         ):
+            # No dump here: the hang watchdog dumps when it fires --
+            # that's the mechanism under test.
+            self._announce("stall", step, dump=False)
             time.sleep(self.stall_s)
         if (
             self.preempt_at_step is not None
@@ -81,9 +113,15 @@ class FaultPlan:
         ):
             # Graceful notice to self: PreemptionGuard's flag is set
             # synchronously (same-process SIGTERM runs the Python
-            # handler at the next bytecode boundary).
+            # handler at the next bytecode boundary). The graceful
+            # path dumps at the Trainer's poll point.
+            self._announce("preempt", step, dump=False)
             os.kill(os.getpid(), signal.SIGTERM)
         if self.kill_at_step is not None and step >= self.kill_at_step:
+            # SIGKILL gives no grace at all -- dump the ring first;
+            # this IS the "what was it doing right before it died"
+            # artifact a hard preemption otherwise destroys.
+            self._announce("kill", step, dump=True)
             os.kill(os.getpid(), signal.SIGKILL)
 
     def wants_ckpt_corruption(self, step: int) -> bool:

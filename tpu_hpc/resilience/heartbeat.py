@@ -182,6 +182,19 @@ class HangWatchdog:
                 )
                 f.flush()
                 faulthandler.dump_traceback(file=f)
+            # The stacks say where the process is wedged NOW; the
+            # flight-recorder ring says what it was doing on the way
+            # there -- dump both. Cross-thread safe: the ring
+            # snapshot's lock wait is bounded (EventBus.ring
+            # lock_timeout, falling back to a lockless copy), so a
+            # main thread wedged mid-emit cannot stop the watchdog
+            # from reaching its os._exit.
+            try:
+                from tpu_hpc.obs import dump_flight
+
+                dump_flight("hang")
+            except Exception:  # pragma: no cover - diagnostics only
+                pass
             return path
         except OSError:  # pragma: no cover - diagnostics best-effort
             return None

@@ -75,12 +75,22 @@ class PreemptionGuard:
     Non-main threads cannot install signal handlers; there ``install``
     is a no-op and the guard simply never triggers, matching the old
     inline behavior in Trainer.fit.
+
+    ``flight_reason``: when set, the FIRST notice also dumps the obs
+    flight-recorder ring under that reason (best-effort, from the
+    handler -- safe because the bus ring lock is reentrant). The
+    Trainer leaves this unset and dumps at its own poll point instead;
+    the hook exists for embedders whose loop has no safe poll point a
+    short grace window is guaranteed to reach.
     """
 
     def __init__(
-        self, signums: Iterable[int] = (signal.SIGTERM,)
+        self,
+        signums: Iterable[int] = (signal.SIGTERM,),
+        flight_reason: Optional[str] = None,
     ):
         self.signums: Tuple[int, ...] = tuple(signums)
+        self.flight_reason = flight_reason
         self._event = threading.Event()
         self._old: dict = {}
 
@@ -92,8 +102,16 @@ class PreemptionGuard:
     def installed(self) -> bool:
         return bool(self._old)
 
-    def _handler(self, signum, frame):  # pragma: no cover - trivial
+    def _handler(self, signum, frame):
+        first = not self._event.is_set()
         self._event.set()
+        if first and self.flight_reason is not None:
+            try:
+                from tpu_hpc.obs import dump_flight
+
+                dump_flight(self.flight_reason)
+            except Exception:  # pragma: no cover - diagnostics only
+                pass
 
     def install(self) -> "PreemptionGuard":
         for signum in self.signums:

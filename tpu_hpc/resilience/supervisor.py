@@ -43,6 +43,8 @@ import sys
 import time
 from typing import IO, List, Optional, Sequence, Tuple
 
+from tpu_hpc.obs.events import ENV_FLIGHT_DIR, ENV_RUN_ID, gen_run_id
+from tpu_hpc.obs.schema import stamp
 from tpu_hpc.resilience.heartbeat import ENV_ATTEMPT, ENV_HEARTBEAT
 from tpu_hpc.resilience.retry import backoff_delays
 from tpu_hpc.resilience.signals import (
@@ -104,12 +106,20 @@ class Supervisor:
         self.poll_s = poll_s
         self._child: Optional[subprocess.Popen] = None
         self._stop_requested = False
+        # One run identity across every attempt: exported to each
+        # child (TPU_HPC_RUN_ID) and stamped on the supervisor's own
+        # events, so attempt logs, the run JSONL, and flight dumps all
+        # join on it. An operator-set run id is honored.
+        self.run_id = os.environ.get(ENV_RUN_ID) or gen_run_id()
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
 
     # -- event log ----------------------------------------------------
     def _event(self, **rec) -> None:
-        rec = {"time": time.time(), **rec}
+        # Schema-stamped like every other telemetry sink
+        # (obs/schema.py declares the attempt_* event kinds), so one
+        # validator and one report read supervisor.jsonl too.
+        rec = stamp(rec, run_id=self.run_id, pid=os.getpid())
         line = json.dumps(rec)
         print(f"supervisor: {line}", file=sys.stderr, flush=True)
         if self.log_dir:
@@ -151,7 +161,14 @@ class Supervisor:
     def _run_attempt(self, attempt: int) -> Tuple[int, str, str]:
         """Returns (rc, reason, log_path). ``reason`` is "exit" or
         "heartbeat-stall"."""
-        env = dict(os.environ, **{ENV_ATTEMPT: str(attempt)})
+        env = dict(os.environ, **{
+            ENV_ATTEMPT: str(attempt), ENV_RUN_ID: self.run_id,
+        })
+        # Flight-recorder dumps land next to the attempt logs (unless
+        # the operator already pointed them elsewhere): the evidence
+        # of WHY an attempt died belongs with that attempt's log.
+        if self.log_dir and ENV_FLIGHT_DIR not in env:
+            env[ENV_FLIGHT_DIR] = self.log_dir
         if self.heartbeat:
             env[ENV_HEARTBEAT] = self.heartbeat
             # Clear the previous attempt's heartbeat: a stale file

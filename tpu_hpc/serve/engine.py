@@ -41,6 +41,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_hpc.models import llama2
+from tpu_hpc.obs import span
 
 
 @dataclasses.dataclass(frozen=True)
@@ -407,7 +408,11 @@ class Engine:
     def prefill(self, slot: int, prompt: Sequence[int]) -> int:
         """Run one request's prompt through the bucketed prefill
         program, writing its K/V into ``slot``; returns the first
-        greedy token."""
+        greedy token. Bracketed as a ``prefill`` span (obs/spans.py):
+        the JSONL/flight-ring phase record and the XProf
+        TraceAnnotation share one bracket. ``int(tok)`` inside the
+        span is the device fetch, so the span measures
+        dispatch-to-result like the Trainer's chunk timer."""
         n = len(prompt)
         if n < 1:
             raise ValueError("empty prompt")
@@ -417,11 +422,13 @@ class Engine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = np.asarray(prompt, np.int32)
         exec_ = self._get_exec(("prefill", bucket))
-        self.ks, self.vs, tok = exec_(
-            self.params, self.ks, self.vs,
-            self._rep_arr(padded), self._rep_arr(n), self._rep_arr(slot),
-        )
-        return int(tok)
+        with span("prefill", hist="serve_prefill_s", n=bucket):
+            self.ks, self.vs, tok = exec_(
+                self.params, self.ks, self.vs,
+                self._rep_arr(padded), self._rep_arr(n),
+                self._rep_arr(slot),
+            )
+            return int(tok)
 
     def decode(
         self, tokens: Sequence[int], positions: Sequence[int]
@@ -429,11 +436,13 @@ class Engine:
         """One decode step for every slot: ``tokens[s]`` enters at
         position ``positions[s]``. Returns the next greedy token per
         slot (inactive slots produce garbage the scheduler ignores --
-        their mask still bounds what they read)."""
+        their mask still bounds what they read). Span-bracketed like
+        :meth:`prefill`; the ``np.asarray`` fetch rides inside."""
         exec_ = self._get_exec(("decode",))
-        self.ks, self.vs, toks = exec_(
-            self.params, self.ks, self.vs,
-            self._rep_arr(np.asarray(tokens, np.int32)),
-            self._rep_arr(np.asarray(positions, np.int32)),
-        )
-        return np.asarray(toks)
+        with span("decode", hist="serve_decode_s"):
+            self.ks, self.vs, toks = exec_(
+                self.params, self.ks, self.vs,
+                self._rep_arr(np.asarray(tokens, np.int32)),
+                self._rep_arr(np.asarray(positions, np.int32)),
+            )
+            return np.asarray(toks)

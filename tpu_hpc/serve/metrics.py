@@ -13,11 +13,10 @@ would understate serving utilization 3x.
 from __future__ import annotations
 
 import dataclasses
-import json
-import os
 import time
 from typing import Dict, List, Optional
 
+from tpu_hpc.obs import get_bus, get_registry
 from tpu_hpc.train.metrics import mfu
 
 
@@ -83,17 +82,27 @@ class ServeMeter:
     def finished(self, rid: str) -> None:
         trace = self.traces[rid]
         trace.t_done = time.perf_counter()
+        ttft_ms = 1e3 * (trace.t_first - trace.t_submit)
         self._append({
             "event": "request",
             "time": time.time(),
             "rid": rid,
-            "ttft_ms": 1e3 * (trace.t_first - trace.t_submit),
+            "ttft_ms": ttft_ms,
             "queue_ms": 1e3 * (
                 (trace.t_admit or trace.t_submit) - trace.t_submit
             ),
             "tokens": len(trace.token_times),
             "total_ms": 1e3 * (trace.t_done - trace.t_submit),
         })
+        # The shared metrics namespace (obs/registry.py): serving
+        # counters/latency live next to the training gauges, one
+        # snapshot + one Prometheus exposition for both.
+        reg = get_registry()
+        reg.inc("serve_requests_total")
+        reg.inc("serve_tokens_total", len(trace.token_times))
+        reg.observe("serve_ttft_ms", ttft_ms)
+        for a, b in zip(trace.token_times, trace.token_times[1:]):
+            reg.observe("serve_itl_ms", 1e3 * (b - a))
 
     # -- aggregation ---------------------------------------------------
     def summary(
@@ -149,12 +158,18 @@ class ServeMeter:
         self._append({
             "event": "serve_summary", "time": time.time(), **summary
         })
+        reg = get_registry()
+        for key in ("tokens_per_s", "tokens_per_s_per_chip",
+                    "serve_mfu"):
+            if key in summary:
+                reg.set_gauge(f"serve_{key}", summary[key])
+        # Textfile-collector exposition (no-op unless
+        # $TPU_HPC_PROM_FILE is set), now carrying the serving gauges.
+        reg.write_prometheus()
 
     def _append(self, record: Dict) -> None:
-        if not self.metrics_path:
-            return
-        parent = os.path.dirname(self.metrics_path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        with open(self.metrics_path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        """Every record rides the obs bus: schema-stamped, into the
+        flight-recorder ring on this host, and appended to
+        ``metrics_path`` when one is configured -- the Trainer's
+        ``_append_metrics`` discipline, shared."""
+        get_bus().emit_record(record, sink=self.metrics_path)

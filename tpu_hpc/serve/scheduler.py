@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from tpu_hpc.obs import get_registry
 from tpu_hpc.serve.engine import Engine
 
 
@@ -137,6 +138,7 @@ class ContinuousBatcher:
                 )
             first = self.engine.prefill(idx, req.prompt)
             self.stats["admitted"] += 1
+            get_registry().inc("serve_admitted_total")
             slot.rid = req.rid
             slot.pos = len(req.prompt)
             slot.last_token = first
@@ -153,6 +155,11 @@ class ContinuousBatcher:
         positions = [s.pos for s in self.slots]
         out = self.engine.decode(tokens, positions)
         self.stats["decode_steps"] += 1
+        reg = get_registry()
+        reg.inc("serve_decode_steps_total")
+        # Occupancy is THE continuous-batching health number: a low
+        # gauge under queued load means admission is starving decode.
+        reg.set_gauge("serve_active_slots", self.active)
         for slot, tok in zip(self.slots, np.asarray(out)):
             if slot.free:
                 continue

@@ -78,13 +78,22 @@ def run_replay(
     from tpu_hpc.serve.weights import load_serving_params
     from tpu_hpc.resilience.heartbeat import Heartbeat
 
+    from tpu_hpc import obs
+
     mesh = build_serving_mesh(jax.device_count(), cfg)
-    if checkpoint_dir:
-        params = load_serving_params(checkpoint_dir, cfg, mesh)
-    else:
-        params = llama2.init_llama(jax.random.key(seed), cfg)
+    # Bring-up phases as spans: restore-vs-compile time is the first
+    # question about any slow serving start, and these records (to
+    # ``metrics_path`` + the flight ring) answer it without a profiler
+    # attach.
+    with obs.span("restore", sink=metrics_path,
+                  hist="serve_restore_s"):
+        if checkpoint_dir:
+            params = load_serving_params(checkpoint_dir, cfg, mesh)
+        else:
+            params = llama2.init_llama(jax.random.key(seed), cfg)
     engine = Engine(params, cfg, serve_cfg, mesh)
-    n_programs = engine.warmup()
+    with obs.span("warmup", sink=metrics_path, hist="serve_warmup_s"):
+        n_programs = engine.warmup()
 
     meter = ServeMeter(metrics_path=metrics_path)
     batcher = ContinuousBatcher(engine, meter=meter)
@@ -127,6 +136,9 @@ def run_replay(
         batcher=dict(batcher.stats),
     )
     meter.write_summary(summary)
+    # Close the replay's JSONL with the registry snapshot, mirroring
+    # the Trainer's run_end discipline -- one schema, two producers.
+    obs.get_registry().emit_snapshot(sink=metrics_path)
     return summary
 
 

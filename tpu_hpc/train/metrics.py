@@ -7,10 +7,11 @@ north-star metric, BASELINE.md) which the reference lacks.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Deque, Dict, Iterator, Optional
 
 
 @dataclasses.dataclass
@@ -21,12 +22,28 @@ class ThroughputMeter:
     multiplies by WORLD_SIZE (multinode_ddp_unet.py:334-361); here the
     caller brackets with block_until_ready and items are *global*
     already (jax arrays are process-global), so no world-size fixup.
+
+    Per-batch samples are WINDOWED (bounded deques, newest ``window``
+    batches): a meter left running for a million-step run must not
+    grow host memory without limit. The Trainer resets per chunk, so
+    its summaries never see the bound; a caller that meters more
+    batches than ``window`` between summaries gets the newest-window
+    aggregate, which is what a rolling throughput reading means.
     """
 
     n_devices: int = 1
-    batch_times: List[float] = dataclasses.field(default_factory=list)
-    batch_items: List[int] = dataclasses.field(default_factory=list)
+    window: int = 4096
+    batch_times: Optional[Deque[float]] = None
+    batch_items: Optional[Deque[int]] = None
     _t0: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.window < 1:
+            raise ValueError(f"window {self.window} must be >= 1")
+        if self.batch_times is None:
+            self.batch_times = collections.deque(maxlen=self.window)
+        if self.batch_items is None:
+            self.batch_items = collections.deque(maxlen=self.window)
 
     def start_batch(self) -> None:
         self._t0 = time.perf_counter()
@@ -47,8 +64,10 @@ class ThroughputMeter:
     def epoch_summary(self, skip_first: int = 1) -> Dict[str, float]:
         """Aggregate items/s over the epoch, skipping warmup batches
         (first batch carries compile time). Parity :363-398."""
-        times = self.batch_times[skip_first:] or self.batch_times
-        items = self.batch_items[skip_first:] or self.batch_items
+        times = list(self.batch_times)[skip_first:] \
+            or list(self.batch_times)
+        items = list(self.batch_items)[skip_first:] \
+            or list(self.batch_items)
         total_t = sum(times)
         total_i = sum(items)
         thpt = total_i / total_t if total_t else 0.0

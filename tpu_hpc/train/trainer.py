@@ -25,6 +25,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_hpc import obs
 from tpu_hpc.config import TrainingConfig
 from tpu_hpc.logging_ import get_logger
 from tpu_hpc.parallel.fsdp import validate_grad_sync_mode
@@ -549,6 +550,18 @@ class Trainer:
         self.goodput = GoodputMeter()
         self.heartbeat = Heartbeat.from_env()
         self.fault_plan = fault_plan_from_env()
+        # Telemetry spine (tpu_hpc.obs): every record the Trainer
+        # writes goes through the process bus -- schema-stamped, into
+        # the flight-recorder ring on EVERY host, and to the metrics
+        # JSONL on host 0. Flight dumps land next to the checkpoints
+        # unless the supervisor already pointed them at its log dir.
+        bus = obs.get_bus()
+        if bus.flight_dir is None and cfg.checkpoint_dir:
+            bus.flight_dir = cfg.checkpoint_dir
+        # Step-time watermark: flags stragglers/stalls (a ``stall``
+        # event) and enriches the heartbeat so the supervisor can tell
+        # hung from slow without attaching to the process.
+        self.stall = obs.StallDetector()
         # Optional callable(state, step) run when a preemption notice
         # stops the run, BEFORE the emergency snapshot -- the hook for
         # recipe-level cleanup (flush custom logs, export metrics).
@@ -716,19 +729,31 @@ class Trainer:
             })
         return out
 
+    def _sink(self) -> Optional[str]:
+        """The metrics JSONL path, on the host that owns the run log
+        (host 0); None elsewhere, so bus emits ring-buffer only."""
+        if self.cfg.metrics_path and jax.process_index() == 0:
+            return self.cfg.metrics_path
+        return None
+
     def _append_metrics(self, record: Dict) -> None:
         """Host-0 append-only JSONL run log (``cfg.metrics_path``) --
         the reference's benchmark_results.log discipline
-        (scripts/main.py:381-397) as structured records."""
-        if not self.cfg.metrics_path or jax.process_index() != 0:
-            return
-        import json
+        (scripts/main.py:381-397) as structured records, routed
+        through the obs bus: schema-stamped (run_id/host/pid), held in
+        the flight-recorder ring, and appended to the file when one is
+        configured."""
+        obs.get_bus().emit_record(record, sink=self._sink())
 
-        parent = os.path.dirname(self.cfg.metrics_path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        with open(self.cfg.metrics_path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+    def _emit_span(self, name: str, dur_s: float, step: int,
+                   **fields) -> None:
+        """One pre-measured phase duration as a ``span`` event (+ a
+        registry histogram) -- the report's step-time breakdown reads
+        these."""
+        obs.emit_span(
+            name, dur_s, sink=self._sink(), step=step,
+            hist=f"train_{name}_s", **fields,
+        )
 
     def _snapshot_config(self) -> None:
         """Write config.yaml next to the checkpoints -- the exact
@@ -755,7 +780,9 @@ class Trainer:
         checkpoint exists (parity: multinode_ddp_basic.py:144-155)."""
         if self.checkpoint_manager is None or not self.cfg.resume:
             return 0
-        with self.goodput.measure("restore"):
+        with self.goodput.measure("restore"), obs.span(
+            "restore", sink=self._sink(), hist="train_restore_s"
+        ):
             restored = self.checkpoint_manager.restore_latest(self.state)
         if restored is not None:
             self.state = restored
@@ -822,22 +849,25 @@ class Trainer:
         # in the same directory.
         self._effective_cfg = dataclasses.replace(cfg, epochs=epochs)
         self._config_snapshotted = False  # per-fit: epochs may differ
-        if jax.process_index() == 0:
-            if cfg.metrics_path:
-                dev = jax.devices()[0]
-                self._append_metrics({
-                    "event": "run_start",
-                    "time": time.time(),
-                    "start_step": start_step,
-                    "total_steps": total_steps,
-                    "n_devices": jax.device_count(),
-                    "n_processes": jax.process_count(),
-                    "device_kind": getattr(
-                        dev, "device_kind", dev.platform
-                    ),
-                    "jax_version": jax.__version__,
-                    "config": dataclasses.asdict(self._effective_cfg),
-                })
+        # Emitted on EVERY host (the file write still lands only on
+        # host 0 via _sink), and even without a metrics_path: a
+        # flight dump from whichever host wedges must carry the run's
+        # identity and shape -- the wedging host is rarely the one
+        # writing the run log.
+        dev = jax.devices()[0]
+        self._append_metrics({
+            "event": "run_start",
+            "time": time.time(),
+            "start_step": start_step,
+            "total_steps": total_steps,
+            "n_devices": jax.device_count(),
+            "n_processes": jax.process_count(),
+            "device_kind": getattr(
+                dev, "device_kind", dev.platform
+            ),
+            "jax_version": jax.__version__,
+            "config": dataclasses.asdict(self._effective_cfg),
+        })
         # Fast path: datasets with a traceable generator get whole-epoch
         # lax.scan (one dispatch/epoch); host-fed datasets fall back to
         # the per-step loop. A resume landing mid-epoch runs a shorter
@@ -893,6 +923,7 @@ class Trainer:
                 prof.stop()
         preempted = guard is not None and guard.triggered
         goodput = self.goodput.summary()
+        end_step = int(jax.device_get(self.state.step))
         if jax.process_index() == 0:
             # Restart accounting: every fit appends one goodput record
             # so a supervised, preempted-and-resumed run leaves an
@@ -900,12 +931,18 @@ class Trainer:
             self._append_metrics({
                 "event": "run_end",
                 "time": time.time(),
-                "step": int(jax.device_get(self.state.step)),
+                "step": end_step,
                 "preempted": preempted,
                 "attempt": current_attempt(),
                 "resumed_from_step": start_step,
                 "goodput": goodput,
             })
+        # Close the run JSONL with the final counter/gauge/histogram
+        # state -- ONE metrics namespace shared with serving, exported
+        # the same two ways (JSONL snapshot + Prometheus textfile).
+        reg = obs.get_registry()
+        reg.emit_snapshot(sink=self._sink(), step=end_step)
+        reg.write_prometheus()
         return {
             "epochs": run_summaries,
             "final_loss": float(jax.device_get(last_metrics["loss"]))
@@ -957,15 +994,18 @@ class Trainer:
                 prof.annotate(done) if prof is not None
                 else contextlib.nullcontext()
             )
+            data_s = 0.0
             with self.goodput.measure("productive"), ann:
                 if scanned:
                     self.state, stacked = epoch_fn(self.state)
                     last_metrics = jax.tree.map(lambda a: a[-1], stacked)
                 else:
                     for i in range(chunk):
+                        t_data = time.perf_counter()
                         batch = dataset.batch_at(
                             done + i, cfg.global_batch_size
                         )
+                        data_s += time.perf_counter() - t_data
                         last_metrics = self.train_step(batch)
                 # ONE host fetch per chunk, INSIDE the productive
                 # window: it is both the chunk barrier (the dispatched
@@ -975,12 +1015,36 @@ class Trainer:
                 # log, and grad_norm separately cost three device
                 # round trips per chunk.
                 last_metrics = jax.device_get(last_metrics)
-            self.meter.end_batch(chunk * cfg.global_batch_size)
+            chunk_s = self.meter.end_batch(chunk * cfg.global_batch_size)
             done += chunk
+            s_per_step = chunk_s / max(chunk, 1)
+            # Phase spans (the report's step-time breakdown). On the
+            # scanned path data generation and the grad collectives
+            # are fused into the one compiled chunk, so the whole
+            # chunk is "compute" -- the report names the fusion
+            # rather than silently omitting those phases; the
+            # host-fed path meters its host data time separately.
+            self._emit_span(
+                "compute", max(chunk_s - data_s, 0.0), done, n=chunk
+            )
+            if data_s > 0:
+                self._emit_span("data", data_s, done, n=chunk)
+            # Straggler/stall watermark: a breach emits a ``stall``
+            # event (every host -- the straggling host is rarely the
+            # one writing the run log).
+            self.stall.observe(done, s_per_step, sink=self._sink())
+            reg = obs.get_registry()
+            reg.inc("train_steps_total", chunk)
+            reg.inc("train_items_total", chunk * cfg.global_batch_size)
+            reg.set_gauge("train_step", done)
+            reg.observe("train_step_s", s_per_step)
             if self._watchdog is not None:
                 self._watchdog.tick()
             if self.heartbeat is not None:
-                self.heartbeat.tick(done)
+                # last-step + step-time enrichment: an outside reader
+                # (the supervisor, an operator's cat) can now tell
+                # "wedged" from "slower than its own recent past".
+                self.heartbeat.tick(done, **self.stall.heartbeat_extra())
             summary = self.meter.epoch_summary(skip_first=0)
             run_summaries.append(summary)
             if jax.process_index() == 0:
@@ -1007,6 +1071,13 @@ class Trainer:
                 if "grad_norm" in last_metrics:
                     rec["grad_norm"] = float(last_metrics["grad_norm"])
                 self._append_metrics(rec)
+                reg.set_gauge("train_loss", loss)
+                reg.set_gauge(
+                    "train_items_per_s", summary["items_per_s"]
+                )
+            # Prometheus textfile exposition: a no-op unless
+            # $TPU_HPC_PROM_FILE names the scrape file.
+            reg.write_prometheus()
             # Fault injection (no-op unless TPU_HPC_FAULTS is set):
             # fires BEFORE the periodic save so a kill at step N
             # leaves the previous checkpoint as the newest one -- the
@@ -1023,7 +1094,10 @@ class Trainer:
                 and cfg.save_every
                 and done % (cfg.save_every * steps_per_epoch) == 0
             ):
-                with self.goodput.measure("ckpt"):
+                with self.goodput.measure("ckpt"), obs.span(
+                    "ckpt", sink=self._sink(), step=done,
+                    hist="train_ckpt_s",
+                ):
                     self.checkpoint_manager.save(self.state)
                     self._snapshot_config()
             if guard is not None and guard.triggered:
@@ -1036,7 +1110,14 @@ class Trainer:
                 )
                 if self.on_preempt is not None:
                     self.on_preempt(self.state, done)
-                with self.goodput.measure("ckpt"):
+                # Flight evidence FIRST: the ring holds the events
+                # leading up to the notice, and the grace window may
+                # not survive the emergency save below.
+                obs.dump_flight("preempt")
+                with self.goodput.measure("ckpt"), obs.span(
+                    "ckpt", sink=self._sink(), step=done,
+                    hist="train_ckpt_s",
+                ):
                     if done not in (
                         self.checkpoint_manager.all_steps() or []
                     ):
