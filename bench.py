@@ -636,6 +636,77 @@ def bench_serve(
     return rec
 
 
+def loadgen_record(summary: dict) -> dict:
+    """Load-harness summary -> the bench record schema. The headline
+    value is the interactive-visible p95 TTFT in VIRTUAL ms (the
+    harness's deterministic clock -- scheduling behavior, not machine
+    noise; wall-clock throughput remains the serve row's job), with
+    the per-tenant shed/queued breakdown riding along so the regress
+    gate can hold admission control to its history."""
+    tenants = summary.get("tenants", {})
+    return {
+        "metric": f"loadgen_{summary['scenario']}_ttft_ms_p95",
+        "value": round(summary["ttft_ms_p95"], 3),
+        "unit": "virtual_ms",
+        "vs_baseline": None,
+        "ttft_ms_p50": round(summary["ttft_ms_p50"], 3),
+        "ttft_ms_p99": round(summary["ttft_ms_p99"], 3),
+        "itl_ms_p50": round(summary["itl_ms_p50"], 3),
+        "itl_ms_p95": round(summary["itl_ms_p95"], 3),
+        "loadgen": {
+            "scenario": summary["scenario"],
+            "seed": summary["seed"],
+            "shed": summary["shed"],
+            "queued": summary["queued"],
+            "occupancy_mean": round(summary["occupancy_mean"], 4),
+            "stall_events": summary["stall_events"],
+            "slo_violations": summary["slo_violations"],
+            "recompiles": summary["recompiles"],
+            "tenants": {
+                name: {
+                    "shed": t["shed"], "queued": t["queued"],
+                    "ttft_ms_p95": round(t["ttft_ms_p95"], 3),
+                }
+                for name, t in tenants.items()
+            },
+        },
+    }
+
+
+def bench_loadgen(
+    scenario: str = "multi_tenant", requests: int = 64,
+    slots: int = 8, max_new: int = 32, seed: int = 0,
+) -> dict:
+    """Scenario-diverse load row: the SAME ~170M bench architecture as
+    the serve row, driven by the tpu_hpc.loadgen harness. ``recompiles``
+    must read 0 like the serve row -- a scenario mix that recompiled
+    would be measuring the compiler."""
+    from tpu_hpc.runtime import init_distributed
+    from tpu_hpc.serve.engine import ServeConfig
+    from tpu_hpc.serve.server import run_loadgen
+
+    init_distributed(verbose=False)
+    model_cfg = bench_model_cfg()
+    buckets = (128, 256, 512)
+    serve_cfg = ServeConfig(
+        slots=slots,
+        max_seq_len=max(buckets) + max_new,
+        prefill_buckets=buckets,
+    )
+    summary = run_loadgen(
+        model_cfg, serve_cfg, scenario, requests, max_new, seed=seed
+    )
+    rec = loadgen_record(summary)
+    print(
+        f"loadgen {scenario} | shed {summary['shed']} "
+        f"queued {summary['queued']} | TTFT p95 "
+        f"{summary['ttft_ms_p95']:.1f} virtual-ms | occupancy "
+        f"{summary['occupancy_mean']:.0%}",
+        file=sys.stderr,
+    )
+    return rec
+
+
 def bench_unet(steps: int = 20) -> dict:
     import jax
     import jax.numpy as jnp
@@ -768,6 +839,7 @@ def run_all(out_path: str, steps: int, devinfo=None) -> int:
          ["--workload", "llama", "--comm-mode", "bucketed_overlap"]),
         ("llama-long seq 8192", ["--workload", "llama-long"]),
         ("serve (continuous batching)", ["--workload", "serve"]),
+        ("loadgen multi-tenant mix", ["--workload", "loadgen"]),
         ("unet ddp", ["--workload", "unet"]),
     ]
     rows, raw = [], []
@@ -846,7 +918,7 @@ def main(argv=None) -> int:
         "--workload",
         choices=(
             "llama", "llama-sp", "llama-pp", "llama-long", "unet",
-            "serve",
+            "serve", "loadgen",
         ),
         default=None,  # resolved after --serve alias handling
     )
@@ -859,6 +931,13 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-requests", type=int, default=32)
     ap.add_argument("--serve-slots", type=int, default=8)
     ap.add_argument("--serve-max-new", type=int, default=64)
+    ap.add_argument(
+        "--loadgen-scenario", type=str, default=None,
+        help="tpu_hpc.loadgen catalog scenario for --workload loadgen "
+        "(default multi_tenant; sized by --serve-requests/"
+        "--serve-slots; virtual-clock latencies, the regress gate's "
+        "input)",
+    )
     ap.add_argument(
         "--all", action="store_true",
         help="run every workload family, write BENCH_EXTRA.md/.jsonl",
@@ -961,6 +1040,15 @@ def main(argv=None) -> int:
         args.workload = "serve"
     elif args.workload is None:
         args.workload = "llama"
+    if args.loadgen_scenario is not None and args.workload != "loadgen":
+        # Same discipline as the --comm-mode guard below: a scenario
+        # flag the selected workload never consumes must be a CLI
+        # error, not a silently-plain run recorded as the scenario.
+        ap.error(
+            f"--loadgen-scenario {args.loadgen_scenario} is only "
+            f"consumed by --workload loadgen; --workload "
+            f"{args.workload} would silently ignore it"
+        )
     if args.comm_mode != "flat" and (
         args.all or args.workload not in ("llama", "llama-long")
     ):
@@ -1050,6 +1138,13 @@ def main(argv=None) -> int:
     elif args.workload == "serve":
         rec = bench_serve(
             requests=args.serve_requests, slots=args.serve_slots,
+            max_new=args.serve_max_new,
+        )
+    elif args.workload == "loadgen":
+        rec = bench_loadgen(
+            scenario=args.loadgen_scenario or "multi_tenant",
+            requests=args.serve_requests * 2,
+            slots=args.serve_slots,
             max_new=args.serve_max_new,
         )
     else:

@@ -265,3 +265,60 @@ def test_serve_alias_conflicts_with_explicit_workload(bench, monkeypatch):
     monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
     with pytest.raises(SystemExit):
         bench.main(["--workload", "llama", "--serve"])
+
+
+def test_loadgen_mode_routes_flags(bench, monkeypatch):
+    """--workload loadgen reaches bench_loadgen with the scenario and
+    sizing knobs (requests doubled vs serve: the harness measures
+    queueing, which needs backlog)."""
+    seen = {}
+
+    def fake_bench_loadgen(scenario, requests, slots, max_new):
+        seen.update(scenario=scenario, requests=requests, slots=slots,
+                    max_new=max_new)
+        return {"metric": "loadgen_x_ttft_ms_p95", "value": 1.0,
+                "unit": "virtual_ms", "vs_baseline": None}
+
+    monkeypatch.setattr(bench, "bench_loadgen", fake_bench_loadgen)
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    rc = bench.main([
+        "--workload", "loadgen", "--loadgen-scenario", "bursty",
+        "--serve-requests", "16", "--serve-slots", "4",
+        "--serve-max-new", "16",
+    ])
+    assert rc == 0
+    assert seen == {"scenario": "bursty", "requests": 32, "slots": 4,
+                    "max_new": 16}
+    # Misplaced scenario flag = CLI error (the --comm-mode
+    # discipline), never a silently-plain run recorded as the
+    # scenario.
+    with pytest.raises(SystemExit):
+        bench.main(["--loadgen-scenario", "colocate"])
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "serve",
+                    "--loadgen-scenario", "colocate"])
+
+
+def test_loadgen_record_schema_matches_training_benches(bench):
+    """Loadgen rows land in the same record schema as every other
+    workload, with the shed/queued admission evidence riding along."""
+    summary = {
+        "scenario": "multi_tenant", "seed": 0,
+        "ttft_ms_p50": 5.0, "ttft_ms_p95": 20.0, "ttft_ms_p99": 30.0,
+        "itl_ms_p50": 8.0, "itl_ms_p95": 12.0,
+        "shed": 3, "queued": 7, "occupancy_mean": 0.8,
+        "stall_events": 1, "slo_violations": [], "recompiles": 0,
+        "tenants": {
+            "background": {"shed": 3, "queued": 2,
+                           "ttft_ms_p95": 40.0},
+        },
+    }
+    rec = bench.loadgen_record(summary)
+    assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
+    assert rec["metric"] == "loadgen_multi_tenant_ttft_ms_p95"
+    assert rec["value"] == 20.0 and rec["unit"] == "virtual_ms"
+    assert rec["loadgen"]["shed"] == 3
+    assert rec["loadgen"]["tenants"]["background"]["shed"] == 3
+    from tpu_hpc.obs import stamp, validate_record
+
+    validate_record(stamp({"event": "bench", **rec}))

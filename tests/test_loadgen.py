@@ -1,0 +1,648 @@
+"""tpu_hpc.loadgen -- the SLO-driven load harness.
+
+Three invariant families:
+
+* **reproducibility** -- a seeded scenario materializes byte-identical
+  request schedules, and a seeded sim-mesh load run replayed twice
+  yields bit-identical latency quantiles (virtual clock), so
+  ``python -m tpu_hpc.obs.regress`` over the two runs is clean -- and
+  an injected latency fault (TPU_HPC_LOADGEN_FAULTS) makes it exit
+  non-zero naming the violated metric+quantile. This is the PR's
+  end-to-end gate proof.
+* **lifecycle telemetry** -- every arrival/admit/first-token/finish/
+  shed lands as a schema-valid ``lg_*`` record, and the report's
+  loadgen section reconstructs the per-tenant breakdown from them.
+* **admission control** -- under a saturating burst the scheduler
+  sheds ONLY the lowest-priority tenant class, emits schema-valid
+  ``admission`` events, and the occupancy gauge tracks the live slot
+  count through every admit/evict/shutdown transition.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_hpc import obs
+from tpu_hpc.loadgen import (
+    SCENARIOS,
+    LoadHarness,
+    build_scenario,
+    parse_faults,
+)
+from tpu_hpc.loadgen.scenarios import (
+    heavy_tail_lengths,
+    onoff_arrivals,
+    poisson_arrivals,
+)
+from tpu_hpc.models import llama2
+from tpu_hpc.obs.regress import main as regress_main
+from tpu_hpc.obs.report import build_report
+from tpu_hpc.obs.schema import load_records, validate_file
+from tpu_hpc.runtime import MeshSpec, build_mesh
+from tpu_hpc.serve import Engine, ServeConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = llama2.LlamaConfig(
+    dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+    multiple_of=16, max_seq_len=64, dtype=jnp.float32,
+)
+MAX_PROMPT, MAX_NEW = 16, 6
+
+
+@pytest.fixture(scope="module")
+def lg_engine(devices):
+    mesh = build_mesh(MeshSpec(axes={"data": 4, "model": 2}))
+    params = llama2.init_llama(jax.random.key(0), TINY)
+    engine = Engine(
+        params, TINY,
+        ServeConfig(slots=4, max_seq_len=48, prefill_buckets=(8, 16)),
+        mesh,
+    )
+    engine.warmup()
+    return engine
+
+
+@pytest.fixture()
+def scoped_obs(tmp_path):
+    """Fresh bus + registry per test: the harness publishes into the
+    process singletons, and tests must not see each other's counters."""
+    bus = obs.EventBus(path=None, run_id="lg-test",
+                       flight_dir=str(tmp_path))
+    reg = obs.MetricsRegistry()
+    prev_bus, prev_reg = obs.set_bus(bus), obs.set_registry(reg)
+    yield bus, reg
+    obs.set_bus(prev_bus)
+    obs.set_registry(prev_reg)
+
+
+def _scenario(name, seed=7, n=24):
+    return build_scenario(
+        name, seed=seed, n_requests=n, vocab_size=TINY.vocab_size,
+        max_prompt=MAX_PROMPT, max_new=MAX_NEW,
+    )
+
+
+def _run(engine, name, path, seed=7, n=24, faults=""):
+    harness = LoadHarness(
+        engine, _scenario(name, seed=seed, n=n),
+        metrics_path=str(path), faults=parse_faults(faults),
+    )
+    return harness.run(n_devices=jax.device_count()), harness
+
+
+# ---------------------------------------------------------------------
+# scenarios.py: the catalog
+# ---------------------------------------------------------------------
+class TestScenarios:
+    def test_same_seed_is_byte_identical(self):
+        a = _scenario("multi_tenant", seed=5)
+        b = _scenario("multi_tenant", seed=5)
+        assert a == b  # frozen dataclasses: full deep equality
+
+    def test_different_seed_differs(self):
+        assert _scenario("steady", seed=1) != _scenario("steady", seed=2)
+
+    def test_catalog_builds_within_engine_limits(self):
+        for name in SCENARIOS:
+            sc = _scenario(name)
+            assert len(sc.requests) == 24
+            arrivals = [r.arrival_ms for r in sc.requests]
+            assert arrivals == sorted(arrivals)
+            for r in sc.requests:
+                assert 1 <= len(r.prompt) <= MAX_PROMPT
+                assert 1 <= r.max_new_tokens <= MAX_NEW
+
+    def test_unknown_scenario_rejected(self):
+        with pytest.raises(ValueError, match="unknown scenario"):
+            _scenario("nope")
+
+    def test_multi_tenant_classes_and_slos(self):
+        sc = _scenario("multi_tenant")
+        names = {t.name for t in sc.tenants}
+        assert names == {"interactive", "batch", "background"}
+        prios = {t.name: t.priority for t in sc.tenants}
+        assert prios["interactive"] > prios["batch"] > prios["background"]
+        assert sc.tenant("interactive").slo["ttft_ms_p95"] > 0
+        # every class actually sends traffic
+        seen = {r.tenant for r in sc.requests}
+        assert seen == names
+
+    def test_heavy_tail_has_a_tail(self):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        lens = heavy_tail_lengths(
+            rng, 4000, median=8.0, sigma=1.0, lo=1, hi=512
+        )
+        assert lens.min() >= 1 and lens.max() <= 512
+        p50, p99 = np.percentile(lens, [50, 99])
+        assert p99 > 3 * p50  # heavy-tailed, not uniform
+
+    def test_arrival_processes(self):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        pois = poisson_arrivals(rng, 1000, rate_per_s=100.0)
+        assert len(pois) == 1000 and np.all(np.diff(pois) >= 0)
+        # mean gap ~ 10ms
+        assert 8.0 < np.mean(np.diff(pois)) < 12.0
+        burst = onoff_arrivals(
+            rng, 100, burst_size=10, burst_rate_per_s=1000.0,
+            off_ms=500.0,
+        )
+        gaps = np.diff(burst)
+        # 9 inter-burst silences of >= 500ms, tight gaps inside bursts
+        assert (gaps > 400).sum() == 9
+        # validation parity with poisson_arrivals (review finding:
+        # rate 0 died in ZeroDivisionError, negative rates produced
+        # non-monotonic arrivals)
+        with pytest.raises(ValueError, match="must be > 0"):
+            poisson_arrivals(rng, 10, rate_per_s=0.0)
+        with pytest.raises(ValueError, match="must be > 0"):
+            onoff_arrivals(rng, 10, 4, burst_rate_per_s=0.0,
+                           off_ms=1.0)
+        with pytest.raises(ValueError, match="off_ms"):
+            onoff_arrivals(rng, 10, 4, burst_rate_per_s=10.0,
+                           off_ms=-1.0)
+
+    def test_unknown_slo_metric_rejected_at_build(self):
+        """A typoed SLO key that could never be violated would make
+        every gate built on its verdict vacuous (review finding) --
+        reject at construction, like parse_faults does."""
+        from tpu_hpc.loadgen import TenantClass
+
+        with pytest.raises(ValueError, match="unknown SLO metric"):
+            TenantClass("t", slo={"ttft_ms_p90": 100.0})
+        with pytest.raises(ValueError, match="unknown SLO metric"):
+            TenantClass("t", slo={"itl_ms_p99": 20.0})
+        TenantClass("t", slo={"ttft_ms_p95": 100.0})  # known: fine
+
+    def test_fault_spec_parsing(self):
+        assert parse_faults("") == {
+            "prefill_delay": 1.0, "decode_delay": 1.0,
+        }
+        got = parse_faults("prefill_delay=1.5, decode_delay=2")
+        assert got == {"prefill_delay": 1.5, "decode_delay": 2.0}
+        with pytest.raises(ValueError, match="unknown loadgen fault"):
+            parse_faults("ttft=2")
+        with pytest.raises(ValueError, match="must be > 0"):
+            parse_faults("decode_delay=0")
+
+
+# ---------------------------------------------------------------------
+# the end-to-end gate proof (acceptance): replay-deterministic
+# quantiles; injected latency fails regress naming metric+quantile
+# ---------------------------------------------------------------------
+class TestRegressGateEndToEnd:
+    def test_replay_is_regress_clean_and_fault_fails(
+        self, lg_engine, scoped_obs, tmp_path, capsys,
+    ):
+        pa, pb, pc = (str(tmp_path / f"{x}.jsonl") for x in "abc")
+        sa, _ = _run(lg_engine, "bursty", pa)
+        sb, _ = _run(lg_engine, "bursty", pb)
+        # Virtual clock: the quantiles are bit-identical, not close.
+        for k in ("ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
+                  "itl_ms_p50", "itl_ms_p95", "tokens_per_s"):
+            assert sa[k] == sb[k], k
+        assert validate_file(pa) > 0 and validate_file(pb) > 0
+        assert regress_main([pa, pb]) == 0
+        capsys.readouterr()
+
+        # The injected-latency proof: 1.5x prefill cost must inflate
+        # TTFT past the 10% default tolerance and fail the gate,
+        # naming the violated metric+quantile.
+        _run(lg_engine, "bursty", pc, faults="prefill_delay=1.5")
+        assert regress_main([pa, pc]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "ttft_ms_p95" in out
+
+    def test_idle_gap_jump_survives_float_roundtrip(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        """Review finding: the ms->s->ms round trip can land the
+        jumped clock a hair SHORT of arrival_ms; re-testing the due
+        predicate then advanced by 0 forever. The idle branch must
+        submit the arrival it jumped to directly. (On the broken
+        code this livelocks, hence the watchdog thread.)"""
+        import threading
+
+        from tpu_hpc.loadgen import LoadRequest, Scenario, TenantClass
+
+        # 65261.45763366384 / 1e3 * 1e3 == 65261.457633663835 < it.
+        bad_ms = 65261.45763366384
+        assert bad_ms / 1e3 * 1e3 < bad_ms  # the adversarial float
+        sc = Scenario(
+            name="gap", seed=0,
+            tenants=(TenantClass("default"),),
+            requests=(
+                LoadRequest("g0", "default", 0, 0.0,
+                            (1, 2, 3), 2),
+                LoadRequest("g1", "default", 0, bad_ms,
+                            (4, 5), 2),
+            ),
+        )
+        harness = LoadHarness(
+            lg_engine, sc, metrics_path=str(tmp_path / "g.jsonl"),
+        )
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(harness.run()), daemon=True,
+        )
+        t.start()
+        t.join(timeout=60)
+        assert done, "harness livelocked on the idle-gap jump"
+        assert done[0]["requests"] == 2
+        assert len(harness.batcher.results["g1"]) == 2
+
+    def test_fault_env_var_reaches_harness(
+        self, lg_engine, scoped_obs, tmp_path, monkeypatch,
+    ):
+        """The TPU_HPC_LOADGEN_FAULTS env spelling (the CI fault
+        path) inflates the same quantiles the kwarg does."""
+        pa = str(tmp_path / "a.jsonl")
+        pb = str(tmp_path / "b.jsonl")
+        sa, _ = _run(lg_engine, "steady", pa)
+        monkeypatch.setenv("TPU_HPC_LOADGEN_FAULTS", "decode_delay=3")
+        harness = LoadHarness(
+            lg_engine, _scenario("steady"), metrics_path=pb,
+        )
+        sb = harness.run(n_devices=jax.device_count())
+        assert sb["itl_ms_p50"] == pytest.approx(3 * sa["itl_ms_p50"])
+
+    def test_regress_cli_subprocess(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        """The exact command CI runs: ``python -m tpu_hpc.obs.regress``
+        in a fresh interpreter (no jax backend needed to judge)."""
+        pa = str(tmp_path / "a.jsonl")
+        pb = str(tmp_path / "b.jsonl")
+        _run(lg_engine, "steady", pa)
+        _run(lg_engine, "steady", pb)
+        env = dict(os.environ)
+        prev = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = REPO + (os.pathsep + prev if prev else "")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_hpc.obs.regress", pa, pb,
+             "--json"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        verdict = json.loads(proc.stdout)
+        assert verdict["pass"] is True and verdict["checked"] > 0
+        assert verdict["schema_version"] == 1
+
+
+# ---------------------------------------------------------------------
+# admission control (acceptance): saturating burst -> schema-valid
+# shed events, lowest class only, report breakdown
+# ---------------------------------------------------------------------
+class TestAdmissionControl:
+    def test_saturating_burst_sheds_lowest_class_only(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        path = tmp_path / "burst.jsonl"
+        summary, harness = _run(
+            lg_engine, "saturating_burst", path, n=32
+        )
+        assert harness.batcher.stats["shed"] > 0
+        assert summary["shed"] == harness.batcher.stats["shed"]
+        # Only the lowest-priority class pays (queue_overflow sheds
+        # newest-of-lowest first and the burst keeps higher classes
+        # under the backlog bound).
+        assert summary["tenants"]["background"]["shed"] > 0
+        assert summary["tenants"]["interactive"]["shed"] == 0
+        # Higher classes queue rather than shed under the burst.
+        assert summary["tenants"]["interactive"]["queued"] > 0
+        # The whole file -- lifecycle, admission decisions, stalls,
+        # summary -- validates against the one schema.
+        assert validate_file(str(path)) > 0
+        records = load_records(str(path))
+        sheds = [
+            r for r in records
+            if r.get("event") == "admission" and r["action"] == "shed"
+        ]
+        assert len(sheds) == summary["shed"]
+        assert {s["tenant"] for s in sheds} == {"background"}
+        assert all(s["reason"] == "queue_overflow" for s in sheds)
+        queues = [
+            r for r in records
+            if r.get("event") == "admission" and r["action"] == "queue"
+        ]
+        assert queues and all(
+            q["occupancy"] == 1.0 and q["pending"] > 0 for q in queues
+        )
+        # lg_shed lifecycle records mirror the decisions.
+        lg_sheds = [r for r in records if r.get("event") == "lg_shed"]
+        assert len(lg_sheds) == summary["shed"]
+
+    def test_report_breakdown_attributes_shed_load(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        path = tmp_path / "burst.jsonl"
+        summary, _ = _run(lg_engine, "saturating_burst", path, n=32)
+        rep = build_report(load_records(str(path)))
+        lg = rep["loadgen"]
+        assert lg["scenario"] == "saturating_burst"
+        bg = lg["tenants"]["background"]
+        assert bg["shed"] == summary["tenants"]["background"]["shed"]
+        assert bg["arrivals"] == bg["admitted"] + bg["shed"]
+        assert lg["admission_decisions"]["shed"] == summary["shed"]
+        assert lg["tenants"]["interactive"]["queued"] > 0
+        # Per-tenant ITL rides from the summary (lg_token is
+        # ring-only, so events alone can't rebuild it) and lands in
+        # the gate's namespace alongside queued.
+        it = lg["tenants"]["interactive"]
+        assert it["itl_ms_p50"] == \
+            summary["tenants"]["interactive"]["itl_ms_p50"]
+        from tpu_hpc.obs.regress import report_metrics
+
+        flat = report_metrics(rep)
+        assert flat["loadgen.interactive.queued"] == it["queued"]
+        assert flat["loadgen.interactive.itl_ms_p95"] == \
+            it["itl_ms_p95"]
+        # and the human rendering names the classes
+        from tpu_hpc.obs.report import format_report
+
+        txt = format_report(rep)
+        assert "Load generator" in txt and "background" in txt
+        assert "admission decisions" in txt
+
+    def test_prefill_admission_does_not_trip_the_watermark(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        """Review finding: an admission tick is EXPECTED to be long
+        (one big-bucket prefill costs many decode-ticks of modeled
+        time); it must not read as a stall and mass-shed tenants.
+        With prefill costing 20x a decode tick and no colocation,
+        zero stall events and zero stall-sheds."""
+        path = tmp_path / "pf.jsonl"
+        harness = LoadHarness(
+            lg_engine, _scenario("multi_tenant", seed=2, n=32),
+            metrics_path=str(path),
+            prefill_ms_per_token=10.0,  # bucket 16 -> 160ms vs 8ms
+        )
+        summary = harness.run(n_devices=jax.device_count())
+        assert summary["stall_events"] == 0
+        records = load_records(str(path))
+        assert not any(
+            r.get("event") == "admission"
+            and r.get("reason") == "stall_watermark"
+            for r in records
+        )
+
+    def test_stall_watermark_sheds_background_protects_online(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        path = tmp_path / "colo.jsonl"
+        summary, _ = _run(lg_engine, "colocate", path, seed=3)
+        # The colocated train step trips the watermark...
+        assert summary["stall_events"] > 0
+        records = load_records(str(path))
+        assert any(r.get("event") == "stall" for r in records)
+        assert any(
+            r.get("event") == "span"
+            and r["name"] == "colocated_train_step"
+            for r in records
+        )
+        # ...and any stall-shedding hits only the background class.
+        stall_sheds = [
+            r for r in records
+            if r.get("event") == "admission"
+            and r.get("reason") == "stall_watermark"
+        ]
+        assert all(s["tenant"] == "background" for s in stall_sheds)
+        assert summary["tenants"]["online"]["shed"] == 0
+
+    def test_overflow_accounts_for_free_slots(
+        self, lg_engine, scoped_obs,
+    ):
+        """Review finding: with occupancy_high < 1 a tick can be
+        'saturated' while slots are free; pending the admit loop will
+        seat this tick must not be shed as overflow."""
+        from tpu_hpc.serve import (
+            AdmissionPolicy,
+            ContinuousBatcher,
+            Request,
+        )
+
+        batcher = ContinuousBatcher(
+            lg_engine,
+            policy=AdmissionPolicy(
+                queue_limit=0, occupancy_high=0.25
+            ),
+        )
+        # One long-running request occupies 1 of 4 slots ->
+        # occupancy 0.25 == occupancy_high: "saturated".
+        batcher.submit(Request(rid="long", prompt=[1, 2, 3],
+                               max_new_tokens=8))
+        batcher.step()
+        assert batcher.active == 1
+        # Three more: exactly the three free slots. queue_limit=0,
+        # but nothing actually queues -- nothing may shed.
+        for i in range(3):
+            batcher.submit(Request(rid=f"s{i}", prompt=[4 + i],
+                                   max_new_tokens=2))
+        batcher.step()
+        # All three were seated (and, at max_new=2, finished within
+        # the step) -- none shed.
+        assert batcher.stats["shed"] == 0
+        assert batcher.stats["admitted"] == 4
+        assert all(f"s{i}" in batcher.results for i in range(3))
+        batcher.run()  # drain
+
+    def test_same_tick_admissions_not_counted_queued(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        """Review finding: two same-tick admissions must both count
+        as un-queued even though the first slot's prefill charge
+        advances the shared clock before the second's t_admit."""
+        from tpu_hpc.loadgen import LoadRequest, Scenario, TenantClass
+
+        sc = Scenario(
+            name="twin", seed=0, tenants=(TenantClass("default"),),
+            requests=(
+                LoadRequest("t0", "default", 0, 0.0, (1, 2, 3), 2),
+                LoadRequest("t1", "default", 0, 0.0, (4, 5, 6), 2),
+            ),
+        )
+        path = tmp_path / "twin.jsonl"
+        harness = LoadHarness(lg_engine, sc, metrics_path=str(path))
+        summary = harness.run()
+        assert summary["queued"] == 0
+        admits = [
+            r for r in load_records(str(path))
+            if r.get("event") == "lg_admit"
+        ]
+        assert len(admits) == 2
+        assert all(a["queued"] is False for a in admits)
+        # ...and the report's breakdown agrees with the flag.
+        rep = build_report(load_records(str(path)))
+        assert rep["loadgen"]["tenants"]["default"]["queued"] == 0
+
+    def test_occupancy_gauge_tracks_live_slots_every_step(
+        self, lg_engine, scoped_obs,
+    ):
+        """Satellite pin: serve_active_slots == live slot count at
+        EVERY decode step (admit and evict paths both update it), and
+        0 after shutdown."""
+        from tpu_hpc.serve import ContinuousBatcher, Request
+
+        bus, reg = scoped_obs
+
+        class GaugeCheckingEngine:
+            def __init__(self, engine, batcher_ref):
+                self._e = engine
+                self._b = batcher_ref
+
+            @property
+            def serve_cfg(self):
+                return self._e.serve_cfg
+
+            def prefill(self, idx, prompt):
+                return self._e.prefill(idx, prompt)
+
+            def decode(self, tokens, positions):
+                # At decode time every admission already updated the
+                # gauge: it must equal the live slot count NOW, not
+                # the count after the previous step.
+                assert reg.gauge("serve_active_slots") == \
+                    self._b[0].active
+                return self._e.decode(tokens, positions)
+
+        ref = [None]
+        proxy = GaugeCheckingEngine(lg_engine, ref)
+        batcher = ContinuousBatcher(proxy)
+        ref[0] = batcher
+        assert reg.gauge("serve_active_slots") == 0  # armed at init
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        reqs = [
+            Request(
+                rid=f"g{i}",
+                prompt=rng.integers(
+                    0, TINY.vocab_size, size=3 + i % 9
+                ).tolist(),
+                max_new_tokens=1 + i % 4,
+            )
+            for i in range(7)  # 7 requests through 4 slots: churn
+        ]
+        batcher.run(reqs)
+        assert batcher.stats["decode_steps"] > 0
+        assert reg.gauge("serve_active_slots") == 0  # shutdown
+
+
+# ---------------------------------------------------------------------
+# the serve_summary ride-along: obs.report machinery for free
+# ---------------------------------------------------------------------
+class TestSummaryRideAlong:
+    def test_report_serve_section_reads_load_run(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        path = tmp_path / "mt.jsonl"
+        summary, _ = _run(lg_engine, "multi_tenant", path)
+        rep = build_report(load_records(str(path)))
+        s = rep["serve"]
+        assert s["ttft_ms_p95"] == summary["ttft_ms_p95"]
+        assert s["ttft_ms_p99"] == summary["ttft_ms_p99"]
+        assert s["tokens_per_s"] == summary["tokens_per_s"]
+        lg = rep["loadgen"]
+        assert lg["occupancy_mean"] == summary["occupancy_mean"]
+        assert lg["stall_events"] == summary["stall_events"]
+
+    def test_per_token_events_ride_the_flight_ring(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        """lg_token is ring-only by design: cadence forensics without
+        sink volume."""
+        bus, _ = scoped_obs
+        path = tmp_path / "st.jsonl"
+        _run(lg_engine, "steady", path, n=8)
+        assert any(
+            r["event"] == "lg_token" for r in bus.ring()
+        )
+        on_disk = load_records(str(path))
+        assert not any(r["event"] == "lg_token" for r in on_disk)
+
+    def test_slo_verdicts_in_summary(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        path = tmp_path / "mt.jsonl"
+        summary, _ = _run(lg_engine, "multi_tenant", path)
+        t = summary["tenants"]["interactive"]
+        assert t["slo"] == {"ttft_ms_p95": 400.0, "itl_ms_p95": 60.0}
+        assert isinstance(t["slo_violated"], list)
+        assert isinstance(summary["slo_violations"], list)
+
+
+# ---------------------------------------------------------------------
+# server CLI: --loadgen mode
+# ---------------------------------------------------------------------
+class TestServerLoadgenCLI:
+    def test_main_runs_scenario_and_prints_summary(self, capsys):
+        from tpu_hpc.serve import server
+
+        rc = server.main([
+            "--loadgen", "saturating_burst", "--requests", "24",
+            "--max-new", "4", "--slots", "2", "--buckets", "8",
+            "--vocab", "64",
+        ])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.splitlines()[-1])
+        assert summary["scenario"] == "saturating_burst"
+        assert summary["recompiles"] == 0
+        assert summary["virtual_clock"] is True
+        assert summary["shed"] + summary["admitted"] == 24
+        assert "interactive" in summary["tenants"]
+
+    def test_main_rejects_unknown_scenario(self):
+        from tpu_hpc.serve import server
+
+        with pytest.raises(SystemExit):
+            server.main(["--loadgen", "nope"])
+
+    def test_main_rejects_degenerate_generate_budget(self, capsys):
+        """Review finding: a cache that leaves < 2 generate tokens
+        after the largest bucket must be an argparse error, not a
+        post-bring-up ValueError traceback."""
+        from tpu_hpc.serve import server
+
+        with pytest.raises(SystemExit):
+            server.main([
+                "--loadgen", "steady", "--buckets", "8,16",
+                "--max-seq-len", "17",
+            ])
+        assert "generate tokens" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# long mixes (full-suite tier only)
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+class TestLongMixes:
+    def test_heavy_tail_long_mix_regress_clean(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        pa = str(tmp_path / "a.jsonl")
+        pb = str(tmp_path / "b.jsonl")
+        _run(lg_engine, "heavy_tail", pa, seed=11, n=200)
+        _run(lg_engine, "heavy_tail", pb, seed=11, n=200)
+        assert regress_main([pa, pb]) == 0
+
+    def test_bursty_long_mix_deterministic_summary(
+        self, lg_engine, scoped_obs, tmp_path,
+    ):
+        sa, _ = _run(
+            lg_engine, "bursty", tmp_path / "a.jsonl", seed=13, n=200
+        )
+        sb, _ = _run(
+            lg_engine, "bursty", tmp_path / "b.jsonl", seed=13, n=200
+        )
+        assert sa["ttft_ms_p99"] == sb["ttft_ms_p99"]
+        assert sa["decode_steps"] == sb["decode_steps"]

@@ -214,6 +214,96 @@ class TestRegistry:
 
 
 # ---------------------------------------------------------------------
+# quantiles.py: the estimator the regress gate trusts
+# ---------------------------------------------------------------------
+class TestQuantileMath:
+    """The windowed-histogram quantiles feed the regression gate; they
+    are pinned EXACTLY (not approximately) to numpy's default
+    percentile estimator on known distributions."""
+
+    @pytest.mark.parametrize("dist", ["uniform", "lognormal", "normal"])
+    @pytest.mark.parametrize("q", [0.0, 0.5, 0.95, 0.99, 1.0])
+    def test_matches_numpy_percentile(self, dist, q):
+        import numpy as np
+
+        from tpu_hpc.obs.quantiles import quantile
+
+        rng = np.random.default_rng(42)
+        vals = {
+            "uniform": rng.uniform(0, 100, size=1001),
+            "lognormal": rng.lognormal(2.0, 1.0, size=997),
+            "normal": rng.normal(50, 10, size=256),
+        }[dist]
+        got = quantile(sorted(vals.tolist()), q)
+        want = float(np.percentile(vals, 100 * q))
+        assert got == pytest.approx(want, rel=1e-12), (dist, q)
+
+    def test_edge_cases(self):
+        from tpu_hpc.obs.quantiles import quantile
+
+        assert quantile([], 0.5) == 0.0
+        assert quantile([3.0], 0.0) == 3.0
+        assert quantile([3.0], 0.99) == 3.0
+        assert quantile([1.0, 2.0], 0.5) == 1.5
+        with pytest.raises(ValueError, match="must be in"):
+            quantile([1.0], 1.5)
+
+    def test_summarize_keys(self):
+        from tpu_hpc.obs.quantiles import summarize
+
+        s = summarize([5.0, 1.0, 3.0])
+        assert set(s) == {"p50", "p95", "p99"}
+        assert s["p50"] == 3.0
+
+    def test_registry_histogram_matches_numpy_on_window(
+        self, registry,
+    ):
+        """The registry's summary quantiles are over the most recent
+        window only -- and on that window they ARE numpy's
+        percentiles."""
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        vals = rng.lognormal(1.0, 0.8, size=10).tolist()
+        for v in vals:
+            registry.observe("lat", v)
+        window = vals[-4:]  # registry fixture: hist_window=4
+        s = registry.histogram_summary("lat")
+        assert s["count"] == 4
+        for key, q in (("p50", 50), ("p95", 95), ("p99", 99)):
+            assert s[key] == pytest.approx(
+                float(np.percentile(window, q)), rel=1e-12
+            ), key
+
+    def test_serve_meter_quantiles_match_numpy(self):
+        """ServeMeter's TTFT quantiles come from the same estimator
+        (the gate compares meter numbers against meter numbers)."""
+        import numpy as np
+
+        from tpu_hpc.serve.metrics import ServeMeter
+
+        t = [0.0]
+        meter = ServeMeter(clock=lambda: t[0])
+        rng = np.random.default_rng(3)
+        ttfts = rng.uniform(0.01, 0.2, size=25)
+        for i, ttft in enumerate(ttfts):
+            rid = f"r{i}"
+            t[0] = float(i)
+            meter.submitted(rid)
+            meter.admitted(rid)
+            t[0] = float(i) + float(ttft)
+            meter.token(rid, first=True)
+            meter.finished(rid)
+        s = meter.summary()
+        assert s["ttft_ms_p95"] == pytest.approx(
+            1e3 * float(np.percentile(ttfts, 95)), rel=1e-9
+        )
+        assert s["ttft_ms_p99"] == pytest.approx(
+            1e3 * float(np.percentile(ttfts, 99)), rel=1e-9
+        )
+
+
+# ---------------------------------------------------------------------
 # stall.py
 # ---------------------------------------------------------------------
 class TestStallDetector:
@@ -415,6 +505,24 @@ class TestReport:
         assert rep["goodput"]["combined"]["productive_s"] == 17.0
         assert report_main([str(p)]) == 0
         assert "Step-time breakdown" in capsys.readouterr().out
+
+    def test_json_contract_pinned(self, tmp_path, capsys):
+        """The driver contract obs/regress.py and CI consume: the
+        JSON report carries schema_version; exit codes are 0 (report
+        produced) / 2 (empty or invalid input) -- nothing else."""
+        p = tmp_path / "run.jsonl"
+        p.write_text(
+            "\n".join(json.dumps(r) for r in _training_records())
+        )
+        assert report_main([str(p), "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["schema_version"] == SCHEMA_VERSION
+        # build_report (the --json payload) and the records agree on
+        # the schema generation -- one constant, two consumers.
+        from tpu_hpc.obs.report import build_report
+
+        assert build_report(_training_records())["schema_version"] \
+            == SCHEMA_VERSION
 
     def test_cli_rejects_invalid_and_missing(self, tmp_path, capsys):
         bad = tmp_path / "bad.jsonl"

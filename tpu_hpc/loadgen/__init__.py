@@ -1,0 +1,37 @@
+"""tpu_hpc.loadgen -- the SLO-driven load harness.
+
+Seeded, scenario-diverse traffic for the serve engine (scenarios.py)
+driven on a virtual clock so latency quantiles replay bit-identically
+(harness.py), every request lifecycle emitted as schema-stamped
+``obs`` records. The producer side of the perf-regression gate:
+``python -m tpu_hpc.obs.regress`` consumes the JSONL these runs write.
+"""
+from tpu_hpc.loadgen.harness import (  # noqa: F401
+    ENV_FAULTS,
+    LoadHarness,
+    LoadMeter,
+    VirtualClock,
+    parse_faults,
+)
+from tpu_hpc.loadgen.scenarios import (  # noqa: F401
+    SCENARIOS,
+    SLO_METRICS,
+    LoadRequest,
+    Scenario,
+    TenantClass,
+    build_scenario,
+)
+
+__all__ = [
+    "ENV_FAULTS",
+    "LoadHarness",
+    "LoadMeter",
+    "LoadRequest",
+    "SCENARIOS",
+    "SLO_METRICS",
+    "Scenario",
+    "TenantClass",
+    "VirtualClock",
+    "build_scenario",
+    "parse_faults",
+]

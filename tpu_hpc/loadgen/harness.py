@@ -1,0 +1,416 @@
+"""The load harness: drive the serve engine with a seeded scenario,
+emit every request's lifecycle as schema-stamped ``obs`` records.
+
+Timing runs on a **virtual clock**: each engine call charges a modeled
+cost (``decode_step_ms`` per decode tick, ``prefill_ms_per_token`` x
+padded bucket per admission, plus the scenario's colocated-train
+steals), so a seeded run's TTFT/ITL/goodput quantiles are a pure
+function of (scenario, seed, engine shape) -- bit-identical on replay,
+which is what lets obs/regress.py treat ANY diff as signal. The engine
+calls themselves are real (real prefill/decode programs, real tokens);
+only the clock is modeled. Wall-clock serving throughput remains
+`python -m tpu_hpc.serve` / `bench.py --serve`'s job -- this harness
+measures *scheduling behavior* (queueing, admission, tenant isolation)
+that machine noise would otherwise drown.
+
+Fault injection (``TPU_HPC_LOADGEN_FAULTS``, the TPU_HPC_FAULTS
+spelling): ``prefill_delay=1.5`` / ``decode_delay=2.0`` multiply the
+modeled costs -- the injected-latency path the regress gate's CI smoke
+proves itself against.
+
+Lifecycle events (obs/schema.py): ``load_scenario`` header, then per
+request ``lg_arrival`` -> ``lg_admit`` -> ``lg_first_token`` ->
+``lg_token`` (ring-only: per-token cadence is flight-recorder
+forensics, not sink volume) -> ``lg_finish``, or ``lg_shed`` when
+admission control drops it; the scheduler's own ``admission`` events
+land in the same sink. The ServeMeter rides along on the virtual
+clock, so ``serve_summary`` -- and through it the obs.report quantile
+machinery -- works on load runs for free.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional
+
+from tpu_hpc.obs import StallDetector, emit_span, get_bus, get_registry
+from tpu_hpc.obs.quantiles import quantile
+from tpu_hpc.serve.metrics import ServeMeter
+from tpu_hpc.serve.scheduler import AdmissionPolicy, ContinuousBatcher
+from tpu_hpc.loadgen.scenarios import Scenario
+
+ENV_FAULTS = "TPU_HPC_LOADGEN_FAULTS"
+
+
+def parse_faults(spec: Optional[str] = None) -> Dict[str, float]:
+    """``"prefill_delay=1.5,decode_delay=2"`` -> multipliers dict.
+    Unknown keys raise: a typoed fault silently injecting nothing
+    would make the gate's failure proof vacuous."""
+    if spec is None:
+        spec = os.environ.get(ENV_FAULTS, "")
+    out = {"prefill_delay": 1.0, "decode_delay": 1.0}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        key, _, val = part.partition("=")
+        if key not in out:
+            raise ValueError(
+                f"unknown loadgen fault {key!r} "
+                f"(known: {', '.join(sorted(out))})"
+            )
+        out[key] = float(val)
+        if out[key] <= 0:
+            raise ValueError(f"fault {key}={val}: must be > 0")
+    return out
+
+
+class VirtualClock:
+    """Monotonic seconds, advanced explicitly. Calling it returns the
+    current time, so it drops in wherever ``time.perf_counter``
+    goes."""
+
+    def __init__(self, t0: float = 0.0):
+        self._t = float(t0)
+
+    def __call__(self) -> float:
+        return self._t
+
+    def advance(self, dt_s: float) -> None:
+        if dt_s < 0:
+            raise ValueError(f"cannot advance clock by {dt_s}")
+        self._t += dt_s
+
+
+class _CostModelEngine:
+    """Engine proxy: runs the real programs, charges modeled virtual
+    time for each. Placed between batcher and engine so the meter's
+    timestamps (taken inside the batcher, after each engine call
+    returns) see prefill/decode costs without the batcher knowing
+    about clocks."""
+
+    def __init__(
+        self,
+        engine,
+        clock: VirtualClock,
+        decode_step_ms: float,
+        prefill_ms_per_token: float,
+        faults: Dict[str, float],
+    ):
+        self._engine = engine
+        self._clock = clock
+        self._decode_s = decode_step_ms / 1e3 * faults["decode_delay"]
+        self._prefill_s_per_token = (
+            prefill_ms_per_token / 1e3 * faults["prefill_delay"]
+        )
+        # Cumulative prefill charge: the harness subtracts its
+        # per-tick delta before feeding the stall detector -- an
+        # admission tick is EXPECTED to be long (one 512-token bucket
+        # costs ~16 decode ticks of modeled time), and letting it
+        # trip the watermark would shed tenants on ordinary prefill
+        # scheduling, not on stalls (review finding).
+        self.prefill_charged_s = 0.0
+
+    @property
+    def serve_cfg(self):
+        return self._engine.serve_cfg
+
+    def prefill(self, idx: int, prompt: List[int]) -> int:
+        out = self._engine.prefill(idx, prompt)
+        bucket = self._engine.serve_cfg.bucket_for(len(prompt))
+        cost = self._prefill_s_per_token * bucket
+        self.prefill_charged_s += cost
+        self._clock.advance(cost)
+        return out
+
+    def decode(self, tokens, positions):
+        out = self._engine.decode(tokens, positions)
+        self._clock.advance(self._decode_s)
+        return out
+
+
+class LoadMeter(ServeMeter):
+    """ServeMeter + the lg_* lifecycle events and per-tenant
+    aggregation. ``tenant_of[rid]`` is filled by the harness at
+    submission time."""
+
+    def __init__(
+        self,
+        metrics_path: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        super().__init__(metrics_path=metrics_path, clock=clock)
+        self.tenant_of: Dict[str, str] = {}
+        self.ttft_ms: Dict[str, List[float]] = {}   # per tenant
+        self.itl_ms: Dict[str, List[float]] = {}
+        self.finished_by: Dict[str, int] = {}
+        self.queued_by: Dict[str, int] = {}         # waited >= 1 tick
+        self.shed_by: Dict[str, int] = {}
+        # Set by the harness before each batcher tick: "queued" means
+        # submitted BEFORE the tick that admitted it. queue_ms alone
+        # cannot tell (an earlier slot's prefill charge advances the
+        # shared clock between two same-tick admissions -- review
+        # finding).
+        self.tick_start_s = 0.0
+
+    def _tenant(self, rid: str) -> str:
+        return self.tenant_of.get(rid, "default")
+
+    def admitted(self, rid: str, prefill_tokens: int = 0) -> None:
+        super().admitted(rid, prefill_tokens=prefill_tokens)
+        trace = self.traces[rid]
+        queue_ms = 1e3 * (trace.t_admit - trace.t_submit)
+        tenant = self._tenant(rid)
+        queued = trace.t_submit < self.tick_start_s
+        if queued:
+            self.queued_by[tenant] = self.queued_by.get(tenant, 0) + 1
+        get_bus().emit(
+            "lg_admit", sink=self.metrics_path,
+            rid=rid, tenant=tenant, queue_ms=queue_ms,
+            prefill_tokens=prefill_tokens, queued=queued,
+        )
+
+    def token(self, rid: str, first: bool = False) -> None:
+        super().token(rid, first=first)
+        trace = self.traces[rid]
+        tenant = self._tenant(rid)
+        if first:
+            ttft_ms = 1e3 * (trace.t_first - trace.t_submit)
+            self.ttft_ms.setdefault(tenant, []).append(ttft_ms)
+            get_bus().emit(
+                "lg_first_token", sink=self.metrics_path,
+                rid=rid, tenant=tenant, ttft_ms=ttft_ms,
+            )
+        else:
+            itl = 1e3 * (trace.token_times[-1] - trace.token_times[-2])
+            self.itl_ms.setdefault(tenant, []).append(itl)
+            # Ring-only (no sink): per-token cadence at decode rate is
+            # flight-recorder forensics, not per-run sink volume.
+            get_bus().emit("lg_token", rid=rid, itl_ms=itl)
+
+    def finished(self, rid: str) -> None:
+        trace = self.traces[rid]
+        tenant = self._tenant(rid)
+        super().finished(rid)
+        self.finished_by[tenant] = self.finished_by.get(tenant, 0) + 1
+        get_bus().emit(
+            "lg_finish", sink=self.metrics_path,
+            rid=rid, tenant=tenant, tokens=len(trace.token_times),
+            total_ms=1e3 * (trace.t_done - trace.t_submit),
+        )
+
+    def request_shed(self, rid: str, reason: str = "") -> None:
+        tenant = self._tenant(rid)
+        super().request_shed(rid, reason=reason)
+        self.shed_by[tenant] = self.shed_by.get(tenant, 0) + 1
+        get_bus().emit(
+            "lg_shed", sink=self.metrics_path,
+            rid=rid, tenant=tenant, reason=reason,
+        )
+
+
+class LoadHarness:
+    """One scenario end to end: submit arrivals on schedule, tick the
+    batcher, watch the stall watermark, aggregate per-tenant SLOs."""
+
+    def __init__(
+        self,
+        engine,
+        scenario: Scenario,
+        metrics_path: Optional[str] = None,
+        decode_step_ms: float = 8.0,
+        prefill_ms_per_token: float = 0.25,
+        policy: Optional[AdmissionPolicy] = None,
+        stall_factor: float = 3.0,
+        faults: Optional[Dict[str, float]] = None,
+    ):
+        self.scenario = scenario
+        self.metrics_path = metrics_path
+        self.clock = VirtualClock()
+        self.engine = _CostModelEngine(
+            engine, self.clock, decode_step_ms, prefill_ms_per_token,
+            faults if faults is not None else parse_faults(),
+        )
+        self.meter = LoadMeter(metrics_path=metrics_path,
+                               clock=self.clock)
+        self.detector = StallDetector(
+            window=16, factor=stall_factor, min_samples=5,
+        )
+        self._stalled = False
+        self.batcher = ContinuousBatcher(
+            self.engine,
+            meter=self.meter,
+            policy=policy or AdmissionPolicy(
+                queue_limit=scenario.queue_limit
+            ),
+            stall_signal=lambda: self._stalled,
+        )
+        self._occupancy: List[float] = []
+
+    # -- the drive loop -----------------------------------------------
+    def run(
+        self,
+        n_devices: int = 1,
+        n_params: Optional[int] = None,
+        peak_flops_per_device: Optional[float] = None,
+        max_ticks: Optional[int] = None,
+        tick_cb=None,
+        extra: Optional[dict] = None,
+    ) -> dict:
+        """drive() then summarize() -- the one-call convenience."""
+        self.drive(max_ticks=max_ticks, tick_cb=tick_cb)
+        return self.summarize(
+            n_devices=n_devices, n_params=n_params,
+            peak_flops_per_device=peak_flops_per_device, extra=extra,
+        )
+
+    def _submit_arrival(self, lr) -> None:
+        self.meter.tenant_of[lr.rid] = lr.tenant
+        get_bus().emit(
+            "lg_arrival", sink=self.metrics_path,
+            rid=lr.rid, tenant=lr.tenant,
+            arrival_ms=lr.arrival_ms,
+            prompt_len=len(lr.prompt),
+            max_new_tokens=lr.max_new_tokens,
+            priority=lr.priority,
+        )
+        self.batcher.submit(lr.to_request())
+
+    def drive(
+        self, max_ticks: Optional[int] = None, tick_cb=None,
+    ) -> None:
+        sc = self.scenario
+        bus = get_bus()
+        bus.emit("load_scenario", sink=self.metrics_path, **sc.header())
+        arrivals = list(sc.requests)  # already arrival-sorted
+        i = 0
+        tick = 0
+        budget = max_ticks if max_ticks is not None else (
+            sum(r.max_new_tokens + 1 for r in arrivals)
+            + len(arrivals) + 16
+        )
+        while i < len(arrivals) or not self.batcher.done:
+            # A request is "queued" iff it was submitted before this
+            # iteration began -- stamp the boundary BEFORE this
+            # tick's submissions (and before any colocation advance,
+            # which would otherwise age same-tick arrivals).
+            self.meter.tick_start_s = self.clock()
+            now_ms = self.clock() * 1e3
+            while i < len(arrivals) and arrivals[i].arrival_ms <= now_ms:
+                self._submit_arrival(arrivals[i])
+                i += 1
+            if self.batcher.done:
+                # Idle: jump the virtual clock to the next arrival
+                # instead of spinning empty decode ticks -- and
+                # submit it DIRECTLY: the ms->s->ms float round trip
+                # can land the clock a hair short of arrival_ms, and
+                # re-testing the due-predicate on that value would
+                # advance(0) forever (review finding: a reproducible
+                # livelock on ~0.7% of uniform arrival times).
+                lr = arrivals[i]
+                self.clock.advance(
+                    max(lr.arrival_ms / 1e3 - self.clock(), 0.0)
+                )
+                self._submit_arrival(lr)
+                i += 1
+                continue
+            if tick >= budget:
+                raise RuntimeError(
+                    f"load harness did not drain within {budget} ticks"
+                )
+            t_before = self.clock()
+            if (
+                sc.colocate_every > 0
+                and tick % sc.colocate_every == 0
+            ):
+                # The colocated training job steals the chip for one
+                # step; span events make the theft attributable in the
+                # report's phase table. emit_span with the VIRTUAL
+                # duration (a wall-clock span here would leak machine
+                # noise into an otherwise deterministic run).
+                self.clock.advance(sc.colocate_train_ms / 1e3)
+                emit_span(
+                    "colocated_train_step",
+                    sc.colocate_train_ms / 1e3,
+                    sink=self.metrics_path, step=tick,
+                )
+            prefill_before = self.engine.prefill_charged_s
+            self.batcher.step()
+            # The watermark watches decode cadence + colocation
+            # steals; this tick's prefill admission charges are
+            # excluded (expected work, not a stall -- see
+            # _CostModelEngine.prefill_charged_s).
+            tick_s = (
+                self.clock() - t_before
+                - (self.engine.prefill_charged_s - prefill_before)
+            )
+            info = self.detector.observe(
+                tick, tick_s, sink=self.metrics_path
+            )
+            self._stalled = info is not None
+            self._occupancy.append(self.batcher.occupancy)
+            if tick_cb is not None:
+                tick_cb(tick)
+            tick += 1
+
+    # -- aggregation ---------------------------------------------------
+    def summarize(
+        self,
+        n_devices: int = 1,
+        n_params: Optional[int] = None,
+        peak_flops_per_device: Optional[float] = None,
+        extra: Optional[dict] = None,
+    ) -> dict:
+        summary = self.meter.summary(
+            n_devices=n_devices, n_params=n_params,
+            peak_flops_per_device=peak_flops_per_device,
+        )
+        m = self.meter
+        tenants = {}
+        slo_violations: List[str] = []
+        for t in self.scenario.tenants:
+            ttfts = sorted(m.ttft_ms.get(t.name, []))
+            itls = sorted(m.itl_ms.get(t.name, []))
+            entry = {
+                "priority": t.priority,
+                "finished": m.finished_by.get(t.name, 0),
+                "shed": m.shed_by.get(t.name, 0),
+                "queued": m.queued_by.get(t.name, 0),
+                "ttft_ms_p50": quantile(ttfts, 0.50),
+                "ttft_ms_p95": quantile(ttfts, 0.95),
+                "ttft_ms_p99": quantile(ttfts, 0.99),
+                "itl_ms_p50": quantile(itls, 0.50),
+                "itl_ms_p95": quantile(itls, 0.95),
+            }
+            if t.slo:
+                # entry[k], not .get(): TenantClass validated the SLO
+                # keys against SLO_METRICS, and a drift between that
+                # set and what summarize produces must crash, not
+                # silently never-violate.
+                violated = sorted(
+                    k for k, bound in t.slo.items()
+                    if entry[k] > bound
+                )
+                entry["slo"] = dict(t.slo)
+                entry["slo_violated"] = violated
+                slo_violations += [f"{t.name}.{k}" for k in violated]
+            tenants[t.name] = entry
+        occ = sorted(self._occupancy)
+        summary.update(
+            scenario=self.scenario.name,
+            seed=self.scenario.seed,
+            n_arrivals=len(self.scenario.requests),
+            tenants=tenants,
+            shed=self.batcher.stats["shed"],
+            queued=sum(m.queued_by.values()),
+            slo_violations=slo_violations,
+            occupancy_mean=(
+                sum(occ) / len(occ) if occ else 0.0
+            ),
+            occupancy_p95=quantile(occ, 0.95),
+            stall_events=self.detector.stalls,
+            decode_steps=self.batcher.stats["decode_steps"],
+            admitted=self.batcher.stats["admitted"],
+            virtual_clock=True,
+        )
+        if extra:
+            summary.update(extra)
+        self.meter.write_summary(summary)
+        get_registry().emit_snapshot(sink=self.metrics_path)
+        return summary

@@ -1,0 +1,375 @@
+"""Seeded scenario catalog: reproducible serving traffic mixes.
+
+Every scenario is generated from ONE ``numpy.random.default_rng(seed)``
+stream, so the same (name, seed, knobs) always yields byte-identical
+request lists -- the reproducibility the regress gate
+(obs/regress.py) needs to call two runs of the same scenario
+"the same workload". The catalog covers the traffic shapes the
+DDP/FSDP characterization study (arxiv 2505.12832) argues systems must
+be judged under -- measured distributions, not the single steady
+replay `python -m tpu_hpc.serve` ships:
+
+* ``steady``            Poisson arrivals, near-uniform lengths;
+* ``bursty``            on/off bursts (B requests at burst rate, then
+                        silence) -- queue-depth stress;
+* ``heavy_tail``        lognormal prompt/output lengths clipped to the
+                        engine's buckets -- slot-occupancy skew;
+* ``multi_tenant``      three tenant classes (interactive/batch/
+                        background) with priorities and per-tenant
+                        SLOs;
+* ``saturating_burst``  everything arrives at once, far past slot
+                        capacity -- the admission-control acceptance
+                        scenario (the lowest class MUST shed);
+* ``colocate``          steady serving while a colocated training job
+                        periodically steals the chip -- the stall
+                        watermark's admission input.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from tpu_hpc.serve.scheduler import Request
+
+
+# The per-tenant summary metrics an SLO may bound (what
+# LoadHarness.summarize actually produces per tenant).
+SLO_METRICS: Tuple[str, ...] = (
+    "ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
+    "itl_ms_p50", "itl_ms_p95",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantClass:
+    """One traffic class: who it is, how much it sends, what it is
+    owed. ``slo`` maps per-tenant summary metric names (the
+    :data:`SLO_METRICS` set) to upper bounds in ms."""
+
+    name: str
+    priority: int = 0
+    share: float = 1.0
+    slo: Mapping[str, float] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        # Same discipline as parse_faults: a typoed SLO key that is
+        # silently never violated would make every gate built on its
+        # verdict vacuous.
+        unknown = sorted(set(self.slo) - set(SLO_METRICS))
+        if unknown:
+            raise ValueError(
+                f"tenant {self.name!r}: unknown SLO metric(s) "
+                f"{unknown} (known: {', '.join(SLO_METRICS)})"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadRequest:
+    """One scheduled arrival: a serve Request plus its arrival time
+    (ms on the harness clock)."""
+
+    rid: str
+    tenant: str
+    priority: int
+    arrival_ms: float
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+
+    def to_request(self) -> Request:
+        return Request(
+            rid=self.rid,
+            prompt=list(self.prompt),
+            max_new_tokens=self.max_new_tokens,
+            tenant=self.tenant,
+            priority=self.priority,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """A fully materialized load scenario: the request schedule plus
+    the policy/colocation knobs the harness consumes."""
+
+    name: str
+    seed: int
+    tenants: Tuple[TenantClass, ...]
+    requests: Tuple[LoadRequest, ...]
+    # Admission backlog bound handed to serve.AdmissionPolicy.
+    queue_limit: int = 32
+    # Train+serve colocation: every `colocate_every` ticks the
+    # harness charges `colocate_train_ms` of virtual time to a
+    # colocated training step (0 = no colocation).
+    colocate_train_ms: float = 0.0
+    colocate_every: int = 0
+
+    def tenant(self, name: str) -> TenantClass:
+        for t in self.tenants:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def header(self) -> dict:
+        """The ``load_scenario`` record the harness emits first."""
+        return {
+            "scenario": self.name,
+            "seed": self.seed,
+            "n_requests": len(self.requests),
+            "queue_limit": self.queue_limit,
+            "colocate_train_ms": self.colocate_train_ms,
+            "colocate_every": self.colocate_every,
+            "tenants": {
+                t.name: {
+                    "priority": t.priority,
+                    "share": t.share,
+                    "slo": dict(t.slo),
+                }
+                for t in self.tenants
+            },
+        }
+
+
+# -- building blocks ---------------------------------------------------
+def poisson_arrivals(
+    rng: np.random.Generator, n: int, rate_per_s: float,
+) -> np.ndarray:
+    """Arrival times (ms) of a Poisson process: cumulative exponential
+    inter-arrival gaps."""
+    if rate_per_s <= 0:
+        raise ValueError(f"rate_per_s {rate_per_s} must be > 0")
+    gaps_s = rng.exponential(1.0 / rate_per_s, size=n)
+    return np.cumsum(gaps_s) * 1e3
+
+
+def onoff_arrivals(
+    rng: np.random.Generator,
+    n: int,
+    burst_size: int,
+    burst_rate_per_s: float,
+    off_ms: float,
+) -> np.ndarray:
+    """On/off bursts: ``burst_size`` Poisson arrivals at the burst
+    rate, then ``off_ms`` of silence, repeated."""
+    if burst_size < 1:
+        raise ValueError(f"burst_size {burst_size} must be >= 1")
+    if burst_rate_per_s <= 0:
+        raise ValueError(
+            f"burst_rate_per_s {burst_rate_per_s} must be > 0"
+        )
+    if off_ms < 0:
+        raise ValueError(f"off_ms {off_ms} must be >= 0")
+    out: List[float] = []
+    t = 0.0
+    while len(out) < n:
+        take = min(burst_size, n - len(out))
+        gaps = rng.exponential(1.0 / burst_rate_per_s, size=take) * 1e3
+        for g in gaps:
+            t += g
+            out.append(t)
+        t += off_ms
+    return np.asarray(out)
+
+
+def heavy_tail_lengths(
+    rng: np.random.Generator,
+    n: int,
+    median: float,
+    sigma: float,
+    lo: int,
+    hi: int,
+) -> np.ndarray:
+    """Lognormal lengths (median ``median``, shape ``sigma``) clipped
+    into [lo, hi] -- the heavy-tailed prompt/output distributions real
+    serving traffic shows."""
+    if not 1 <= lo <= hi:
+        raise ValueError(f"bad length range [{lo}, {hi}]")
+    vals = rng.lognormal(mean=np.log(median), sigma=sigma, size=n)
+    return np.clip(np.rint(vals), lo, hi).astype(np.int64)
+
+
+def _assemble(
+    name: str,
+    seed: int,
+    rng: np.random.Generator,
+    tenants: Tuple[TenantClass, ...],
+    tenant_of: np.ndarray,       # index into tenants, per request
+    arrival_ms: np.ndarray,
+    prompt_lens: np.ndarray,
+    max_new: np.ndarray,
+    vocab_size: int,
+    **scenario_kw,
+) -> Scenario:
+    order = np.argsort(arrival_ms, kind="stable")
+    reqs = []
+    for k, i in enumerate(order):
+        t = tenants[int(tenant_of[i])]
+        plen = int(prompt_lens[i])
+        reqs.append(LoadRequest(
+            rid=f"{name[:2]}{k:05d}",
+            tenant=t.name,
+            priority=t.priority,
+            arrival_ms=float(arrival_ms[i]),
+            prompt=tuple(
+                int(x) for x in rng.integers(0, vocab_size, size=plen)
+            ),
+            max_new_tokens=int(max_new[i]),
+        ))
+    return Scenario(
+        name=name, seed=seed, tenants=tenants, requests=tuple(reqs),
+        **scenario_kw,
+    )
+
+
+# -- the catalog -------------------------------------------------------
+def build_scenario(
+    name: str,
+    seed: int = 0,
+    n_requests: int = 32,
+    vocab_size: int = 512,
+    max_prompt: int = 16,
+    max_new: int = 8,
+    rate_per_s: float = 40.0,
+) -> Scenario:
+    """Materialize catalog scenario ``name``. ``max_prompt`` must not
+    exceed the engine's largest prefill bucket and ``max_prompt +
+    max_new`` must fit its cache capacity -- the caller (server
+    ``--loadgen``, bench, tests) aligns these with its ServeConfig."""
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r} (catalog: "
+            f"{', '.join(sorted(SCENARIOS))})"
+        )
+    if n_requests < 1:
+        raise ValueError(f"n_requests {n_requests} must be >= 1")
+    if max_prompt < 2 or max_new < 2:
+        raise ValueError(
+            f"max_prompt {max_prompt} and max_new {max_new} must both "
+            "be >= 2 (the catalog's length distributions need a range)"
+        )
+    rng = np.random.default_rng(seed)
+    n = n_requests
+    lo_p = min(2, max_prompt)
+
+    if name == "steady":
+        tenants = (TenantClass("default", priority=0, share=1.0),)
+        return _assemble(
+            name, seed, rng, tenants,
+            tenant_of=np.zeros(n, np.int64),
+            arrival_ms=poisson_arrivals(rng, n, rate_per_s),
+            prompt_lens=rng.integers(lo_p, max_prompt + 1, size=n),
+            max_new=rng.integers(2, max_new + 1, size=n),
+            vocab_size=vocab_size,
+        )
+
+    if name == "bursty":
+        tenants = (TenantClass("default", priority=0, share=1.0),)
+        return _assemble(
+            name, seed, rng, tenants,
+            tenant_of=np.zeros(n, np.int64),
+            arrival_ms=onoff_arrivals(
+                rng, n, burst_size=max(4, n // 4),
+                burst_rate_per_s=rate_per_s * 10, off_ms=250.0,
+            ),
+            prompt_lens=rng.integers(lo_p, max_prompt + 1, size=n),
+            max_new=rng.integers(2, max_new + 1, size=n),
+            vocab_size=vocab_size,
+        )
+
+    if name == "heavy_tail":
+        tenants = (TenantClass("default", priority=0, share=1.0),)
+        return _assemble(
+            name, seed, rng, tenants,
+            tenant_of=np.zeros(n, np.int64),
+            arrival_ms=poisson_arrivals(rng, n, rate_per_s),
+            prompt_lens=heavy_tail_lengths(
+                rng, n, median=max(2.0, max_prompt / 4), sigma=1.0,
+                lo=1, hi=max_prompt,
+            ),
+            max_new=heavy_tail_lengths(
+                rng, n, median=max(2.0, max_new / 3), sigma=0.8,
+                lo=1, hi=max_new,
+            ),
+            vocab_size=vocab_size,
+        )
+
+    if name in ("multi_tenant", "saturating_burst"):
+        tenants = (
+            TenantClass(
+                "interactive", priority=2, share=0.5,
+                slo={"ttft_ms_p95": 400.0, "itl_ms_p95": 60.0},
+            ),
+            TenantClass(
+                "batch", priority=1, share=0.3,
+                slo={"ttft_ms_p95": 2000.0},
+            ),
+            TenantClass("background", priority=0, share=0.2),
+        )
+        shares = np.array([t.share for t in tenants])
+        tenant_of = rng.choice(
+            len(tenants), size=n, p=shares / shares.sum()
+        )
+        # Interactive sends short prompts/outputs; batch and
+        # background send long ones.
+        short = tenant_of == 0
+        prompt_lens = np.where(
+            short,
+            rng.integers(lo_p, max(lo_p, max_prompt // 2) + 1, size=n),
+            heavy_tail_lengths(
+                rng, n, median=max(2.0, max_prompt / 2), sigma=0.6,
+                lo=1, hi=max_prompt,
+            ),
+        )
+        max_new_arr = np.where(
+            short,
+            rng.integers(1, max(2, max_new // 2) + 1, size=n),
+            rng.integers(max(1, max_new // 2), max_new + 1, size=n),
+        )
+        if name == "saturating_burst":
+            # Everyone at (nearly) once, way past slot capacity; a
+            # tight backlog bound forces the policy's hand.
+            arrival_ms = np.sort(rng.uniform(0.0, 5.0, size=n))
+            return _assemble(
+                name, seed, rng, tenants, tenant_of, arrival_ms,
+                prompt_lens, max_new_arr, vocab_size,
+                queue_limit=max(2, n // 8),
+            )
+        return _assemble(
+            name, seed, rng, tenants, tenant_of,
+            poisson_arrivals(rng, n, rate_per_s),
+            prompt_lens, max_new_arr, vocab_size,
+        )
+
+    assert name == "colocate"
+    # Two classes: when the colocated train step trips the stall
+    # watermark, admission control sheds `background` and the
+    # `online` class keeps its SLO -- the class-protection property
+    # the scenario exists to measure.
+    tenants = (
+        TenantClass(
+            "online", priority=1, share=0.7,
+            slo={"ttft_ms_p95": 600.0},
+        ),
+        TenantClass("background", priority=0, share=0.3),
+    )
+    shares = np.array([t.share for t in tenants])
+    return _assemble(
+        name, seed, rng, tenants,
+        tenant_of=rng.choice(
+            len(tenants), size=n, p=shares / shares.sum()
+        ),
+        arrival_ms=poisson_arrivals(rng, n, rate_per_s),
+        prompt_lens=rng.integers(lo_p, max_prompt + 1, size=n),
+        max_new=rng.integers(2, max_new + 1, size=n),
+        vocab_size=vocab_size,
+        # A 40 ms train step every 8 serve ticks: >3x a default 8 ms
+        # decode tick, so the stall watermark trips by design.
+        colocate_train_ms=40.0,
+        colocate_every=8,
+    )
+
+
+SCENARIOS: Tuple[str, ...] = (
+    "steady", "bursty", "heavy_tail", "multi_tenant",
+    "saturating_burst", "colocate",
+)

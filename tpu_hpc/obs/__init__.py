@@ -15,8 +15,16 @@ schema-stamped JSONL discipline:
                Prometheus text exposition.
   stall.py     rolling step-time watermark detector (straggler / stall
                flagging; feeds the heartbeat file).
+  quantiles.py the one quantile estimator (numpy-parity linear
+               interpolation) every latency number comes from.
   report.py    ``python -m tpu_hpc.obs.report run.jsonl`` -- goodput /
                MFU / step-time-breakdown report from a run's JSONL.
+  regress.py   ``python -m tpu_hpc.obs.regress base.jsonl cand.jsonl``
+               -- the SLO-driven perf-regression gate over report
+               quantiles (and, with --bank, the bench history).
+  bank.py      ``python -m tpu_hpc.obs.bank BENCH_r*.json`` --
+               normalize driver bench captures into one validated
+               history JSONL for regress --bank.
 """
 from tpu_hpc.obs.events import (  # noqa: F401
     ENV_EVENTS,
@@ -27,6 +35,7 @@ from tpu_hpc.obs.events import (  # noqa: F401
     get_bus,
     set_bus,
 )
+from tpu_hpc.obs.quantiles import quantile, summarize  # noqa: F401
 from tpu_hpc.obs.registry import (  # noqa: F401
     ENV_PROM_FILE,
     MetricsRegistry,
@@ -57,10 +66,12 @@ __all__ = [
     "emit_span",
     "get_bus",
     "get_registry",
+    "quantile",
     "set_bus",
     "set_registry",
     "span",
     "stamp",
+    "summarize",
     "validate_file",
     "validate_record",
 ]

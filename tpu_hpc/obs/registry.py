@@ -28,6 +28,8 @@ import re
 import threading
 from typing import Deque, Dict, Optional
 
+from tpu_hpc.obs.quantiles import quantile as _quantile
+
 ENV_PROM_FILE = "TPU_HPC_PROM_FILE"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -37,13 +39,6 @@ def _sanitize(name: str) -> str:
     """Prometheus metric-name charset; JSONL keeps the raw name."""
     out = _NAME_RE.sub("_", name)
     return out if not out[:1].isdigit() else "_" + out
-
-
-def _quantile(sorted_vals, q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
-    return sorted_vals[idx]
 
 
 class MetricsRegistry:
@@ -108,6 +103,7 @@ class MetricsRegistry:
             "max": vals[-1] if vals else 0.0,
             "p50": _quantile(vals, 0.50),
             "p95": _quantile(vals, 0.95),
+            "p99": _quantile(vals, 0.99),
         }
 
     def snapshot(self) -> Dict[str, Dict]:
@@ -152,6 +148,7 @@ class MetricsRegistry:
                 f"# TYPE {m} summary",
                 f'{m}{{quantile="0.5"}} {s["p50"]}',
                 f'{m}{{quantile="0.95"}} {s["p95"]}',
+                f'{m}{{quantile="0.99"}} {s["p99"]}',
                 f"{m}_sum {s['sum']}",
                 f"{m}_count {s['count']}",
             ]

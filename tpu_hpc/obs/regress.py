@@ -1,0 +1,347 @@
+"""``python -m tpu_hpc.obs.regress baseline.jsonl candidate.jsonl`` --
+the perf-regression gate.
+
+Every perf claim in this repo's history was a headline number, and the
+BENCH_r01..r05 trajectory (46.3% -> 57.6% MFU with four driver-bench
+outages in between) shows how easily one number lies. This gate
+replaces it: two schema-stamped run JSONLs (a training run log, a
+serve replay trace, or a tpu_hpc.loadgen run) are reduced through
+``obs.report.build_report`` to their quantile metrics -- TTFT/ITL
+p50/p95/p99, goodput, MFU, tokens/s, per-tenant loadgen quantiles,
+shed counts, occupancy -- and diffed metric by metric against
+per-metric tolerances. Exit is non-zero on ANY violated metric, named
+with its quantile, so CI can gate a PR on measured distributions
+instead of a headline (the DDP/FSDP characterization study's
+discipline, arxiv 2505.12832).
+
+Modes:
+
+* default -- both files are run JSONLs; their reports are compared.
+* ``--bank`` -- the baseline is a normalized bench-history JSONL
+  (``python -m tpu_hpc.obs.bank`` lifts the BENCH_r*.json driver
+  captures into one), the candidate holds new ``bench`` records;
+  each candidate metric (its LATEST record per metric -- the round
+  under judgment, never masked by a better earlier row in the same
+  file) is compared against the bank's best value for that metric
+  (the trajectory's high-water mark, not whichever round happened to
+  run last).
+
+SLO config (``--slo slo.json``)::
+
+    {"default_tol": 0.1,
+     "metrics": {"serve.ttft_ms_p95": {"tol": 0.05, "max": 200.0},
+                 "goodput":           {"min": 0.85}}}
+
+``tol`` is the relative regression allowed vs baseline; ``max``/
+``min`` are absolute bounds on the candidate alone (true SLOs -- they
+fire even when the baseline was already out of bounds, and a bound on
+a metric the candidate never produced is itself a violation: a typoed
+name must not silently never fire).
+
+Exit codes (pinned by tests): 0 = gate passes, 1 = regression or SLO
+violation (each printed as ``REGRESSION: <metric> ...``), 2 = unusable
+input (missing/empty/schema-invalid file, or no comparable metrics --
+a gate with nothing to compare must fail loudly, not pass silently).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_hpc.obs.report import build_report
+from tpu_hpc.obs.schema import SCHEMA_VERSION, SchemaError, load_records
+
+DEFAULT_TOL = 0.10
+
+# Substrings marking a metric as lower-is-better; everything else
+# (throughput, goodput, MFU, occupancy) regresses by going DOWN.
+_LOWER_IS_BETTER = (
+    "ttft", "itl", "_ms", "latency", "shed", "stall", "queued",
+)
+
+
+def lower_is_better(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _LOWER_IS_BETTER)
+
+
+# -- metric extraction -------------------------------------------------
+def report_metrics(rep: dict) -> Dict[str, float]:
+    """Flatten a build_report() dict into the comparable numeric
+    metrics namespace."""
+    flat: Dict[str, float] = {}
+    gp = rep.get("goodput")
+    if gp:
+        flat["goodput"] = float(gp["combined"]["goodput"])
+    m = rep.get("mfu")
+    if m:
+        flat["mfu"] = float(m["mfu"])
+    for key, val in (rep.get("serve") or {}).items():
+        if isinstance(val, (int, float)) and key not in ("requests",):
+            flat[f"serve.{key}"] = float(val)
+    lg = rep.get("loadgen")
+    if lg:
+        for name, t in lg["tenants"].items():
+            for k in ("ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
+                      "itl_ms_p50", "itl_ms_p95"):
+                if k in t:
+                    flat[f"loadgen.{name}.{k}"] = float(t[k])
+            flat[f"loadgen.{name}.shed"] = float(t["shed"])
+            flat[f"loadgen.{name}.queued"] = float(t["queued"])
+        for k in ("occupancy_mean", "occupancy_p95", "stall_events",
+                  "shed", "queued"):
+            if k in lg:
+                flat[f"loadgen.{k}"] = float(lg[k])
+    return flat
+
+
+_QUANTILE_KEYS = (
+    "ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
+    "itl_ms_p50", "itl_ms_p95", "itl_ms_p99", "mfu",
+)
+
+
+def bank_metrics(
+    records: Sequence[dict], keep: str = "best",
+) -> Dict[str, float]:
+    """Reduce a bench-record JSONL to one value per metric.
+
+    ``keep="best"`` (the BASELINE side): max for higher-is-better,
+    min for lower -- the trajectory's high-water mark.
+    ``keep="latest"`` (the CANDIDATE side): the last record per
+    metric in file order -- a candidate file holding several rounds
+    must be judged by its newest measurement, or a regressed latest
+    round hides behind any better earlier one (review finding).
+    Failure rows (``value: null``) contribute nothing but are
+    legitimate history."""
+    if keep not in ("best", "latest"):
+        raise ValueError(f"keep {keep!r} must be 'best' or 'latest'")
+    out: Dict[str, float] = {}
+
+    def consider(name: str, value) -> None:
+        if not isinstance(value, (int, float)) or isinstance(
+            value, bool
+        ):
+            return
+        value = float(value)
+        if keep == "latest" or name not in out:
+            out[name] = value
+        elif lower_is_better(name):
+            out[name] = min(out[name], value)
+        else:
+            out[name] = max(out[name], value)
+
+    for rec in records:
+        if rec.get("event") != "bench":
+            continue
+        metric = rec.get("metric")
+        if not metric:
+            continue
+        consider(metric, rec.get("value"))
+        for k in _QUANTILE_KEYS:
+            if k in rec:
+                consider(f"{metric}.{k}", rec[k])
+    return out
+
+
+# -- comparison --------------------------------------------------------
+def load_slo(path: Optional[str]) -> dict:
+    if path is None:
+        return {}
+    with open(path) as f:
+        cfg = json.load(f)
+    if not isinstance(cfg, dict):
+        raise ValueError(f"{path}: SLO config must be a JSON object")
+    return cfg
+
+
+def compare(
+    baseline: Dict[str, float],
+    candidate: Dict[str, float],
+    slo: Optional[dict] = None,
+    tol: float = DEFAULT_TOL,
+) -> Tuple[List[dict], int]:
+    """Diff candidate against baseline; returns (violations, number of
+    checks run). A metric present on only one side is skipped for the
+    relative check (a new subsystem must not fail the gate for
+    existing), but absolute SLO bounds apply to every candidate metric
+    they name."""
+    slo = slo or {}
+    per_metric = slo.get("metrics", {})
+    default_tol = float(slo.get("default_tol", tol))
+    violations: List[dict] = []
+    checked = 0
+    for name in sorted(set(baseline) & set(candidate)):
+        base, cand = baseline[name], candidate[name]
+        m_tol = float(per_metric.get(name, {}).get("tol", default_tol))
+        checked += 1
+        if lower_is_better(name):
+            limit = base * (1.0 + m_tol) + 1e-9
+            bad = cand > limit
+        else:
+            limit = base * (1.0 - m_tol) - 1e-9
+            bad = cand < limit
+        if bad:
+            violations.append({
+                "metric": name,
+                "kind": "regression",
+                "baseline": base,
+                "candidate": cand,
+                "allowed": limit,
+                "tol": m_tol,
+                "direction": (
+                    "lower" if lower_is_better(name) else "higher"
+                ),
+            })
+    for name, bounds in per_metric.items():
+        if name not in candidate:
+            # An absolute bound on a metric the candidate never
+            # produced is unverifiable -- a typoed name (or a config
+            # pointed at the wrong run type) must fail the gate, not
+            # silently never fire (review finding; same discipline as
+            # parse_faults / TenantClass SLO-key validation).
+            # tol-only entries are tolerance *modifiers* for the
+            # relative pass and may legitimately cover metrics other
+            # run types emit, so they skip quietly.
+            if "max" in bounds or "min" in bounds:
+                checked += 1
+                violations.append({
+                    "metric": name, "kind": "slo_missing",
+                    "candidate": None,
+                    "allowed": bounds.get("max", bounds.get("min")),
+                })
+            continue
+        cand = candidate[name]
+        # Every evaluated bound counts as a check, violated or not:
+        # an SLO-only gate (no overlapping baseline metrics) whose
+        # bounds all pass must exit 0, not "nothing to compare"
+        # (review finding).
+        if "max" in bounds:
+            checked += 1
+            if cand > float(bounds["max"]):
+                violations.append({
+                    "metric": name, "kind": "slo_max",
+                    "candidate": cand,
+                    "allowed": float(bounds["max"]),
+                })
+        if "min" in bounds:
+            checked += 1
+            if cand < float(bounds["min"]):
+                violations.append({
+                    "metric": name, "kind": "slo_min",
+                    "candidate": cand,
+                    "allowed": float(bounds["min"]),
+                })
+    return violations, checked
+
+
+def _fmt_violation(v: dict) -> str:
+    if v["kind"] == "regression":
+        arrow = ">" if v["direction"] == "lower" else "<"
+        return (
+            f"REGRESSION: {v['metric']} {v['candidate']:.6g} {arrow} "
+            f"allowed {v['allowed']:.6g} "
+            f"(baseline {v['baseline']:.6g}, tol {v['tol']:.0%}, "
+            f"{v['direction']}-is-better)"
+        )
+    if v["kind"] == "slo_missing":
+        return (
+            f"REGRESSION: {v['metric']} has an absolute SLO bound "
+            "but the candidate produced no such metric (typoed name, "
+            "or wrong run type for this SLO config?)"
+        )
+    bound = "max" if v["kind"] == "slo_max" else "min"
+    return (
+        f"REGRESSION: {v['metric']} {v['candidate']:.6g} violates "
+        f"SLO {bound} {v['allowed']:.6g}"
+    )
+
+
+# -- CLI ---------------------------------------------------------------
+def _metrics_from_file(
+    path: str, bank: bool, keep: str = "best",
+) -> Dict[str, float]:
+    records = load_records(path, validate=True)
+    if not records:
+        raise SchemaError(f"{path} holds no records")
+    if bank:
+        return bank_metrics(records, keep=keep)
+    return report_metrics(build_report(records))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu_hpc.obs.regress",
+        description=__doc__.split("\n")[0],
+    )
+    ap.add_argument("baseline", help="baseline run JSONL (or, with "
+                    "--bank, the normalized bench-history JSONL)")
+    ap.add_argument("candidate", help="candidate run JSONL (or, with "
+                    "--bank, a JSONL of new bench records)")
+    ap.add_argument(
+        "--bank", action="store_true",
+        help="bench-history mode: compare candidate bench records "
+        "against the bank's best value per metric",
+    )
+    ap.add_argument(
+        "--slo", type=str, default=None,
+        help="per-metric SLO/tolerance config (JSON; see module doc)",
+    )
+    ap.add_argument(
+        "--tol", type=float, default=DEFAULT_TOL,
+        help="default relative regression tolerance "
+        f"(default {DEFAULT_TOL:.0%}; --slo overrides per metric)",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as one JSON object")
+    args = ap.parse_args(argv)
+    try:
+        slo = load_slo(args.slo)
+        base = _metrics_from_file(args.baseline, args.bank)
+        # Candidate side of --bank: latest per metric, NOT best --
+        # the newest round is the one under judgment.
+        cand = _metrics_from_file(
+            args.candidate, args.bank, keep="latest"
+        )
+    except (OSError, ValueError, SchemaError) as e:
+        # SchemaError subclasses ValueError; both are "bad input".
+        print(f"tpu_hpc.obs.regress: {e}", file=sys.stderr)
+        if args.bank and "schema_version" in str(e):
+            print(
+                "hint: un-stamped bench rows (pre-schema history) "
+                "must be lifted first: python -m tpu_hpc.obs.bank "
+                "<file> -o lifted.jsonl",
+                file=sys.stderr,
+            )
+        return 2
+    violations, checked = compare(base, cand, slo=slo, tol=args.tol)
+    if checked == 0:
+        print(
+            "tpu_hpc.obs.regress: no comparable metrics between "
+            f"{args.baseline} and {args.candidate} -- a gate with "
+            "nothing to check must not pass",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "checked": checked,
+            "violations": violations,
+            "pass": not violations,
+        }))
+    else:
+        for v in violations:
+            print(_fmt_violation(v))
+        verdict = "FAIL" if violations else "PASS"
+        print(
+            f"regress: {verdict} -- {checked} metric(s) checked, "
+            f"{len(violations)} violation(s)"
+        )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

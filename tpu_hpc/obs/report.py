@@ -17,9 +17,15 @@ judged by:
 * **restart timeline** -- one line per attempt (resumed-from step,
   end step, exit disposition);
 * **serving** -- tokens/s/chip, TTFT/ITL quantiles and serving MFU
-  when the file holds serve records.
+  when the file holds serve records;
+* **load generator** -- per-tenant lifecycle/shed/queued breakdown
+  and admission-control decisions when the file holds loadgen
+  (``lg_*``) records.
 
 ``--json`` emits the same report as one JSON object for drivers.
+Driver contract (pinned by tests): the JSON carries
+``schema_version``; exit code 0 = report produced, 2 = empty, missing
+or schema-invalid input. obs/regress.py and CI consume exactly this.
 """
 from __future__ import annotations
 
@@ -28,7 +34,11 @@ import json
 import sys
 from typing import Dict, Optional, Sequence
 
-from tpu_hpc.obs.schema import SchemaError, load_records  # noqa: F401
+from tpu_hpc.obs.schema import (  # noqa: F401
+    SCHEMA_VERSION,
+    SchemaError,
+    load_records,
+)
 # (load_records re-exported: the schema module owns the one
 # parse-and-validate loop; the report is just its largest consumer.)
 
@@ -152,12 +162,97 @@ def _serve(records: Sequence[dict]) -> Optional[dict]:
         for k in (
             "requests", "tokens", "tokens_per_s",
             "tokens_per_s_per_chip", "ttft_ms_p50", "ttft_ms_p95",
-            "itl_ms_p50", "itl_ms_p95",
+            "ttft_ms_p99", "itl_ms_p50", "itl_ms_p95", "itl_ms_p99",
         )
         if k in s
     }
     if "serve_mfu" in s:
         out["serve_mfu"] = s["serve_mfu"]
+    return out
+
+
+def _loadgen(records: Sequence[dict]) -> Optional[dict]:
+    """Load-harness breakdown: per-tenant lifecycle counts and TTFT
+    quantiles rebuilt from the lg_* events themselves (the breakdown
+    must exist even when a run died before its serve_summary), plus
+    the admission-control decision counts that attribute shed load."""
+    from tpu_hpc.obs.quantiles import quantile
+
+    headers = [
+        r for r in records if r.get("event") == "load_scenario"
+    ]
+    lifecycle = [
+        r for r in records
+        if r.get("event") in (
+            "lg_arrival", "lg_admit", "lg_first_token", "lg_finish",
+            "lg_shed",
+        )
+    ]
+    admissions = [
+        r for r in records if r.get("event") == "admission"
+    ]
+    if not headers and not lifecycle and not admissions:
+        return None
+    tenants: Dict[str, dict] = {}
+
+    def entry(name: str) -> dict:
+        return tenants.setdefault(name, {
+            "arrivals": 0, "admitted": 0, "queued": 0,
+            "finished": 0, "shed": 0, "_ttfts": [],
+        })
+
+    for r in lifecycle:
+        e = entry(r["tenant"])
+        ev = r["event"]
+        if ev == "lg_arrival":
+            e["arrivals"] += 1
+        elif ev == "lg_admit":
+            e["admitted"] += 1
+            # The producer's explicit tick-aware flag when present;
+            # queue_ms alone over-counts same-tick admissions (an
+            # earlier slot's prefill advances the shared clock).
+            if r.get("queued", r["queue_ms"] > 1e-9):
+                e["queued"] += 1
+        elif ev == "lg_first_token":
+            e["_ttfts"].append(float(r["ttft_ms"]))
+        elif ev == "lg_finish":
+            e["finished"] += 1
+        elif ev == "lg_shed":
+            e["shed"] += 1
+    summaries = [
+        r for r in records
+        if r.get("event") == "serve_summary" and "scenario" in r
+    ]
+    if summaries:
+        # Per-tenant ITL quantiles exist only in the closing
+        # summary: lg_token is ring-only by design, so the file's
+        # lifecycle events cannot reconstruct them. Merge them in so
+        # the regress gate sees per-tenant ITL too.
+        for name, st in (summaries[-1].get("tenants") or {}).items():
+            e = entry(name)
+            for k in ("itl_ms_p50", "itl_ms_p95"):
+                if k in st:
+                    e[k] = st[k]
+    for e in tenants.values():
+        ttfts = sorted(e.pop("_ttfts"))
+        e["ttft_ms_p50"] = quantile(ttfts, 0.50)
+        e["ttft_ms_p95"] = quantile(ttfts, 0.95)
+        e["ttft_ms_p99"] = quantile(ttfts, 0.99)
+    decisions = {"shed": 0, "queue": 0}
+    for r in admissions:
+        decisions[r["action"]] = decisions.get(r["action"], 0) + 1
+    # The closing serve_summary's loadgen extras (occupancy, SLO
+    # verdicts) ride along when present.
+    out: dict = {"tenants": tenants, "admission_decisions": decisions}
+    if headers:
+        out["scenario"] = headers[-1]["scenario"]
+        out["seed"] = headers[-1]["seed"]
+    if summaries:
+        s = summaries[-1]
+        for k in ("occupancy_mean", "occupancy_p95", "stall_events",
+                  "slo_violations", "shed", "queued"):
+            if k in s:
+                out[k] = s[k]
     return out
 
 
@@ -173,6 +268,9 @@ def build_report(
     faults = [r for r in records if r.get("event") == "fault"]
     run_start = run_starts[-1] if run_starts else None
     return {
+        # The --json contract: drivers (obs/regress.py, CI) key on
+        # this stamp the same way record consumers do.
+        "schema_version": SCHEMA_VERSION,
         "run_id": next(
             (r["run_id"] for r in records if "run_id" in r), None
         ),
@@ -197,6 +295,7 @@ def build_report(
             {"kind": f["kind"], "step": f.get("step")} for f in faults
         ],
         "serve": _serve(records),
+        "loadgen": _loadgen(records),
     }
 
 
@@ -299,6 +398,47 @@ def format_report(rep: dict) -> str:
         if "serve_mfu" in s:
             lines.append(f"- serving MFU (2N forward accounting): "
                          f"{s['serve_mfu']:.1%}")
+    lg = rep.get("loadgen")
+    if lg is not None:
+        lines += [
+            "",
+            "## Load generator",
+            "",
+        ]
+        if "scenario" in lg:
+            lines.append(
+                f"scenario `{lg['scenario']}` seed {lg['seed']}"
+            )
+            lines.append("")
+        lines += [
+            "| tenant | arrivals | admitted | queued | shed | "
+            "finished | TTFT p50/p95/p99 (ms) |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for name in sorted(lg["tenants"]):
+            t = lg["tenants"][name]
+            lines.append(
+                f"| {name} | {t['arrivals']} | {t['admitted']} | "
+                f"{t['queued']} | {t['shed']} | {t['finished']} | "
+                f"{t['ttft_ms_p50']:.1f} / {t['ttft_ms_p95']:.1f} / "
+                f"{t['ttft_ms_p99']:.1f} |"
+            )
+        dec = lg["admission_decisions"]
+        lines.append("")
+        lines.append(
+            f"- admission decisions: {dec.get('shed', 0)} shed, "
+            f"{dec.get('queue', 0)} saturated-queue ticks"
+        )
+        if "occupancy_mean" in lg:
+            lines.append(
+                f"- occupancy mean {lg['occupancy_mean']:.1%} / "
+                f"p95 {lg.get('occupancy_p95', 0):.1%}; stall events "
+                f"{lg.get('stall_events', 0)}"
+            )
+        if lg.get("slo_violations"):
+            lines.append(
+                "- SLO VIOLATED: " + ", ".join(lg["slo_violations"])
+            )
     return "\n".join(lines) + "\n"
 
 

@@ -91,6 +91,31 @@ EVENTS: Dict[str, EventSpec] = {
     ),
     # -- bench.py record lines (metric/value/unit + workload extras) --
     "bench": EventSpec(("metric", "value", "unit"), open=True),
+    # -- load generator (tpu_hpc/loadgen): one event per request
+    #    lifecycle edge, so the report and the regress gate can
+    #    reconstruct queueing/shedding behavior per tenant class --
+    "load_scenario": EventSpec(
+        ("scenario", "seed", "n_requests"), open=True,
+    ),
+    "lg_arrival": EventSpec(
+        ("rid", "tenant", "arrival_ms"),
+        optional=("prompt_len", "max_new_tokens", "priority"),
+    ),
+    "lg_admit": EventSpec(
+        ("rid", "tenant", "queue_ms"),
+        optional=("prefill_tokens", "queued"),
+    ),
+    "lg_first_token": EventSpec(("rid", "tenant", "ttft_ms")),
+    # Per-token cadence evidence; hot path, so producers usually emit
+    # it ring-only (flight-recorder forensics) rather than to the sink.
+    "lg_token": EventSpec(("rid",), optional=("itl_ms",)),
+    "lg_finish": EventSpec(("rid", "tenant", "tokens", "total_ms")),
+    "lg_shed": EventSpec(("rid", "tenant", "reason")),
+    # -- admission-control decisions (serve/scheduler.py policy) --
+    "admission": EventSpec(
+        ("action", "occupancy"),
+        optional=("rid", "tenant", "reason", "pending", "by_tenant"),
+    ),
     # -- supervisor attempt log (resilience/supervisor.py) --
     "attempt_start": EventSpec(("attempt", "cmd")),
     "attempt_end": EventSpec(

@@ -10,6 +10,7 @@ throughput accounting (metrics), and a local request-replay CLI
 from tpu_hpc.serve.engine import Engine, ServeConfig
 from tpu_hpc.serve.metrics import ServeMeter
 from tpu_hpc.serve.scheduler import (
+    AdmissionPolicy,
     ContinuousBatcher,
     Request,
     replay_requests,
@@ -21,6 +22,7 @@ from tpu_hpc.serve.weights import (
 )
 
 __all__ = [
+    "AdmissionPolicy",
     "ContinuousBatcher",
     "Engine",
     "Request",

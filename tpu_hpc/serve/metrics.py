@@ -9,14 +9,21 @@ operators alarm on); throughput is global and per-chip.
 MFU for serving divides by the FORWARD-only 2N FLOPs/token estimate
 (train/metrics.mfu(mode="inference")) -- the 6N training convention
 would understate serving utilization 3x.
+
+The time source is injectable (``clock``): the load generator
+(tpu_hpc/loadgen) drives the meter on a VIRTUAL clock so a seeded
+scenario replay yields bit-identical latency quantiles -- the
+determinism the regress gate (obs/regress.py) stakes exit codes on.
+Real serving keeps the default ``time.perf_counter``.
 """
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from tpu_hpc.obs import get_bus, get_registry
+from tpu_hpc.obs.quantiles import quantile as _quantile
 from tpu_hpc.train.metrics import mfu
 
 
@@ -29,31 +36,31 @@ class _Trace:
     t_done: Optional[float] = None
 
 
-def _quantile(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
-    return sorted_vals[idx]
-
-
 class ServeMeter:
     """Per-request latency traces + run-level throughput.
 
     Wire it into a ContinuousBatcher; call :meth:`summary` after the
     drain. ``metrics_path`` (optional) appends one JSONL record per
     finished request plus one ``serve_summary`` record -- the Trainer's
-    run-log discipline applied to serving.
+    run-log discipline applied to serving. ``clock`` (optional)
+    replaces ``time.perf_counter`` as the monotonic time source.
     """
 
-    def __init__(self, metrics_path: Optional[str] = None):
+    def __init__(
+        self,
+        metrics_path: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
         self.metrics_path = metrics_path
+        self.clock = clock or time.perf_counter
         self.traces: Dict[str, _Trace] = {}
         self.prefill_tokens = 0  # padded prompt tokens forwarded
-        self._t0 = time.perf_counter()
+        self.shed = 0            # requests dropped by admission control
+        self._t0 = self.clock()
 
     # -- batcher callbacks --------------------------------------------
     def submitted(self, rid: str) -> None:
-        self.traces[rid] = _Trace(t_submit=time.perf_counter())
+        self.traces[rid] = _Trace(t_submit=self.clock())
 
     def admitted(self, rid: str, prefill_tokens: int = 0) -> None:
         # TTFT is measured from SUBMISSION: an oversubscribed replay
@@ -61,7 +68,7 @@ class ServeMeter:
         # on, not hide it between submit and slot admission. Callers
         # that never signal submission (direct engine drivers) still
         # get a trace anchored here.
-        t = time.perf_counter()
+        t = self.clock()
         trace = self.traces.get(rid)
         if trace is None:
             trace = self.traces[rid] = _Trace(t_submit=t)
@@ -73,7 +80,7 @@ class ServeMeter:
         self.prefill_tokens += prefill_tokens
 
     def token(self, rid: str, first: bool = False) -> None:
-        t = time.perf_counter()
+        t = self.clock()
         trace = self.traces[rid]
         if first:
             trace.t_first = t
@@ -81,7 +88,7 @@ class ServeMeter:
 
     def finished(self, rid: str) -> None:
         trace = self.traces[rid]
-        trace.t_done = time.perf_counter()
+        trace.t_done = self.clock()
         ttft_ms = 1e3 * (trace.t_first - trace.t_submit)
         self._append({
             "event": "request",
@@ -104,6 +111,14 @@ class ServeMeter:
         for a, b in zip(trace.token_times, trace.token_times[1:]):
             reg.observe("serve_itl_ms", 1e3 * (b - a))
 
+    def request_shed(self, rid: str, reason: str = "") -> None:
+        """Admission control dropped ``rid`` before it ever got a
+        slot. The trace is removed so the latency quantiles describe
+        only served requests; the shed count rides the summary (a
+        gate that ignored shed load would reward shedding)."""
+        self.traces.pop(rid, None)
+        self.shed += 1
+
     # -- aggregation ---------------------------------------------------
     def summary(
         self,
@@ -117,7 +132,7 @@ class ServeMeter:
         serving MFU on the forward-only 2N estimate over ALL tokens
         the model forwarded (padded prefill + generated): utilization
         measures work done, not work delivered."""
-        wall = time.perf_counter() - self._t0
+        wall = self.clock() - self._t0
         ttfts = sorted(
             t.t_first - t.t_submit
             for t in self.traces.values() if t.t_first is not None
@@ -139,10 +154,14 @@ class ServeMeter:
             "tokens_per_s_per_chip": tokens_per_s / n_devices,
             "ttft_ms_p50": 1e3 * _quantile(ttfts, 0.50),
             "ttft_ms_p95": 1e3 * _quantile(ttfts, 0.95),
+            "ttft_ms_p99": 1e3 * _quantile(ttfts, 0.99),
             "itl_ms_p50": 1e3 * _quantile(itls, 0.50),
             "itl_ms_p95": 1e3 * _quantile(itls, 0.95),
+            "itl_ms_p99": 1e3 * _quantile(itls, 0.99),
             "prefill_tokens": self.prefill_tokens,
         }
+        if self.shed:
+            out["shed"] = self.shed
         if n_params is not None and peak_flops_per_device:
             forwarded_per_s = (
                 (total_tokens + self.prefill_tokens) / wall

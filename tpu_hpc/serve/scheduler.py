@@ -18,26 +18,42 @@ Slot invariants (pinned by tests/test_serve.py):
     unreachable;
   * generated tokens per request are independent of what shares the
     batch (each slot's attention sees only its own rows).
+
+Admission control (:class:`AdmissionPolicy`) closes the telemetry
+loop the obs spine opened: the occupancy gauge (``serve_active_slots``)
+and the stall watermark (obs/stall.py, via ``stall_signal``) feed a
+shed/queue decision per tick -- when every slot is busy and the
+backlog exceeds ``queue_limit``, or the watermark trips, the batcher
+sheds the lowest-priority tenant class instead of letting every
+tenant's TTFT collapse together. Every decision is emitted as a
+schema-stamped ``admission`` event so the report can attribute the
+shed load per tenant class.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from tpu_hpc.obs import get_registry
+from tpu_hpc.obs import get_bus, get_registry
 from tpu_hpc.serve.engine import Engine
 
 
 @dataclasses.dataclass
 class Request:
-    """One generation request: prompt token ids + a stop condition."""
+    """One generation request: prompt token ids + a stop condition.
+
+    ``tenant``/``priority`` classify the request for multi-tenant
+    admission control: higher ``priority`` admits first and sheds
+    last. The defaults make single-tenant callers policy-free."""
 
     rid: str
     prompt: List[int]
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
+    tenant: str = "default"
+    priority: int = 0
 
     def __post_init__(self):
         if not self.prompt:
@@ -45,6 +61,36 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"request {self.rid!r}: max_new_tokens must be >= 1"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionPolicy:
+    """Shed/queue policy over the occupancy gauge + stall watermark.
+
+    ``queue_limit``: backlog tolerated while every slot is busy;
+    beyond it, the newest lowest-priority requests are shed until the
+    backlog fits (bounded queues, not unbounded TTFT).
+    ``occupancy_high``: occupancy fraction at/above which the backlog
+    limit applies (below it, free slots will drain the queue anyway).
+    ``shed_on_stall``: when the stall watermark trips (decode ticks
+    running >= factor x their own recent median -- a colocated train
+    step, a straggling host), shed the entire lowest-priority pending
+    class to protect the higher classes' SLOs.
+    """
+
+    queue_limit: int = 32
+    occupancy_high: float = 1.0
+    shed_on_stall: bool = True
+
+    def __post_init__(self):
+        if self.queue_limit < 0:
+            raise ValueError(
+                f"queue_limit {self.queue_limit} must be >= 0"
+            )
+        if not 0.0 < self.occupancy_high <= 1.0:
+            raise ValueError(
+                f"occupancy_high {self.occupancy_high} must be in (0, 1]"
             )
 
 
@@ -67,9 +113,13 @@ class ContinuousBatcher:
 
     ``meter`` (serve/metrics.ServeMeter, optional) gets the
     admit/first-token/token/finish callbacks for TTFT and inter-token
-    latency accounting. ``results[rid]`` accumulates each request's
-    generated tokens; ``stats`` counts admissions, evictions and decode
-    steps (the slot-reuse evidence the tests read).
+    latency accounting. ``policy`` (AdmissionPolicy, optional) turns
+    on admission control; ``stall_signal`` (callable -> bool,
+    optional) is its watermark input -- the load harness wires it to
+    an obs.StallDetector over tick durations. ``results[rid]``
+    accumulates each request's generated tokens; ``stats`` counts
+    admissions, evictions, decode steps and sheds (the slot-reuse and
+    shed-load evidence the tests read).
 
     Scope note: per-request host state (``results``, the request
     table, the meter's traces) is retained for the life of the
@@ -80,14 +130,28 @@ class ContinuousBatcher:
     accumulate forever.
     """
 
-    def __init__(self, engine: Engine, meter=None):
+    def __init__(
+        self,
+        engine: Engine,
+        meter=None,
+        policy: Optional[AdmissionPolicy] = None,
+        stall_signal: Optional[Callable[[], bool]] = None,
+    ):
         self.engine = engine
         self.meter = meter
+        self.policy = policy
+        self.stall_signal = stall_signal
         self.slots = [_Slot() for _ in range(engine.serve_cfg.slots)]
         self.pending: List[Request] = []
         self.results: Dict[str, List[int]] = {}
-        self.stats = {"admitted": 0, "evicted": 0, "decode_steps": 0}
+        self.stats = {
+            "admitted": 0, "evicted": 0, "decode_steps": 0, "shed": 0,
+        }
         self._requests: Dict[str, Request] = {}
+        self._order: Dict[str, int] = {}  # rid -> submission sequence
+        # The occupancy gauge exists (at 0) from bring-up: a scraper
+        # must distinguish "serving, idle" from "no batcher yet".
+        self._set_occupancy()
 
     # -- queue ---------------------------------------------------------
     def submit(self, request: Request) -> None:
@@ -105,6 +169,7 @@ class ContinuousBatcher:
         # request's partial results for one oversized prompt.
         self.engine.serve_cfg.bucket_for(len(request.prompt))
         self._requests[request.rid] = request
+        self._order[request.rid] = len(self._order)
         self.pending.append(request)
         if self.meter is not None:
             self.meter.submitted(request.rid)
@@ -119,16 +184,123 @@ class ContinuousBatcher:
         return sum(1 for s in self.slots if not s.free)
 
     @property
+    def occupancy(self) -> float:
+        return self.active / len(self.slots)
+
+    @property
     def done(self) -> bool:
         return not self.pending and self.active == 0
 
+    def _set_occupancy(self) -> None:
+        # Occupancy is THE continuous-batching health number: a low
+        # gauge under queued load means admission is starving decode.
+        # Updated on EVERY transition (admit, evict, bring-up) so the
+        # gauge equals the live slot count at any instant, not just
+        # after the last decode step.
+        get_registry().set_gauge("serve_active_slots", self.active)
+
+    def _next_pending(self) -> Request:
+        """Highest priority first, submission order within a class --
+        plain FIFO when every request carries the default priority."""
+        best = min(
+            self.pending,
+            key=lambda r: (-r.priority, self._order[r.rid]),
+        )
+        self.pending.remove(best)
+        return best
+
+    # -- admission control --------------------------------------------
+    def _shed(self, req: Request, reason: str, occupancy: float) -> None:
+        self.pending.remove(req)
+        self.stats["shed"] += 1
+        reg = get_registry()
+        reg.inc("serve_shed_total")
+        if self.meter is not None and hasattr(self.meter, "request_shed"):
+            self.meter.request_shed(req.rid, reason=reason)
+        get_bus().emit(
+            "admission",
+            sink=self._sink(),
+            action="shed",
+            rid=req.rid,
+            tenant=req.tenant,
+            occupancy=occupancy,
+            pending=len(self.pending),
+            reason=reason,
+        )
+
+    def _sink(self) -> Optional[str]:
+        # Admission decisions land in the same JSONL the meter writes,
+        # so one file tells the whole story.
+        return getattr(self.meter, "metrics_path", None)
+
+    def _admission_control(self) -> None:
+        """One policy pass per tick, BEFORE admissions: bound the
+        backlog while saturated; dump the lowest class on a watermark
+        trip; record who is left queueing."""
+        if self.policy is None or not self.pending:
+            return
+        occupancy = self.occupancy
+        saturated = occupancy >= self.policy.occupancy_high
+        # The backlog that actually queues excludes what the admit
+        # loop will seat THIS tick: with occupancy_high < 1 a tick
+        # can be "saturated" while slots are free, and shedding a
+        # request a free slot would serve is pure waste (review
+        # finding).
+        free = len(self.slots) - self.active
+        backlog = len(self.pending) - free
+        if saturated and backlog > self.policy.queue_limit:
+            overflow = backlog - self.policy.queue_limit
+            # Newest of the lowest class go first: oldest requests
+            # have already paid the most queue time (shedding them
+            # wastes the wait), and higher classes are shed only when
+            # the lowest is exhausted.
+            victims = sorted(
+                self.pending,
+                key=lambda r: (r.priority, -self._order[r.rid]),
+            )[:overflow]
+            for req in victims:
+                self._shed(req, "queue_overflow", occupancy)
+        if (
+            self.policy.shed_on_stall
+            and self.pending
+            and self.stall_signal is not None
+            and self.stall_signal()
+        ):
+            low = min(r.priority for r in self.pending)
+            high = max(r.priority for r in self.pending)
+            # Shedding is class PROTECTION: dump the lowest waiting
+            # class so a higher one keeps its SLO through the stall.
+            # A homogeneous backlog has nobody to protect -- it rides
+            # the stall out queued (the overflow rule above still
+            # bounds it).
+            if low < high:
+                victims = [
+                    r for r in self.pending if r.priority == low
+                ]
+                for req in victims:
+                    self._shed(req, "stall_watermark", occupancy)
+        if saturated and self.pending:
+            by_tenant: Dict[str, int] = {}
+            for r in self.pending:
+                by_tenant[r.tenant] = by_tenant.get(r.tenant, 0) + 1
+            get_bus().emit(
+                "admission",
+                sink=self._sink(),
+                action="queue",
+                occupancy=occupancy,
+                pending=len(self.pending),
+                by_tenant=by_tenant,
+            )
+
     # -- one decode-granularity tick ----------------------------------
     def step(self) -> None:
-        """Admit into free slots, then one decode step for all."""
+        """Apply admission policy, admit into free slots, then one
+        decode step for all."""
+        self._admission_control()
         for idx, slot in enumerate(self.slots):
             if not slot.free or not self.pending:
                 continue
-            req = self.pending.pop(0)
+            req = self._next_pending()
             if self.meter is not None:
                 self.meter.admitted(
                     req.rid,
@@ -143,6 +315,7 @@ class ContinuousBatcher:
             slot.pos = len(req.prompt)
             slot.last_token = first
             slot.remaining = req.max_new_tokens - 1
+            self._set_occupancy()
             self.results[req.rid] = [first]
             if self.meter is not None:
                 self.meter.token(req.rid, first=True)
@@ -155,11 +328,7 @@ class ContinuousBatcher:
         positions = [s.pos for s in self.slots]
         out = self.engine.decode(tokens, positions)
         self.stats["decode_steps"] += 1
-        reg = get_registry()
-        reg.inc("serve_decode_steps_total")
-        # Occupancy is THE continuous-batching health number: a low
-        # gauge under queued load means admission is starving decode.
-        reg.set_gauge("serve_active_slots", self.active)
+        get_registry().inc("serve_decode_steps_total")
         for slot, tok in zip(self.slots, np.asarray(out)):
             if slot.free:
                 continue
@@ -180,6 +349,7 @@ class ContinuousBatcher:
         self.stats["evicted"] += 1
         slot.rid = None
         slot.remaining = 0
+        self._set_occupancy()
         # pos/last_token are reset on the next admission's prefill;
         # leaving them is safe because the length mask bounds reads.
 
@@ -190,9 +360,9 @@ class ContinuousBatcher:
         max_steps: Optional[int] = None,
         tick=None,
     ) -> Dict[str, List[int]]:
-        """Submit ``requests`` and step until every request finished.
-        ``tick(step_index)`` is the liveness hook (the replay server
-        wires the resilience heartbeat here). Returns
+        """Submit ``requests`` and step until every request finished
+        (or was shed). ``tick(step_index)`` is the liveness hook (the
+        replay server wires the resilience heartbeat here). Returns
         ``{rid: generated tokens}``."""
         for r in requests:
             self.submit(r)
@@ -212,6 +382,10 @@ class ContinuousBatcher:
             if tick is not None:
                 tick(steps)
             steps += 1
+        # Replay shutdown: the gauge must read the true (empty) state
+        # even if the last transition was shed-from-pending (which
+        # never touches a slot).
+        self._set_occupancy()
         return self.results
 
 

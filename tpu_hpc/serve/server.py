@@ -6,6 +6,10 @@ replays a deterministic synthetic request mix through the continuous
 batcher, and emits the serving metrics record -- TTFT/ITL quantiles,
 tokens/s/chip, serving MFU -- as one JSON line on stdout plus optional
 JSONL traces. The serving analogue of bench.py's training contract.
+``--loadgen SCENARIO`` swaps the plain replay for a tpu_hpc.loadgen
+scenario (bursty/heavy-tail/multi-tenant/colocation mixes on the
+deterministic virtual clock) -- the producer side of the
+``python -m tpu_hpc.obs.regress`` gate.
 
 Resilience: ``--supervise N`` re-execs under
 tpu_hpc.resilience.supervisor with N bounded restarts (same contract
@@ -142,6 +146,87 @@ def run_replay(
     return summary
 
 
+def run_loadgen(
+    cfg: llama2.LlamaConfig,
+    serve_cfg,
+    scenario_name: str,
+    n_requests: int,
+    max_new_tokens: int,
+    checkpoint_dir: Optional[str] = None,
+    metrics_path: Optional[str] = None,
+    seed: int = 0,
+) -> dict:
+    """Engine bring-up + a tpu_hpc.loadgen scenario run; returns the
+    harness summary (per-tenant quantiles, shed/queued counts,
+    occupancy). The scenario's lengths are aligned to THIS engine's
+    buckets/capacity, so any catalog entry runs against any serve
+    shape."""
+    import jax
+
+    from tpu_hpc.loadgen import LoadHarness, build_scenario
+    from tpu_hpc.serve.engine import Engine
+    from tpu_hpc.serve.weights import load_serving_params
+    from tpu_hpc.resilience.heartbeat import Heartbeat
+
+    from tpu_hpc import obs
+
+    # Scenario FIRST: it is cheap and validates the derived sizing
+    # (build_scenario rejects max_prompt/max_new < 2), so a bad CLI
+    # combination fails in milliseconds, not after restore + warmup.
+    max_prompt = max(serve_cfg.prefill_buckets)
+    max_new = min(
+        max_new_tokens, serve_cfg.max_seq_len - max_prompt
+    )
+    scenario = build_scenario(
+        scenario_name, seed=seed, n_requests=n_requests,
+        vocab_size=cfg.vocab_size, max_prompt=max_prompt,
+        max_new=max_new,
+    )
+
+    mesh = build_serving_mesh(jax.device_count(), cfg)
+    with obs.span("restore", sink=metrics_path,
+                  hist="serve_restore_s"):
+        if checkpoint_dir:
+            params = load_serving_params(checkpoint_dir, cfg, mesh)
+        else:
+            params = llama2.init_llama(jax.random.key(seed), cfg)
+    engine = Engine(params, cfg, serve_cfg, mesh)
+    with obs.span("warmup", sink=metrics_path, hist="serve_warmup_s"):
+        n_programs = engine.warmup()
+    harness = LoadHarness(
+        engine, scenario, metrics_path=metrics_path,
+    )
+    heartbeat = Heartbeat.from_env()
+    tick_cb = None
+    if heartbeat is not None:
+        import time as _time
+
+        last = [0.0]
+
+        def tick_cb(tick):
+            now = _time.monotonic()
+            if now - last[0] >= 2.0:
+                last[0] = now
+                heartbeat.tick(tick)
+
+    harness.drive(tick_cb=tick_cb)
+    peak = peak_flops_per_chip(jax.devices()[0])
+    return harness.summarize(
+        n_devices=jax.device_count(),
+        n_params=llama2.count_params(cfg),
+        peak_flops_per_device=peak,
+        # Evaluated AFTER the drive: recompiles must count the run.
+        extra=dict(
+            mesh={k: int(v) for k, v in mesh.shape.items()},
+            slots=serve_cfg.slots,
+            prefill_buckets=list(serve_cfg.prefill_buckets),
+            compiled_programs=n_programs,
+            recompiles=engine.compile_count - n_programs,
+            batcher=dict(harness.batcher.stats),
+        ),
+    )
+
+
 def _last_json_line(log_dir: str) -> Optional[str]:
     """The newest attempt log's final JSON line (the child's summary
     record), or None when no attempt log holds one."""
@@ -198,6 +283,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("--max-new", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--loadgen", type=str, default=None, metavar="SCENARIO",
+        help="run a tpu_hpc.loadgen scenario instead of the plain "
+        "replay mix (catalog: steady, bursty, heavy_tail, "
+        "multi_tenant, saturating_burst, colocate); --requests/"
+        "--max-new/--seed size it, latencies run on the virtual "
+        "clock (deterministic -- the regress gate's input)",
+    )
     ap.add_argument(
         "--checkpoint-dir", type=str, default=None,
         help="restore params from the newest trainer checkpoint here "
@@ -268,7 +361,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     buckets = tuple(int(b) for b in args.buckets.split(","))
     prompt_lens = tuple(int(p) for p in args.prompt_lens.split(","))
     too_long = [p for p in prompt_lens if p > max(buckets)]
-    if too_long:
+    # --loadgen sizes its own prompt distribution to the buckets; the
+    # replay mix's --prompt-lens is unused there and must not block.
+    if too_long and not args.loadgen:
         ap.error(
             f"prompt lens {too_long} exceed the largest bucket "
             f"{max(buckets)}"
@@ -289,11 +384,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     serve_cfg = ServeConfig(
         slots=args.slots, max_seq_len=max_seq, prefill_buckets=buckets
     )
-    summary = run_replay(
-        cfg, serve_cfg, args.requests, prompt_lens, args.max_new,
-        checkpoint_dir=args.checkpoint_dir, metrics_path=args.metrics,
-        seed=args.seed,
-    )
+    if args.loadgen:
+        from tpu_hpc.loadgen import SCENARIOS
+
+        if args.loadgen not in SCENARIOS:
+            ap.error(
+                f"--loadgen {args.loadgen!r}: unknown scenario "
+                f"(catalog: {', '.join(SCENARIOS)})"
+            )
+        # The scenario's output-length budget is what the cache has
+        # left after the largest bucket; a combination that leaves
+        # < 2 tokens is a CLI error, not a post-bring-up traceback.
+        lg_max_new = min(args.max_new, max_seq - max(buckets))
+        if lg_max_new < 2:
+            ap.error(
+                f"--loadgen: cache capacity {max_seq} minus the "
+                f"largest bucket {max(buckets)} leaves "
+                f"{max_seq - max(buckets)} generate tokens (< 2); "
+                "raise --max-seq-len or --max-new"
+            )
+        summary = run_loadgen(
+            cfg, serve_cfg, args.loadgen, args.requests, args.max_new,
+            checkpoint_dir=args.checkpoint_dir,
+            metrics_path=args.metrics, seed=args.seed,
+        )
+    else:
+        summary = run_replay(
+            cfg, serve_cfg, args.requests, prompt_lens, args.max_new,
+            checkpoint_dir=args.checkpoint_dir,
+            metrics_path=args.metrics, seed=args.seed,
+        )
     print(json.dumps(summary))
     return 0
 
