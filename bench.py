@@ -571,7 +571,7 @@ def bench_llama_pp(
     }
 
 
-def serve_record(summary: dict) -> dict:
+def serve_record(summary: dict, disagg: bool = False) -> dict:
     """Serving summary -> the training-bench record schema
     (metric/value/unit/vs_baseline), with the serving-native latency
     quantiles riding along. vs_baseline = serving MFU (forward-only
@@ -579,8 +579,26 @@ def serve_record(summary: dict) -> dict:
     same 40% north-star target the training rows use; None on
     backends with no published peak (CPU sim)."""
     mfu = summary.get("serve_mfu")
+    rec_serve = {
+        "requests": summary["requests"],
+        "slots": summary["slots"],
+        "prefill_buckets": summary["prefill_buckets"],
+        "recompiles": summary["recompiles"],
+    }
+    if disagg:
+        d = summary.get("disagg", {})
+        rec_serve["disagg"] = {
+            "prefill_mesh": d.get("prefill_mesh"),
+            "decode_mesh": d.get("decode_mesh"),
+            "kv_transfers": d.get("kv_transfers"),
+            "kv_transfer_bytes": d.get("kv_transfer_bytes"),
+            "kv_transfer_ms_p95": d.get("kv_transfer_ms_p95"),
+        }
     return {
-        "metric": "serve_tokens_per_s_per_chip",
+        "metric": (
+            "serve_disagg_tokens_per_s_per_chip" if disagg
+            else "serve_tokens_per_s_per_chip"
+        ),
         "value": round(summary["tokens_per_s_per_chip"], 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 3) if mfu is not None else None,
@@ -588,19 +606,14 @@ def serve_record(summary: dict) -> dict:
         "ttft_ms_p95": round(summary["ttft_ms_p95"], 2),
         "itl_ms_p50": round(summary["itl_ms_p50"], 2),
         "itl_ms_p95": round(summary["itl_ms_p95"], 2),
-        "serve": {
-            "requests": summary["requests"],
-            "slots": summary["slots"],
-            "prefill_buckets": summary["prefill_buckets"],
-            "recompiles": summary["recompiles"],
-        },
+        "serve": rec_serve,
     }
 
 
 def bench_serve(
     requests: int = 32, slots: int = 8, max_new: int = 64,
     prompt_lens=(96, 192, 384), buckets=(128, 256, 512),
-    model_cfg=None,
+    model_cfg=None, disagg: bool = False,
 ) -> dict:
     """Batched-inference throughput: the SAME ~170M bench architecture
     as the training headline (bench_model_cfg -- one factory, so
@@ -616,6 +629,13 @@ def bench_serve(
     from tpu_hpc.serve.server import run_replay
 
     init_distributed(verbose=False)
+    if disagg and jax.device_count() < 2:
+        # The server.py guard's twin: a tier split needs a chip per
+        # tier -- fail as a CLI error, not a mid-bring-up traceback.
+        raise SystemExit(
+            "bench.py: --serve-disagg needs >= 2 devices (one per "
+            f"tier); only {jax.device_count()} visible"
+        )
     model_cfg = model_cfg or bench_model_cfg()
     serve_cfg = ServeConfig(
         slots=slots,
@@ -623,11 +643,13 @@ def bench_serve(
         prefill_buckets=tuple(buckets),
     )
     summary = run_replay(
-        model_cfg, serve_cfg, requests, prompt_lens, max_new
+        model_cfg, serve_cfg, requests, prompt_lens, max_new,
+        disagg=disagg,
     )
-    rec = serve_record(summary)
+    rec = serve_record(summary, disagg=disagg)
     print(
-        f"serve | {summary['mesh']} slots={slots} | "
+        f"serve{'-disagg' if disagg else ''} | "
+        f"{summary['mesh']} slots={slots} | "
         f"{summary['tokens_per_s']:.0f} tokens/s | "
         f"TTFT p50 {summary['ttft_ms_p50']:.0f} ms | "
         f"ITL p50 {summary['itl_ms_p50']:.1f} ms",
@@ -932,6 +954,13 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-slots", type=int, default=8)
     ap.add_argument("--serve-max-new", type=int, default=64)
     ap.add_argument(
+        "--serve-disagg", action="store_true",
+        help="disaggregated serving row: prefill/decode on disjoint "
+        "mesh tiers, KV blocks moved by tpu_hpc.reshard plans; the "
+        "record carries the per-tier meshes and kv-transfer load "
+        "(--workload serve only)",
+    )
+    ap.add_argument(
         "--loadgen-scenario", type=str, default=None,
         help="tpu_hpc.loadgen catalog scenario for --workload loadgen "
         "(default multi_tenant; sized by --serve-requests/"
@@ -1049,6 +1078,15 @@ def main(argv=None) -> int:
             f"consumed by --workload loadgen; --workload "
             f"{args.workload} would silently ignore it"
         )
+    if args.serve_disagg and args.workload != "serve":
+        # The --comm-mode guard discipline: a tier-split flag on a
+        # workload that never consumes it must be a CLI error, not a
+        # silently single-tier row labeled disaggregated.
+        ap.error(
+            "--serve-disagg is only consumed by --workload serve; "
+            f"--workload {args.workload} would silently run "
+            "single-tier"
+        )
     if args.comm_mode != "flat" and (
         args.all or args.workload not in ("llama", "llama-long")
     ):
@@ -1138,7 +1176,7 @@ def main(argv=None) -> int:
     elif args.workload == "serve":
         rec = bench_serve(
             requests=args.serve_requests, slots=args.serve_slots,
-            max_new=args.serve_max_new,
+            max_new=args.serve_max_new, disagg=args.serve_disagg,
         )
     elif args.workload == "loadgen":
         rec = bench_loadgen(

@@ -85,6 +85,7 @@ SLOW_NODEIDS = frozenset(nodeid for nodeid, _ in [
     ("tests/test_resnet.py::test_forward_shape[50]", "14s"),
     ("tests/test_serve.py::TestReplayServerCLI::test_main_runs_replay_and_prints_summary", "8s"),
     ("tests/test_serve.py::TestServingWeights::test_trainer_checkpoint_restores_into_serving_layout", "9s"),
+    ("tests/test_reshard.py::TestLongShapes::test_long_shape_bounded_parity_sweep", "35s"),
     ("tests/test_resnet.py::test_fsdp_training_step", "60s"),
     ("tests/test_run_metrics.py::TestMetricsLog::test_appends_across_runs", "13s"),
     ("tests/test_runtime.py::TestHybridMesh::test_end_to_end_train_step_over_two_slices", "12s"),

@@ -243,8 +243,9 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
     with the serve-specific knobs."""
     seen = {}
 
-    def fake_bench_serve(requests, slots, max_new):
-        seen.update(requests=requests, slots=slots, max_new=max_new)
+    def fake_bench_serve(requests, slots, max_new, disagg=False):
+        seen.update(requests=requests, slots=slots, max_new=max_new,
+                    disagg=disagg)
         return {"metric": "serve_tokens_per_s_per_chip", "value": 1,
                 "unit": "tokens/s/chip", "vs_baseline": None}
 
@@ -255,10 +256,15 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
         "--serve-max-new", "7",
     ])
     assert rc == 0
-    assert seen == {"requests": 12, "slots": 4, "max_new": 7}
+    assert seen == {"requests": 12, "slots": 4, "max_new": 7,
+                    "disagg": False}
     seen.clear()
     assert bench.main(["--workload", "serve"]) == 0
-    assert seen == {"requests": 32, "slots": 8, "max_new": 64}
+    assert seen == {"requests": 32, "slots": 8, "max_new": 64,
+                    "disagg": False}
+    seen.clear()
+    assert bench.main(["--workload", "serve", "--serve-disagg"]) == 0
+    assert seen["disagg"] is True
 
 
 def test_serve_alias_conflicts_with_explicit_workload(bench, monkeypatch):

@@ -1,0 +1,174 @@
+"""Disaggregated prefill/decode serving (serve/disagg.py).
+
+The invariants, on the 8-device sim mesh split into two 4-chip tiers:
+
+* **token parity** -- greedy decode through the disaggregated path
+  (prefill tier -> reshard KV hop -> decode tier) is token-exact
+  against the single-tier engine, which is itself pinned token-exact
+  against the no-cache forward (tests/test_serve.py's oracle chain);
+* **compile discipline** -- after warmup (both tiers' tables, the
+  extract/insert executables, and every KV plan's programs via a
+  dummy transfer) a mixed request stream triggers ZERO new compiles;
+* **tier attribution** -- the replay summary carries the per-tier
+  meshes, kv-transfer count/bytes and hop-latency quantiles, and the
+  batcher stats fold in the transfer load;
+* **flag discipline** -- ``--disagg`` on a workload that cannot
+  consume it (--loadgen) is a CLI error, as is a disagg sizing flag
+  without --disagg (the --comm-mode guard discipline).
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_hpc.models import llama2
+from tpu_hpc.runtime import MeshSpec, build_mesh
+from tpu_hpc.serve import (
+    ContinuousBatcher,
+    DisaggEngine,
+    Engine,
+    Request,
+    ServeConfig,
+    split_serving_meshes,
+)
+
+TINY = llama2.LlamaConfig(
+    dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+    multiple_of=16, max_seq_len=64, dtype=jnp.float32,
+)
+SCFG = ServeConfig(slots=4, max_seq_len=48, prefill_buckets=(8, 16))
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama2.init_llama(jax.random.key(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def warm_disagg(tiny_params, devices):
+    prefill_mesh, decode_mesh = split_serving_meshes(8, TINY)
+    engine = DisaggEngine(
+        tiny_params, TINY, SCFG, prefill_mesh, decode_mesh,
+        max_inflight_bytes=1 << 14,
+    )
+    engine.warmup()
+    return engine
+
+
+@pytest.fixture(scope="module")
+def warm_single(tiny_params, devices):
+    mesh = build_mesh(MeshSpec(axes={"data": 4, "model": 2}))
+    engine = Engine(tiny_params, TINY, SCFG, mesh)
+    engine.warmup()
+    return engine
+
+
+def _mix(seed=0, n=6, max_new=5):
+    rng = np.random.default_rng(seed)
+    lens = (7, 11, 9, 16, 3, 13)
+    return [
+        Request(
+            rid=f"r{i}",
+            prompt=rng.integers(0, TINY.vocab_size, size=lens[i % len(
+                lens
+            )]).tolist(),
+            max_new_tokens=max_new,
+        )
+        for i in range(n)
+    ]
+
+
+class TestDisaggParity:
+    def test_mixed_stream_token_exact_vs_single_tier(
+        self, warm_single, warm_disagg
+    ):
+        """Both buckets, slot reuse, mid-stream admissions: every
+        request's tokens equal the single-tier engine's -- the KV hop
+        moved the bytes, nothing else."""
+        a = ContinuousBatcher(warm_single).run(_mix())
+        b = ContinuousBatcher(warm_disagg).run(_mix())
+        assert a == b
+
+    def test_tiers_are_disjoint_and_validated(self, tiny_params):
+        pm, dm = split_serving_meshes(8, TINY)
+        assert not (
+            set(pm.devices.flat) & set(dm.devices.flat)
+        )
+        with pytest.raises(ValueError, match="disjoint"):
+            DisaggEngine(tiny_params, TINY, SCFG, pm, pm)
+
+    def test_split_needs_two_devices(self):
+        with pytest.raises(ValueError, match=">= 2 devices"):
+            split_serving_meshes(1, TINY)
+        with pytest.raises(ValueError, match="prefill tier"):
+            split_serving_meshes(8, TINY, prefill_devices=8)
+
+
+class TestDisaggCompileDiscipline:
+    def test_zero_recompiles_after_warmup(self, warm_disagg):
+        n = warm_disagg.compile_count
+        ContinuousBatcher(warm_disagg).run(_mix(seed=3))
+        assert warm_disagg.compile_count == n
+
+    def test_transfer_stats_ride_batcher(self, warm_disagg):
+        before = warm_disagg.transfer_stats["kv_transfers"]
+        batcher = ContinuousBatcher(warm_disagg)
+        batcher.run(_mix(seed=4, n=3))
+        assert (
+            warm_disagg.transfer_stats["kv_transfers"] == before + 3
+        )
+        assert batcher.stats["kv_transfers"] == before + 3
+        assert batcher.stats["kv_transfer_bytes"] > 0
+
+    def test_describe_reports_tiers_and_plans(self, warm_disagg):
+        d = warm_disagg.describe()
+        assert set(d["prefill_mesh"]) and set(d["decode_mesh"])
+        assert sorted(d["kv_plans"]) == [8, 16]
+        for plan in d["kv_plans"].values():
+            assert plan["bound_met"] is True
+            assert plan["max_inflight_bytes"] == 1 << 14
+
+
+class TestDisaggCLI:
+    def test_replay_main_with_disagg(self, capsys):
+        from tpu_hpc.serve import server
+
+        rc = server.main([
+            "--requests", "3", "--max-new", "2", "--slots", "2",
+            "--buckets", "8", "--prompt-lens", "3,6", "--vocab", "64",
+            "--disagg", "--disagg-max-inflight-mb", "1",
+        ])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.splitlines()[-1])
+        assert summary["recompiles"] == 0
+        d = summary["disagg"]
+        assert d["kv_transfers"] == 3
+        assert d["kv_transfer_bytes"] > 0
+        assert "kv_transfer_ms_p95" in d
+        assert summary["batcher"]["kv_transfers"] == 3
+
+    def test_disagg_with_loadgen_is_cli_error(self):
+        """Misplaced-flag discipline: the loadgen harness cannot
+        consume the tier split -- silent single-tier would be a lie."""
+        from tpu_hpc.serve import server
+
+        with pytest.raises(SystemExit):
+            server.main([
+                "--loadgen", "steady", "--disagg",
+            ])
+
+    def test_disagg_sizing_without_disagg_is_cli_error(self):
+        from tpu_hpc.serve import server
+
+        with pytest.raises(SystemExit):
+            server.main(["--disagg-max-inflight-mb", "4"])
+
+    def test_bench_serve_disagg_flag_guard(self):
+        """bench.py: --serve-disagg on a non-serve workload errors."""
+        import bench
+
+        with pytest.raises(SystemExit):
+            bench.main(["--workload", "llama", "--serve-disagg",
+                        "--steps", "1"])

@@ -243,12 +243,15 @@ def test_dp_checkpoint_restores_into_pp_layout(params, tokens, tmp_path):
     )
     split = llama_pp.split_params(restored, CFG, n_stages=S)
     # Place on the pipe mesh (edges replicated, stages stage-sharded)
-    # -- the restore-then-shard step a real PP retrain performs.
-    from jax.sharding import NamedSharding
+    # -- the restore-then-shard step a real PP retrain performs, now
+    # through the general reshard engine (one planned move for the
+    # whole tree instead of a device_put per leaf).
+    from tpu_hpc import reshard
+    from tpu_hpc.parallel.plans import shardings_for
 
-    split = jax.tree.map(
-        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
-        split, llama_pp.pp_pspecs(split),
+    split = reshard.apply(
+        split, shardings_for(mesh, llama_pp.pp_pspecs(split)),
+        label="dp_ckpt_to_pp",
     )
     pipe = pp.pipelined(
         llama_pp.make_stage_fn(CFG, S), mesh, axis="pipe",

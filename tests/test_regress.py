@@ -37,6 +37,11 @@ class TestCompare:
         assert not lower_is_better("goodput")
         assert not lower_is_better("mfu")
         assert not lower_is_better("serve.tokens_per_s_per_chip")
+        # Reshard-cost metrics: time, wire traffic, and transient peak
+        # all regress UPWARD.
+        assert lower_is_better("reshard_exchange_ms")
+        assert lower_is_better("reshard_exchange_wire_bytes")
+        assert lower_is_better("reshard.peak_inflight_bytes")
 
     def test_identical_passes(self):
         m = {"serve.ttft_ms_p95": 10.0, "goodput": 0.9}
@@ -353,6 +358,69 @@ class TestBank:
         assert bank_metrics([json.loads(l) for l in
                              cand.read_text().splitlines()],
                             keep="best")["tok_per_chip"] == 57.0
+
+    def test_reshard_cost_regression_fails_the_bank_diff(
+        self, tmp_path, capsys,
+    ):
+        """Satellite pin: comm/bench.py's reshard rows ride the bank
+        gate -- a slower execute OR more wire bytes than the banked
+        history fails with the metric named (both are lower-is-better
+        by the direction tokens)."""
+        def rows(ms, wire):
+            return [
+                stamp({
+                    "event": "bench", "metric": "reshard_exchange_ms",
+                    "value": ms, "unit": "ms", "op": "reshard_exchange",
+                }),
+                stamp({
+                    "event": "bench",
+                    "metric": "reshard_exchange_wire_bytes",
+                    "value": wire, "unit": "bytes",
+                    "op": "reshard_exchange",
+                }),
+            ]
+
+        def write(path, recs):
+            path.write_text(
+                "\n".join(json.dumps(r) for r in recs) + "\n"
+            )
+            return str(path)
+
+        bank = write(tmp_path / "hist.jsonl", rows(2.0, 28000))
+        ok = write(tmp_path / "ok.jsonl", rows(2.1, 28000))
+        slow = write(tmp_path / "slow.jsonl", rows(4.0, 28000))
+        fat = write(tmp_path / "fat.jsonl", rows(2.0, 60000))
+        assert regress_main(["--bank", bank, ok]) == 0
+        assert regress_main(["--bank", bank, slow]) == 1
+        assert "reshard_exchange_ms" in capsys.readouterr().out
+        assert regress_main(["--bank", bank, fat]) == 1
+        assert "reshard_exchange_wire_bytes" in (
+            capsys.readouterr().out
+        )
+
+    def test_live_reshard_bench_rows_ride_the_gate(self, tmp_path):
+        """End to end: real run_reshard_bench rows on the sim mesh are
+        schema-valid JSONL the bank gate accepts (exit 0 against
+        themselves)."""
+        import jax
+
+        from tpu_hpc.comm.bench import run_reshard_bench
+        from tpu_hpc.runtime import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(axes={"data": jax.device_count()}))
+        records = run_reshard_bench(
+            mesh, sizes=[256], warmup=0, iters=1,
+            ops=("reshard_exchange",),
+        )
+        assert records
+        path = tmp_path / "rs.jsonl"
+        path.write_text(
+            "\n".join(json.dumps(r) for r in records) + "\n"
+        )
+        assert validate_file(str(path)) == len(records)
+        assert regress_main(
+            ["--bank", str(path), str(path), "--tol", "0.5"]
+        ) == 0
 
     def test_committed_history_artifact_is_valid(self):
         """The repo's own BENCH_HISTORY.jsonl (the bank `regress

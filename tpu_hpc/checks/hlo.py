@@ -74,6 +74,59 @@ def compiled_text(fn, *args) -> str:
     return jax.jit(fn).lower(*args).compile().as_text()
 
 
+# Element sizes for the HLO scalar types that appear in this repo's
+# programs (compiled HLO spells shapes as e.g. ``bf16[64,32]``).
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+_TENSOR = re.compile(
+    r"\b(" + "|".join(_DTYPE_BYTES) + r")\[([0-9,]*)\]"
+)
+# StableHLO spells the same shapes as ``tensor<64x32xf32>`` (dims
+# x-separated, element type last, MLIR integer names).
+_STABLEHLO_DTYPE_BYTES = {
+    "i1": 1, "i8": 1, "ui8": 1, "i16": 2, "ui16": 2, "f16": 2,
+    "bf16": 2, "i32": 4, "ui32": 4, "f32": 4, "i64": 8, "ui64": 8,
+    "f64": 8,
+}
+_STABLEHLO_TENSOR = re.compile(
+    r"tensor<((?:\d+x)*)("
+    + "|".join(_STABLEHLO_DTYPE_BYTES) + r")>"
+)
+
+
+def max_tensor_bytes(text: str) -> int:
+    """The largest single tensor in an HLO or StableHLO module text,
+    in bytes.
+
+    Compiled (post-SPMD) HLO is PER-DEVICE: every shape in it is a
+    per-device buffer, so this is the peak single-buffer HBM a program
+    can demand on one chip -- the instrument that pins the reshard
+    planner's ``max_inflight_bytes`` contract ("no step materializes a
+    full replica"). GSPMD's involuntary-full-rematerialization escape
+    hatch shows up here as a full-global-shape tensor in what should
+    be a sharded program. Lowered StableHLO (``tensor<64x32xf32>``
+    spelling) is covered too so a pre-compile bound check cannot pass
+    vacuously on zero matches.
+    """
+    best = 0
+    for dt, dims in _TENSOR.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n * _DTYPE_BYTES[dt])
+    for dims, dt in _STABLEHLO_TENSOR.findall(text):
+        n = 1
+        for d in dims.split("x"):
+            if d:
+                n *= int(d)
+        best = max(best, n * _STABLEHLO_DTYPE_BYTES[dt])
+    return best
+
+
 # "replica_groups = dense<...> : tensor<GxSxi64>" -- the tensor type
 # carries (group count x group size) directly, no need to parse ids.
 _STABLEHLO_GROUPS = re.compile(

@@ -1,1 +1,2 @@
 from tpu_hpc.ckpt.checkpoint import CheckpointManager  # noqa: F401
+from tpu_hpc.reshard.elastic import TopologyMismatchError  # noqa: F401

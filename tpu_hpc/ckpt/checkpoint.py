@@ -20,6 +20,17 @@ Resilience integration (tpu_hpc.resilience, docs/guide/resilience.md):
 next-older step when the newest snapshot is torn; saves replay over
 existing steps after such a fallback instead of dying on
 StepAlreadyExists.
+
+Elastic resume (tpu_hpc.reshard, docs/guide/resharding.md): every save
+records the state's topology in a ``.tpu_hpc_meta/<step>.json``
+sidecar; ``restore_latest`` against a template on a DIFFERENT mesh
+shape restores into the checkpoint's own layout and runs an explicit,
+memory-bounded reshard plan onto the live shardings -- so the
+supervisor can relaunch a preempted run onto a different pod shape and
+resume bit-exact. A structurally incompatible checkpoint (wrong
+model/config, not a pod-shape change) raises
+:class:`~tpu_hpc.reshard.TopologyMismatchError` naming both
+topologies instead of a generic orbax error.
 """
 from __future__ import annotations
 
@@ -57,6 +68,11 @@ class CheckpointManager:
                 enable_async_checkpointing=async_save,
             ),
         )
+        # Provenance of the most recent restore_latest: step, whether
+        # the cross-topology (elastic) path ran, source/target meshes
+        # and the executed plan summary. The Trainer reads this to
+        # emit the ``elastic_restore`` telemetry event.
+        self.last_restore_info: Optional[dict] = None
 
     def save(self, state: Any, step: Optional[int] = None, force: bool = False) -> bool:
         """Sharded (per-host) async save at ``step`` (defaults to
@@ -88,8 +104,30 @@ class CheckpointManager:
                 if reload is not None:
                     reload()
         if started:
+            self._write_sidecar(step, state)
             self._maybe_corrupt(step)
         return started
+
+    def _write_sidecar(self, step: int, state: Any) -> None:
+        """Record the state's topology (mesh axes + per-leaf specs)
+        next to the checkpoint -- what the elastic restore path reads
+        to rebuild the SOURCE layout on a relaunch with a different
+        mesh. Failure to write it must never fail the save: a missing
+        sidecar only means the restore falls back to the direct orbax
+        path."""
+        from tpu_hpc.reshard import elastic
+
+        try:
+            elastic.write_sidecar(self.directory, step, state)
+            elastic.prune_sidecars(
+                self.directory, [*self._mgr.all_steps(), step]
+            )
+        except Exception as exc:  # noqa: BLE001 - advisory metadata
+            get_logger().warning(
+                "could not write topology sidecar for step %d "
+                "(%s: %s); elastic restore will fall back to the "
+                "direct orbax path", step, type(exc).__name__, exc,
+            )
 
     def _stash_existing(self, step: int) -> Optional[str]:
         """Resume replay: a run restored below its newest snapshot
@@ -132,6 +170,7 @@ class CheckpointManager:
             import shutil
 
             shutil.rmtree(aside, ignore_errors=True)
+        self._write_sidecar(step, state)
         self._maybe_corrupt(step)
         return step
 
@@ -153,7 +192,11 @@ class CheckpointManager:
         )
 
     def restore_latest(
-        self, template_state: Any, retries: int = 1
+        self,
+        template_state: Any,
+        retries: int = 1,
+        max_inflight_bytes: Optional[int] = None,
+        elastic: bool = True,
     ) -> Optional[Any]:
         """Restore the newest READABLE checkpoint resharded to match
         ``template_state``'s shardings; None if no checkpoint can be
@@ -165,26 +208,58 @@ class CheckpointManager:
         very restart -- falls back to the next-older one instead of
         wedging the relaunch loop on a corrupt newest snapshot.
 
+        Cross-topology (elastic) restore: when the step's topology
+        sidecar names a mesh shape DIFFERENT from the template's, the
+        restore lands in the checkpoint's own layout (rebuilt over the
+        live devices) and an explicit :mod:`tpu_hpc.reshard` plan --
+        bounded by ``max_inflight_bytes``, span-bracketed, recorded in
+        ``last_restore_info`` -- moves it onto the live shardings.
+        This is what lets the resilience supervisor relaunch a
+        preempted run onto a different pod shape. ``elastic=False``
+        opts a caller out: the direct orbax restore lands bytes
+        straight into the template's shardings in ONE pass -- right
+        for templates that already encode a deliberate cross-layout
+        move (the serving loader's train->serve template), where the
+        two-pass explicit path would restore the full train state
+        into its training layout first.
+
         Loud-failure guarantee: if checkpoints EXIST but none restore
-        (a structural mismatch -- wrong mesh/model config on relaunch
-        -- fails every step, unlike a torn write which fails only the
-        newest), the last error is re-raised. Returning None there
-        would silently restart from step 0 and then overwrite the
-        surviving snapshots as training re-passed them."""
+        (a structural mismatch -- wrong model config on relaunch --
+        fails every step, unlike a torn write which fails only the
+        newest), the failure is re-raised; when the sidecar shows the
+        saved and live trees are structurally different, as a
+        :class:`~tpu_hpc.reshard.TopologyMismatchError` naming both
+        topologies. Returning None there would silently restart from
+        step 0 and then overwrite the surviving snapshots as training
+        re-passed them."""
+        from tpu_hpc.reshard import elastic as elastic_mod
+
         steps = sorted(self._mgr.all_steps(), reverse=True)
         abstract = jax.tree.map(
             ocp.utils.to_shape_dtype_struct, template_state
         )
+        self.last_restore_info = None
         last_exc: Optional[Exception] = None
         for step in steps:
+            meta = elastic_mod.read_sidecar(self.directory, step)
             try:
-                return retry_call(
+                if elastic and meta is not None and \
+                        elastic_mod.needs_reshard(meta, abstract):
+                    return self._restore_elastic(
+                        step, abstract, meta, retries,
+                        max_inflight_bytes,
+                    )
+                restored = retry_call(
                     self._mgr.restore,
                     (step,),
                     {"args": ocp.args.StandardRestore(abstract)},
                     retries=retries, base_delay=0.2, max_delay=5.0,
                     describe=f"checkpoint restore (step {step})",
                 )
+                self.last_restore_info = {
+                    "step": step, "elastic": False,
+                }
+                return restored
             except Exception as exc:  # noqa: BLE001 - fall back older
                 last_exc = exc
                 get_logger().warning(
@@ -193,8 +268,130 @@ class CheckpointManager:
                     step, type(exc).__name__, exc,
                 )
         if last_exc is not None:
-            raise last_exc
+            self._raise_restore_failure(steps, abstract, last_exc)
         return None
+
+    def _raise_restore_failure(
+        self, steps, abstract, last_exc: Exception
+    ):
+        """Every existing step failed to restore. If the newest
+        sidecar shows a STRUCTURAL disagreement with the live
+        template, raise the typed error naming source vs. live
+        topology; otherwise re-raise the underlying failure."""
+        from tpu_hpc.reshard import elastic
+
+        for step in steps:
+            meta = elastic.read_sidecar(self.directory, step)
+            if meta is None:
+                continue
+            mismatch = elastic.describe_mismatch(meta, abstract)
+            if mismatch is not None:
+                live = elastic.live_mesh_of(abstract)
+                live_desc = (
+                    {k: int(v) for k, v in live.shape.items()}
+                    if live is not None else "unsharded"
+                )
+                raise elastic.TopologyMismatchError(
+                    f"no checkpoint under {self.directory!r} restores "
+                    f"into the live state. Checkpoint step {step} was "
+                    f"written on mesh {meta.get('mesh')} "
+                    f"({meta.get('device_count')} devices); the live "
+                    f"topology is mesh {live_desc} "
+                    f"({jax.device_count()} devices). Structural "
+                    f"difference: {mismatch}. A pod-shape change "
+                    "alone is handled automatically by the "
+                    "elastic-resume path (docs/guide/resharding.md); "
+                    "this error means the saved and live trees "
+                    "disagree -- wrong model/config on relaunch?"
+                ) from last_exc
+            break
+        raise last_exc
+
+    def _restore_elastic(
+        self,
+        step: int,
+        abstract: Any,
+        meta: dict,
+        retries: int,
+        max_inflight_bytes: Optional[int],
+    ) -> Any:
+        """The cross-topology path: restore into the checkpoint's own
+        layout (no implicit movement hiding inside orbax), then run an
+        explicit bounded reshard plan onto the live shardings."""
+        from tpu_hpc import obs, reshard
+        from tpu_hpc.reshard import elastic
+
+        src_template = elastic.source_template(meta, abstract)
+        if src_template is None:
+            get_logger().warning(
+                "elastic restore: source mesh %s (%s devices) cannot "
+                "be rebuilt over the %d live device(s); falling back "
+                "to the direct orbax restore",
+                meta.get("mesh"), meta.get("device_count"),
+                jax.device_count(),
+            )
+            restored = retry_call(
+                self._mgr.restore,
+                (step,),
+                {"args": ocp.args.StandardRestore(abstract)},
+                retries=retries, base_delay=0.2, max_delay=5.0,
+                describe=f"checkpoint restore (step {step})",
+            )
+            self.last_restore_info = {
+                "step": step, "elastic": False,
+                "src_mesh": meta.get("mesh"),
+            }
+            return restored
+        restored_src = retry_call(
+            self._mgr.restore,
+            (step,),
+            {"args": ocp.args.StandardRestore(src_template)},
+            retries=retries, base_delay=0.2, max_delay=5.0,
+            describe=f"elastic checkpoint restore (step {step})",
+        )
+        targets = elastic.target_shardings(abstract)
+        plan = reshard.plan_reshard(
+            restored_src, targets,
+            max_inflight_bytes=max_inflight_bytes,
+            label="elastic_restore",
+        )
+        # donate=True: ownership of the source-layout copy transfers
+        # to the executor -- same-mesh stages donate into their
+        # programs, chunked/disjoint-device moves free eagerly, and
+        # the rest drops by refcount as stages complete; nothing here
+        # keeps the source tree alive past the reshard.
+        # copy_noop=True: replicated leaves (state.step) are
+        # assignment-equivalent across the throwaway source mesh and
+        # the live mesh, and a plain passthrough would leave them
+        # COMMITTED to the source mesh -- the next save's topology
+        # sidecar would then record the stale mesh and mis-route
+        # every subsequent restart. Every leaf must land on the live
+        # template's own shardings.
+        with obs.span(
+            "elastic_reshard", hist="ckpt_elastic_reshard_s"
+        ):
+            restored = plan.execute(
+                restored_src, donate=True, copy_noop=True
+            )
+        live = elastic.live_mesh_of(abstract)
+        self.last_restore_info = {
+            "step": step,
+            "elastic": True,
+            "src_mesh": meta.get("mesh"),
+            "tgt_mesh": (
+                {k: int(v) for k, v in live.shape.items()}
+                if live is not None else None
+            ),
+            "plan": plan.summary(),
+        }
+        get_logger().info(
+            "elastic restore: step %d moved from mesh %s onto %s "
+            "(%d step(s), %d wire bytes, peak inflight %d bytes)",
+            step, meta.get("mesh"),
+            self.last_restore_info["tgt_mesh"], len(plan.steps),
+            plan.wire_bytes, plan.peak_inflight_bytes,
+        )
+        return restored
 
     def restore(self, step: int, template_state: Any) -> Any:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template_state)

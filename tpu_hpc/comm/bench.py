@@ -64,7 +64,11 @@ HIER_OPS = ("hier_all_reduce", "hier_all_gather", "hier_reduce_scatter")
 # Comm/compute-overlap building blocks (comm.overlap); run on the flat
 # axis like the classic ops.
 OVERLAP_OPS = ("ppermute_all_gather", "gather_matmul")
-ALL_OPS = OPS + HIER_OPS + OVERLAP_OPS
+# Reshard-engine ops (tpu_hpc.reshard): plan + execute timings with
+# modeled vs. measured bytes; each has a ``_bounded`` flavor running
+# the chunked decomposition under max_inflight_bytes = total/4.
+RESHARD_OPS = ("reshard_exchange", "reshard_replicate")
+ALL_OPS = OPS + HIER_OPS + OVERLAP_OPS + RESHARD_OPS
 
 # gather_matmul's fixed output width: the benched payload is the
 # sharded weight [K/n, N]; K scales with the requested element count.
@@ -279,6 +283,130 @@ class CommBenchmark:
         return records
 
 
+def run_reshard_bench(
+    mesh: Mesh,
+    axis: str = "data",
+    sizes: Sequence[int] = DEFAULT_SIZES,
+    warmup: int = 5,
+    iters: int = 20,
+    ops: Sequence[str] = RESHARD_OPS,
+    dtype: str = "float32",
+) -> List[Dict]:
+    """Benchmark the reshard engine's plan + execute over one mesh
+    axis, emitting SCHEMA-STAMPED bench rows (obs.schema ``bench``
+    events) so the rows ride straight into the regress gate's --bank
+    diff next to the training/serving history.
+
+    Two ops x two flavors per size:
+
+    * ``reshard_exchange``  -- ``[n, inner]`` sharded dim 0 -> dim 1
+      (the Ulysses-style axis swap, GSPMD's full-remat trap);
+    * ``reshard_replicate`` -- sharded -> fully replicated (the
+      required-residency case; never bounded, the full copy IS the
+      target);
+    * ``*_bounded``         -- the same exchange decomposed under
+      ``max_inflight_bytes = total_bytes / 4``: what the bound costs
+      in time is exactly what it saves in peak HBM, and both sides of
+      that trade land in one row (``plan_ms``, ``mean_s``,
+      ``wire_bytes_modeled`` vs ``bytes_moved``, ``chunks``,
+      ``peak_inflight_bytes``).
+    """
+    from tpu_hpc import reshard
+    from tpu_hpc.obs.schema import stamp
+
+    n = mesh.shape[axis]
+    if n < 2:
+        print(
+            f"comm.bench: skipping reshard ops -- axis {axis!r} has "
+            f"size {n} (< 2): nothing to redistribute",
+            file=sys.stderr,
+        )
+        return []
+    dt = jnp.dtype(dtype)
+    records: List[Dict] = []
+    for op in ops:
+        if op not in RESHARD_OPS:
+            raise ValueError(f"not a reshard op: {op}")
+        flavors = (
+            (False, True) if op == "reshard_exchange" else (False,)
+        )
+        for bounded in flavors:
+            for size in sizes:
+                if op == "reshard_exchange":
+                    inner = -(-size // n) * n
+                    x = jnp.arange(n * inner, dtype=dt).reshape(
+                        n, inner
+                    )
+                    src, tgt = P(axis), P(None, axis)
+                else:
+                    x = jnp.arange(n * size, dtype=dt)
+                    src, tgt = P(axis), P()
+                x = jax.device_put(x, NamedSharding(mesh, src))
+                x.block_until_ready()
+                bound = x.nbytes // 4 if bounded else None
+                t0 = time.perf_counter()
+                plan = reshard.plan_reshard(
+                    {"x": x}, {"x": NamedSharding(mesh, tgt)},
+                    max_inflight_bytes=bound,
+                )
+                plan_ms = (time.perf_counter() - t0) * 1e3
+                for _ in range(warmup):
+                    plan.execute({"x": x})["x"].block_until_ready()
+                times = []
+                for _ in range(iters):
+                    x.block_until_ready()
+                    t0 = time.perf_counter()
+                    out = plan.execute({"x": x})
+                    out["x"].block_until_ready()
+                    times.append(time.perf_counter() - t0)
+                times = np.asarray(times)
+                mean = float(times.mean())
+                name = op + ("_bounded" if bounded else "")
+                step = plan.steps[0]
+                common = {
+                    "op": name,
+                    "size_elements": size,
+                    "bytes_per_shard": x.nbytes // n,
+                    "world_size": n,
+                    "max_inflight_bytes": bound,
+                }
+                # The size rides IN the metric name: the bank gate
+                # reduces per metric (best on the baseline side,
+                # latest on the candidate side), and a sweep emitting
+                # one name for every size would diff
+                # min-across-sizes against the last size measured.
+                records.append(stamp({
+                    "event": "bench",
+                    "metric": f"{name}_n{size}_ms",
+                    "value": round(mean * 1e3, 6),
+                    "unit": "ms",
+                    **common,
+                    "mean_s": mean,
+                    "std_s": float(times.std()),
+                    "min_s": float(times.min()),
+                    "max_s": float(times.max()),
+                    "plan_ms": round(plan_ms, 6),
+                    "wire_bytes_modeled": plan.wire_bytes,
+                    "bytes_moved": plan.bytes,
+                    "peak_inflight_bytes": plan.peak_inflight_bytes,
+                    "chunks": (
+                        step.chunk.count if step.chunk else 1
+                    ),
+                    "busbw_GB_s": (
+                        plan.wire_bytes / mean / 1e9 if mean > 0
+                        else float("inf")
+                    ),
+                }))
+                records.append(stamp({
+                    "event": "bench",
+                    "metric": f"{name}_n{size}_wire_bytes",
+                    "value": plan.wire_bytes,
+                    "unit": "bytes",
+                    **common,
+                }))
+    return records
+
+
 def _env_metadata(mesh: Mesh) -> Dict[str, str]:
     """CSV metadata header block, parity with torch_comm_bench.py:153-194
     (host, versions, backend, world size -> TPU equivalents)."""
@@ -366,18 +494,27 @@ def run_comm_bench(
     unknown = [op for op in ops if op not in ALL_OPS]
     if unknown:
         raise ValueError(f"unknown ops {unknown}; choose from {ALL_OPS}")
-    flat_ops = [op for op in ops if op not in HIER_OPS]
+    flat_ops = [
+        op for op in ops if op not in HIER_OPS and op not in RESHARD_OPS
+    ]
     hier_ops = [op for op in ops if op in HIER_OPS]
+    reshard_ops = [op for op in ops if op in RESHARD_OPS]
     records: List[Dict] = []
     from tpu_hpc.runtime import MeshSpec, build_mesh
 
-    if flat_ops:
+    if flat_ops or reshard_ops:
         if mesh is None:
             mesh = build_mesh(MeshSpec(axes={axis: -1}))
+    if flat_ops:
         records += CommBenchmark(
             mesh=mesh, axis=axis, sizes=sizes, warmup=warmup,
             iters=iters, ops=flat_ops,
         ).run()
+    if reshard_ops:
+        records += run_reshard_bench(
+            mesh, axis=axis, sizes=sizes, warmup=warmup, iters=iters,
+            ops=reshard_ops,
+        )
     if hier_ops:
         if hier_mesh is None:
             from tpu_hpc.runtime.mesh import slice_groups, two_tier_spec

@@ -75,6 +75,13 @@ class TrainingConfig:
     save_every: int = 0  # epochs; 0 = off
     checkpoint_dir: str = "checkpoints"
     resume: bool = True
+    # Elastic resume (tpu_hpc.reshard): peak per-device transient, in
+    # MiB, a cross-topology restore's reshard plan may materialize
+    # (restore_latest max_inflight_bytes). 0 = unbounded -- fine on
+    # meshes with HBM headroom; set it on runs whose state is a large
+    # fraction of the chip, where an unbounded cross-mesh move is free
+    # to stage a full-array transient per device.
+    reshard_max_inflight_mb: int = 0
 
     # Profiling (reference: utils/config.py:48-50).
     profile: bool = False

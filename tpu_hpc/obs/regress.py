@@ -57,8 +57,13 @@ DEFAULT_TOL = 0.10
 
 # Substrings marking a metric as lower-is-better; everything else
 # (throughput, goodput, MFU, occupancy) regresses by going DOWN.
+# "wire_bytes"/"inflight": reshard-cost metrics (comm/bench.py's
+# reshard rows) -- more bytes over the wire or a higher transient peak
+# is the regression, so the bank diff catches a plan that started
+# moving or materializing more than its history.
 _LOWER_IS_BETTER = (
     "ttft", "itl", "_ms", "latency", "shed", "stall", "queued",
+    "wire_bytes", "inflight",
 )
 
 

@@ -73,7 +73,7 @@ EVENTS: Dict[str, EventSpec] = {
     )),
     # -- the telemetry spine itself (obs/) --
     "span": EventSpec(
-        ("name", "dur_s"), optional=("parent", "depth", "n"),
+        ("name", "dur_s"), optional=("parent", "depth", "n", "tier"),
     ),
     "metrics": EventSpec(("metrics",)),
     "stall": EventSpec(("step", "step_s", "watermark_s", "ratio")),
@@ -115,6 +115,20 @@ EVENTS: Dict[str, EventSpec] = {
     "admission": EventSpec(
         ("action", "occupancy"),
         optional=("rid", "tenant", "reason", "pending", "by_tenant"),
+    ),
+    # -- resharding engine (tpu_hpc/reshard): one record per executed
+    #    plan, modeled wire/peak bytes next to measured moved bytes --
+    "reshard_plan": EventSpec(
+        ("steps", "bytes", "wire_bytes", "peak_inflight_bytes"),
+        optional=(
+            "chunked_steps", "max_inflight_bytes", "bound_met",
+            "kinds", "label", "measured_bytes",
+        ),
+    ),
+    # -- elastic resume (ckpt.restore_latest cross-topology path) --
+    "elastic_restore": EventSpec(
+        ("from_step", "src_mesh", "tgt_mesh"),
+        optional=("plan", "device_count"),
     ),
     # -- supervisor attempt log (resilience/supervisor.py) --
     "attempt_start": EventSpec(("attempt", "cmd")),

@@ -1,0 +1,49 @@
+"""tpu_hpc.reshard -- memory-bounded cross-topology resharding.
+
+The general source->target redistribution engine (arXiv:2112.01075):
+plan any ``NamedSharding`` -> ``NamedSharding`` move -- including
+across meshes of different shapes -- as an introspectable chain of
+bounded steps, then execute it with cached compiled programs.
+
+  plan.py     the planner: exact wire-byte model, step taxonomy,
+              chunked decomposition under ``max_inflight_bytes``.
+  execute.py  the executor: packed identity programs, device_put
+              transfers, chunk slice->move->write assembly; obs spans,
+              the peak-HBM gauge, ``reshard_plan`` events.
+  elastic.py  checkpoint topology sidecars + the elastic-resume
+              restore path (ckpt.restore_latest routes through it when
+              a checkpoint's topology differs from the live mesh).
+
+Consumers in-tree: serve/weights.py (trainer ckpt -> serving layout),
+serve/disagg.py (prefill-tier KV blocks -> decode tier),
+ckpt/checkpoint.py (resume onto a different pod shape), and the
+legacy DP-ckpt -> PP placement in tests/test_pp_llama.py.
+"""
+from tpu_hpc.reshard.elastic import (  # noqa: F401
+    TopologyMismatchError,
+    read_sidecar,
+    topology_of,
+    write_sidecar,
+)
+from tpu_hpc.reshard.execute import apply, execute_plan  # noqa: F401
+from tpu_hpc.reshard.plan import (  # noqa: F401
+    ChunkPlan,
+    ReshardPlan,
+    ReshardStep,
+    modeled_wire_bytes,
+    plan_reshard,
+)
+
+__all__ = [
+    "ChunkPlan",
+    "ReshardPlan",
+    "ReshardStep",
+    "TopologyMismatchError",
+    "apply",
+    "execute_plan",
+    "modeled_wire_bytes",
+    "plan_reshard",
+    "read_sidecar",
+    "topology_of",
+    "write_sidecar",
+]

@@ -1,0 +1,256 @@
+"""Elastic resume: checkpoint topology sidecars + cross-topology restore.
+
+A checkpoint written on one pod shape and restored on another is the
+resilience story's missing half: the supervisor can relaunch a
+preempted run, but only onto the SAME mesh. This module closes that
+gap for ``ckpt.CheckpointManager``:
+
+* every save writes a tiny JSON sidecar (``.tpu_hpc_meta/<step>.json``)
+  recording the mesh axes and per-leaf shardings the state was written
+  with -- the source topology, which orbax's array metadata alone does
+  not surface to the restore path;
+* ``restore_latest`` compares the sidecar against the live template's
+  mesh; when the topologies differ it restores INTO THE SOURCE LAYOUT
+  (rebuilt over the live devices, so no implicit cross-layout movement
+  hides inside orbax) and then runs an explicit
+  :mod:`tpu_hpc.reshard` plan onto the live shardings -- bounded,
+  span-bracketed, and reported as an ``elastic_restore`` event;
+* when a restore fails STRUCTURALLY (wrong model/shape on relaunch --
+  every step fails, unlike a torn newest write), the sidecar lets the
+  error name the source vs. live topology instead of surfacing a
+  generic orbax traceback: :class:`TopologyMismatchError`.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+SIDECAR_DIR = ".tpu_hpc_meta"
+
+
+class TopologyMismatchError(ValueError):
+    """A checkpoint exists but cannot be restored against the live
+    state: the topologies/shapes are structurally incompatible (not a
+    torn write, which only fails the newest step). The message names
+    the source and live topology; for a legitimate pod-shape change
+    the elastic-resume path (docs/guide/resharding.md) handles the
+    move automatically -- this error means the trees themselves
+    disagree."""
+
+
+def _spec_to_json(spec) -> list:
+    out: List[Any] = []
+    for entry in tuple(spec):
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append(list(entry))
+    return out
+
+
+def _spec_from_json(data) -> P:
+    entries = []
+    for entry in data:
+        if entry is None or isinstance(entry, str):
+            entries.append(entry)
+        else:
+            entries.append(tuple(entry))
+    return P(*entries)
+
+
+def _path_leaves(tree) -> List[Tuple[str, Any]]:
+    from tpu_hpc.parallel.plans import _path_str
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(_path_str(path), leaf) for path, leaf in flat]
+
+
+def topology_of(state: Any) -> Optional[dict]:
+    """The topology record for a state tree: mesh axes plus per-leaf
+    shape/dtype/spec. None when no leaf carries a ``NamedSharding``
+    (host-local trees -- nothing cross-topology to record)."""
+    mesh = None
+    leaves: Dict[str, dict] = {}
+    for path, leaf in _path_leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        rec = {
+            "shape": [int(d) for d in getattr(leaf, "shape", ())],
+            "dtype": str(getattr(leaf, "dtype", "")),
+        }
+        if isinstance(sharding, NamedSharding):
+            if mesh is None:
+                mesh = sharding.mesh
+            rec["spec"] = _spec_to_json(sharding.spec)
+        leaves[path] = rec
+    if mesh is None:
+        return None
+    return {
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "device_count": int(mesh.size),
+        "leaves": leaves,
+    }
+
+
+def _sidecar_path(directory: str, step: int) -> str:
+    return os.path.join(directory, SIDECAR_DIR, f"{int(step)}.json")
+
+
+def write_sidecar(directory: str, step: int, state: Any) -> Optional[str]:
+    """Record ``state``'s topology for checkpoint ``step`` (host 0
+    only; other hosts return None). A state with no NamedSharding
+    leaves writes nothing."""
+    if jax.process_index() != 0:
+        return None
+    topo = topology_of(state)
+    if topo is None:
+        return None
+    topo["step"] = int(step)
+    path = _sidecar_path(directory, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(topo, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_sidecar(directory: str, step: int) -> Optional[dict]:
+    """The topology record written with checkpoint ``step``, or None
+    (pre-sidecar checkpoints restore exactly as before)."""
+    try:
+        with open(_sidecar_path(directory, step)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def prune_sidecars(directory: str, keep_steps) -> None:
+    """Drop sidecars whose checkpoint orbax has garbage-collected."""
+    meta = os.path.join(directory, SIDECAR_DIR)
+    try:
+        names = os.listdir(meta)
+    except OSError:
+        return
+    keep = {f"{int(s)}.json" for s in keep_steps}
+    for name in names:
+        if name.endswith(".json") and name not in keep:
+            try:
+                os.remove(os.path.join(meta, name))
+            except OSError:
+                pass
+
+
+def live_mesh_of(template: Any):
+    """The mesh the template's first NamedSharding leaf lives on."""
+    for _, leaf in _path_leaves(template):
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return sharding.mesh
+    return None
+
+
+def needs_reshard(meta: dict, template: Any) -> bool:
+    """True when the checkpoint's mesh axes differ from the live
+    template's -- the cross-topology case the explicit reshard path
+    exists for. Same-mesh spec differences stay on the direct restore
+    (orbax lands bytes straight into the template's shardings)."""
+    mesh = live_mesh_of(template)
+    if mesh is None:
+        return False
+    live = {k: int(v) for k, v in mesh.shape.items()}
+    return meta.get("mesh") != live
+
+
+def describe_mismatch(meta: dict, template: Any) -> Optional[str]:
+    """Human-readable structural difference between a sidecar and the
+    live template, or None when the structures agree (the failure was
+    not topological)."""
+    saved = meta.get("leaves", {})
+    live = {
+        path: [int(d) for d in getattr(leaf, "shape", ())]
+        for path, leaf in _path_leaves(template)
+    }
+    missing = sorted(set(saved) - set(live))
+    extra = sorted(set(live) - set(saved))
+    if missing or extra:
+        return (
+            f"tree structure differs: {len(missing)} leaf/leaves only "
+            f"in the checkpoint (first: {missing[:3]}), {len(extra)} "
+            f"only in the live state (first: {extra[:3]})"
+        )
+    for path, shape in live.items():
+        got = saved[path].get("shape")
+        if got != shape:
+            return (
+                f"leaf {path!r} has shape {got} in the checkpoint but "
+                f"{shape} in the live state (wrong model config?)"
+            )
+    return None
+
+
+def source_template(meta: dict, template: Any) -> Optional[Any]:
+    """The checkpoint's own layout, rebuilt over the live devices: a
+    template whose leaves carry the SOURCE shardings, so the restore
+    lands bytes exactly as written and the explicit reshard plan owns
+    every cross-layout move.
+
+    None when the source mesh cannot be built from the live process's
+    devices (a grown-then-shrunk world where the source needed more
+    chips than exist now) -- the caller falls back to the direct
+    orbax restore, which handles that case opaquely but correctly.
+    Raises :class:`TopologyMismatchError` when the tree structure
+    itself disagrees (a reshard cannot fix a wrong model).
+    """
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+
+    mismatch = describe_mismatch(meta, template)
+    if mismatch is not None:
+        raise TopologyMismatchError(
+            f"checkpoint (mesh {meta.get('mesh')}) is structurally "
+            f"incompatible with the live state: {mismatch}"
+        )
+    axes = meta.get("mesh") or {}
+    total = math.prod(axes.values()) if axes else 0
+    devices = jax.devices()
+    if total < 1 or total > len(devices):
+        return None
+    src_mesh = build_mesh(
+        MeshSpec(axes=dict(axes)), devices=devices[:total]
+    )
+    saved = meta["leaves"]
+
+    def leaf_template(path, leaf):
+        rec = saved[path]
+        spec = rec.get("spec")
+        sharding = NamedSharding(
+            src_mesh,
+            _spec_from_json(spec) if spec is not None else P(),
+        )
+        # LIVE dtype, deliberately: orbax casts into the template's
+        # dtype at restore time, so a dtype switch on relaunch (the
+        # fp32->bf16 moments unlock) behaves identically on the
+        # elastic path and the direct path -- the reshard then moves
+        # already-cast bytes. Dtype differences are a legal config
+        # change, never a structural mismatch.
+        return jax.ShapeDtypeStruct(
+            tuple(leaf.shape), leaf.dtype, sharding=sharding
+        )
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    from tpu_hpc.parallel.plans import _path_str
+
+    leaves = [
+        leaf_template(_path_str(path), leaf) for path, leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def target_shardings(template: Any) -> Any:
+    """The live template's shardings, as a matching pytree -- the
+    reshard targets for the elastic path."""
+    return jax.tree.map(lambda leaf: leaf.sharding, template)

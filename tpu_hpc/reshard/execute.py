@@ -1,0 +1,484 @@
+"""The reshard executor: run a :class:`~tpu_hpc.reshard.plan.ReshardPlan`.
+
+Execution discipline, per step kind (plan.py documents the taxonomy):
+
+* same-mesh unchunked steps are PACKED into joint jitted-identity
+  programs (one dispatch moves many leaves), greedily bounded so the
+  summed conservative transient of each program stays under the plan's
+  ``max_inflight_bytes``;
+* cross-mesh (``transfer``) and host (``place``) steps go through
+  ``jax.device_put``, batched the same bounded way;
+* chunked steps run the paper's decomposition: preallocate the target,
+  then per chunk slice -> move -> dynamic-update-slice, each chunk its
+  own program so XLA can never fuse the transient footprints together.
+
+Every compiled program is cached INSIDE the plan, keyed by step/chunk,
+so a held plan replays with zero recompiles -- the property the
+disaggregated serve tier's per-bucket KV plans and the elastic restore
+path rely on.
+
+Observability: each execution is bracketed in a ``reshard`` span, emits
+one schema-stamped ``reshard_plan`` event -- modeled wire/peak bytes
+next to ``measured_bytes``, the payload the executor actually moved,
+summed from the OUTPUT arrays at runtime (an accounting cross-check on
+the plan, not a hardware wire counter) -- sets the
+``reshard_inflight_bytes`` gauge around every stage and the
+``reshard_peak_hbm_bytes`` gauge to the execution's modeled per-device
+peak (transient + target residency), and counts
+``reshard_wire_bytes_total``.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from tpu_hpc.obs import get_bus, get_registry, span
+from tpu_hpc.reshard.plan import (
+    ReshardPlan,
+    ReshardStep,
+    _spec_without_axis,
+    _chunk_offsets,
+)
+
+
+def _chunk_src_sharding(step: ReshardStep):
+    ax = step.chunk.axis
+    src = step.src_sharding
+    return NamedSharding(src.mesh, _spec_without_axis(src.spec, ax))
+
+
+def _chunk_tgt_sharding(step: ReshardStep):
+    ax = step.chunk.axis
+    tgt = step.tgt_sharding
+    return NamedSharding(tgt.mesh, _spec_without_axis(tgt.spec, ax))
+
+
+def _slice_program(plan: ReshardPlan, idx: int, a: int, b: int):
+    """Slice rows [a, b) of the source and land them in the chunk
+    layout (target layout on every other dim, whole along the chunk
+    axis). Same-mesh plans reshard in the same program; cross-mesh
+    plans keep the chunk on the source mesh (minus the chunk axis) and
+    let device_put carry it across.
+
+    The offset is deliberately STATIC (one program per chunk, not per
+    chunk length): a traced-offset dynamic_slice along a sharded dim
+    forces GSPMD to rematerialize the FULL operand per device (it
+    cannot know at compile time which shards contribute), which
+    silently voids the max_inflight_bytes contract -- measured on the
+    sim mesh: the traced-offset program's largest live tensor is the
+    whole array. O(chunks) compiles are the price of the bound, and
+    they amortize: programs are cached on the plan."""
+    step = plan.steps[idx]
+    key = ("slice", idx, a)
+    if key not in plan._programs:
+        ax = step.chunk.axis
+        out = (
+            _chunk_tgt_sharding(step) if step.same_mesh
+            else _chunk_src_sharding(step)
+        )
+        plan._programs[key] = jax.jit(
+            lambda x: jax.lax.slice_in_dim(x, a, b, axis=ax),
+            out_shardings=out,
+        )
+    return plan._programs[key]
+
+
+def _init_program(plan: ReshardPlan, idx: int):
+    step = plan.steps[idx]
+    key = ("init", idx)
+    if key not in plan._programs:
+        dtype = np.dtype(step.dtype)
+        shape = step.shape
+        plan._programs[key] = jax.jit(
+            lambda: jnp.zeros(shape, dtype),
+            out_shardings=step.tgt_sharding,
+        )
+    return plan._programs[key]
+
+
+def _write_program(plan: ReshardPlan, idx: int, a: int):
+    """Write one landed chunk into the preallocated target at STATIC
+    offset ``a`` (static for the same GSPMD-rematerialization reason
+    as the slice side). The buffer is donated -- the target is built
+    in place, so the assembly never holds two copies of it."""
+    step = plan.steps[idx]
+    key = ("write", idx, a)
+    if key not in plan._programs:
+        ax = step.chunk.axis
+        plan._programs[key] = jax.jit(
+            lambda buf, c: jax.lax.dynamic_update_slice_in_dim(
+                buf, c, a, axis=ax
+            ),
+            donate_argnums=(0,),
+            out_shardings=step.tgt_sharding,
+        )
+    return plan._programs[key]
+
+
+def _run_chunked(plan: ReshardPlan, idx: int, leaf):
+    step = plan.steps[idx]
+    extent = step.shape[step.chunk.axis]
+    buf = _init_program(plan, idx)()
+    for a, b in _chunk_offsets(extent, step.chunk.size):
+        chunk = _slice_program(plan, idx, a, b)(leaf)
+        if not step.same_mesh:
+            chunk = jax.device_put(chunk, _chunk_tgt_sharding(step))
+        buf = _write_program(plan, idx, a)(buf, chunk)
+    return buf
+
+
+def _stages(
+    plan: ReshardPlan, copy_noop: bool = False
+) -> List[Tuple[str, Any]]:
+    """Group steps into execution stages (cached on the plan):
+
+    ``("pass", i)`` noop passthrough; ``("chunked", i)`` one chunked
+    step; ``("jit", (indices...))`` packed same-mesh identity program;
+    ``("dput", (indices...))`` packed device_put batch. Packs are
+    bounded by the plan's ``max_inflight_bytes`` over the summed
+    conservative transients. ``copy_noop=True`` routes noop leaves
+    through the identity program too (fresh buffers instead of
+    aliasing the input -- safe since a noop's source assignment equals
+    the target's, whatever mesh spelled it)."""
+    key = ("stages", copy_noop)
+    if key in plan._programs:
+        return plan._programs[key]
+    bound = plan.max_inflight_bytes
+    stages: List[Tuple[str, Any]] = []
+    jit_groups = {}   # target mesh -> (indices, inflight sum)
+    dput: Tuple[list, int] = ([], 0)
+
+    def flush_jit(gkey):
+        idxs, _ = jit_groups.pop(gkey)
+        if idxs:
+            stages.append(("jit", tuple(idxs)))
+
+    def flush_dput():
+        nonlocal dput
+        if dput[0]:
+            stages.append(("dput", tuple(dput[0])))
+        dput = ([], 0)
+
+    def pack_jit(i, step):
+        gkey = step.tgt_sharding.mesh
+        idxs, acc = jit_groups.get(gkey, ([], 0))
+        if bound is not None and idxs and (
+            acc + step.inflight_bytes > bound
+        ):
+            jit_groups[gkey] = (idxs, acc)
+            flush_jit(gkey)
+            idxs, acc = [], 0
+        idxs.append(i)
+        jit_groups[gkey] = (idxs, acc + step.inflight_bytes)
+
+    for i, step in enumerate(plan.steps):
+        if step.kind == "noop":
+            if copy_noop:
+                pack_jit(i, step)
+            else:
+                stages.append(("pass", i))
+        elif step.chunk is not None:
+            stages.append(("chunked", i))
+        elif step.same_mesh or step.kind == "place":
+            # "place" (host/uncommitted source) rides the identity
+            # program too: jit commits the input AND guarantees fresh
+            # output buffers, where device_put may alias a resident
+            # single-device buffer into the output.
+            pack_jit(i, step)
+        else:
+            idxs, acc = dput
+            if bound is not None and idxs and (
+                acc + step.inflight_bytes > bound
+            ):
+                flush_dput()
+                idxs, acc = dput
+            idxs.append(i)
+            dput = (idxs, acc + step.inflight_bytes)
+    for gkey in list(jit_groups):
+        flush_jit(gkey)
+    flush_dput()
+    plan._programs[key] = stages
+    return stages
+
+
+def _may_alias(step: ReshardStep) -> bool:
+    """Whether a device_put for this step can return buffers shared
+    with the source: only possible when source and target device sets
+    overlap (jax reuses resident per-device buffers)."""
+    src = step.src_sharding
+    if src is None:
+        return True  # uncommitted single-device source: resident
+    return bool(
+        set(src.device_set) & set(step.tgt_sharding.device_set)
+    )
+
+
+def _fresh_copy_program(plan: ReshardPlan, idx: int):
+    """Same-mesh identity on the TARGET sharding: jit outputs never
+    alias non-donated inputs, so this severs any device_put aliasing."""
+    key = ("fresh", idx)
+    if key not in plan._programs:
+        plan._programs[key] = jax.jit(
+            lambda t: t, out_shardings=plan.steps[idx].tgt_sharding
+        )
+    return plan._programs[key]
+
+
+def _jit_stage_program(plan: ReshardPlan, idxs, donate: bool):
+    key = ("jit", idxs, donate)
+    if key not in plan._programs:
+        out = tuple(plan.steps[i].tgt_sharding for i in idxs)
+        # Host-sourced ("place") operands are not device buffers;
+        # donating them only produces XLA warnings, so they are
+        # excluded from the donation set.
+        donatable = tuple(
+            k for k, i in enumerate(idxs)
+            if plan.steps[i].kind != "place"
+        ) if donate else ()
+        plan._programs[key] = jax.jit(
+            lambda *xs: xs,
+            out_shardings=out,
+            donate_argnums=donatable,
+        )
+    return plan._programs[key]
+
+
+def _stage_inflight(plan: ReshardPlan, stage) -> int:
+    kind, payload = stage
+    if kind in ("pass",):
+        return 0
+    if kind == "chunked":
+        return plan.steps[payload].inflight_bytes
+    return sum(plan.steps[i].inflight_bytes for i in payload)
+
+
+def step_program_texts(
+    plan: ReshardPlan, index: int, compiled: bool = True
+) -> List[str]:
+    """The XLA program texts step ``index`` runs, lowered from
+    abstract operands -- the introspection hook behind
+    ``ReshardPlan.step_hlo``.
+
+    Chunked steps lower THE SAME cached jitted callables the executor
+    runs (``_init_program``/``_slice_program``/``_write_program``,
+    donation flags included), so the bound-checked HLO cannot drift
+    from the executed programs. Unchunked same-mesh steps lower a
+    single-leaf identity (execution may pack several leaves into one
+    program; the per-leaf collectives are the same, the packing is
+    reported by the peak-HBM gauge). Cross-mesh hops (device_put)
+    have no jit-visible program and contribute no text."""
+    step = plan.steps[index]
+
+    def text(jfn, *avals):
+        low = jfn.lower(*avals)
+        return (low.compile().as_text() if compiled else low.as_text())
+
+    if step.kind == "noop":
+        return []
+    dtype = np.dtype(step.dtype)
+    src_aval = jax.ShapeDtypeStruct(
+        step.shape, dtype, sharding=step.src_sharding
+    ) if step.src_sharding is not None else None
+    tgt_aval = jax.ShapeDtypeStruct(
+        step.shape, dtype, sharding=step.tgt_sharding
+    )
+    if step.chunk is None:
+        if not step.same_mesh or step.src_sharding is None:
+            return []  # plain device_put
+        return [text(
+            jax.jit(lambda x: x, out_shardings=step.tgt_sharding),
+            src_aval,
+        )]
+    ax = step.chunk.axis
+    texts = [text(_init_program(plan, index))]
+    chunk_tgt = _chunk_tgt_sharding(step)
+    for a, b in _chunk_offsets(step.shape[ax], step.chunk.size):
+        if step.src_sharding is not None:
+            texts.append(
+                text(_slice_program(plan, index, a, b), src_aval)
+            )
+        cshape = list(step.shape)
+        cshape[ax] = b - a
+        texts.append(text(
+            _write_program(plan, index, a),
+            tgt_aval,
+            jax.ShapeDtypeStruct(tuple(cshape), dtype,
+                                 sharding=chunk_tgt),
+        ))
+    return texts
+
+
+def execute_plan(
+    plan: ReshardPlan,
+    tree: Any,
+    *,
+    donate: bool = False,
+    copy_noop: bool = False,
+    sink: Optional[str] = None,
+) -> Any:
+    """Execute ``plan`` on ``tree``; returns the target-placed tree.
+
+    ``donate=True`` transfers ownership of the source buffers: packed
+    identity programs donate their inputs, chunked sources and
+    disjoint-device transfers are explicitly deleted as soon as their
+    stage's target materializes, and the remaining (possibly-aliased
+    overlapping-set) sources are dropped by reference -- the caller
+    must not touch the input tree afterwards. Leave False when the
+    caller keeps using the input. ``copy_noop=True`` additionally
+    gives already-placed (noop) leaves fresh buffers instead of
+    aliasing the input -- the serve weight placement's fresh-buffer
+    contract.
+    """
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    if treedef != plan.treedef:
+        raise ValueError(
+            f"tree structure does not match the plan: {treedef} vs "
+            f"{plan.treedef}"
+        )
+    if len(flat) != len(plan.steps):
+        raise ValueError(
+            f"{len(flat)} leaves vs {len(plan.steps)} planned steps"
+        )
+    for leaf, step in zip(flat, plan.steps):
+        if tuple(leaf.shape) != step.shape or (
+            np.dtype(leaf.dtype) != np.dtype(step.dtype)
+        ):
+            raise ValueError(
+                f"leaf {step.path}: {tuple(leaf.shape)}/{leaf.dtype} "
+                f"does not match the planned {step.shape}/{step.dtype}"
+            )
+    out: List[Any] = [None] * len(flat)
+    reg = get_registry()
+    stages = _stages(plan, copy_noop)
+    # Modeled per-device peak while a STAGE runs: packed stages move
+    # many leaves in one program, so the footprint is the per-stage
+    # SUM of (source shard still live + target shard being built +
+    # transient), not the largest single step.
+    def _stage_hbm(stage):
+        kind, payload = stage
+        idxs = (payload,) if kind in ("pass", "chunked") else payload
+        return sum(
+            plan.steps[i].src_resident_bytes
+            + plan.steps[i].resident_bytes
+            + plan.steps[i].inflight_bytes
+            for i in idxs
+        )
+
+    peak_hbm = max((_stage_hbm(s) for s in stages), default=0)
+    moved = 0
+
+    def release(indices, chunked=False):
+        # donate=True ownership transfer for the paths jit donation
+        # cannot cover (device_put transfers, chunked assemblies):
+        # drop the source buffers as soon as the stage's target is
+        # resident, so the peak never holds both full layouts.
+        #
+        # Deleting is only safe when the target CANNOT share buffers
+        # with the source: chunked assemblies qualify always (the
+        # source is read by non-donating jit slice programs, whose
+        # outputs are fresh), a plain device_put only when the source
+        # and target device sets are disjoint -- jax reuses resident
+        # per-device buffers for overlapping sets (a replicated scalar
+        # moved onto a sub-mesh comes back aliased), and deleting the
+        # source would kill the output. Overlapping-set sources just
+        # drop our reference and free by refcount.
+        if not donate:
+            return
+        for i in indices:
+            arr = flat[i]
+            step = plan.steps[i]
+            flat[i] = None
+            if not isinstance(arr, jax.Array) or arr is out[i]:
+                continue
+            if not chunked:
+                src = step.src_sharding
+                if src is None or (
+                    set(src.device_set) & set(
+                        step.tgt_sharding.device_set
+                    )
+                ):
+                    continue
+            try:
+                arr.delete()
+            except RuntimeError:
+                pass  # already deleted (duplicate-leaf trees)
+
+    with span("reshard", sink=sink, n=len(plan.steps),
+              hist="reshard_execute_s"):
+        for stage in stages:
+            kind, payload = stage
+            reg.set_gauge(
+                "reshard_inflight_bytes", _stage_inflight(plan, stage)
+            )
+            if kind == "pass":
+                out[payload] = flat[payload]
+            elif kind == "chunked":
+                out[payload] = _run_chunked(plan, payload, flat[payload])
+                moved += out[payload].nbytes
+                release((payload,), chunked=True)
+            elif kind == "jit":
+                prog = _jit_stage_program(plan, payload, donate)
+                results = prog(*(flat[i] for i in payload))
+                for i, r in zip(payload, results):
+                    out[i] = r
+                    moved += r.nbytes
+            else:  # dput
+                arrs = [flat[i] for i in payload]
+                shardings = [
+                    plan.steps[i].tgt_sharding for i in payload
+                ]
+                results = jax.device_put(arrs, shardings)
+                for i, r in zip(payload, results):
+                    if copy_noop and _may_alias(plan.steps[i]):
+                        # Fresh-buffer contract on the device_put
+                        # path: overlapping-device-set transfers may
+                        # hand back buffers aliased with the source;
+                        # a same-mesh identity copy on the TARGET
+                        # severs the aliasing.
+                        r = _fresh_copy_program(plan, i)(r)
+                    out[i] = r
+                    moved += r.nbytes
+                del arrs
+                release(payload)
+        reg.set_gauge("reshard_inflight_bytes", 0)
+    reg.set_gauge("reshard_peak_hbm_bytes", peak_hbm)
+    reg.inc("reshard_wire_bytes_total", plan.wire_bytes)
+    reg.inc("reshard_executions_total")
+    get_bus().emit(
+        "reshard_plan",
+        sink=sink,
+        label=plan.label,
+        measured_bytes=moved,
+        **plan.summary(),
+    )
+    return jax.tree_util.tree_unflatten(plan.treedef, out)
+
+
+def apply(
+    tree: Any,
+    targets: Any,
+    *,
+    max_inflight_bytes: Optional[int] = None,
+    donate: bool = False,
+    copy_noop: bool = False,
+    label: Optional[str] = None,
+    sink: Optional[str] = None,
+) -> Any:
+    """Plan + execute in one call: reshard ``tree`` onto ``targets``
+    (a matching pytree of shardings, or one sharding for every leaf).
+    For repeated moves of same-shaped trees build the plan once with
+    :func:`~tpu_hpc.reshard.plan.plan_reshard` and call
+    ``plan.execute`` -- the compiled programs are cached on the plan."""
+    from tpu_hpc.reshard.plan import plan_reshard
+
+    plan = plan_reshard(
+        tree, targets, max_inflight_bytes=max_inflight_bytes,
+        label=label,
+    )
+    return execute_plan(
+        plan, tree, donate=donate, copy_noop=copy_noop, sink=sink
+    )

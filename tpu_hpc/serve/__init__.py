@@ -5,8 +5,11 @@ mesh-sharded KV cache driven by AOT-compiled prefill/decode programs
 (engine), continuous batching over fixed slots (scheduler), trainer
 checkpoints resharded into the serving layout (weights), TTFT/ITL/
 throughput accounting (metrics), and a local request-replay CLI
-(``python -m tpu_hpc.serve``, server).
+(``python -m tpu_hpc.serve``, server). ``--disagg`` splits the
+engine into disaggregated prefill/decode tiers with KV blocks moved
+across by tpu_hpc.reshard plans (disagg).
 """
+from tpu_hpc.serve.disagg import DisaggEngine, split_serving_meshes
 from tpu_hpc.serve.engine import Engine, ServeConfig
 from tpu_hpc.serve.metrics import ServeMeter
 from tpu_hpc.serve.scheduler import (
@@ -24,6 +27,7 @@ from tpu_hpc.serve.weights import (
 __all__ = [
     "AdmissionPolicy",
     "ContinuousBatcher",
+    "DisaggEngine",
     "Engine",
     "Request",
     "ServeConfig",
@@ -32,4 +36,5 @@ __all__ = [
     "place_params",
     "replay_requests",
     "serving_pspecs",
+    "split_serving_meshes",
 ]

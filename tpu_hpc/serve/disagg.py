@@ -1,0 +1,328 @@
+"""Disaggregated prefill/decode: two engine tiers, one request stream.
+
+Prefill and decode want different hardware economics: prefill is a
+compute-bound batch job over a whole prompt, decode a latency-bound
+single-token tick whose batch the continuous batcher keeps full. Run
+them on the SAME chips and every admission's prefill stalls the decode
+batch for a full prompt's worth of FLOPs. The disaggregated tier
+(the splitwise/distserve deployment shape) gives each phase its own
+mesh slice:
+
+* the **prefill tier** runs the bucketed prefill programs and writes
+  the prompt's K/V into its own (transient) cache rows;
+* the KV block then crosses to the **decode tier** as an explicit
+  :mod:`tpu_hpc.reshard` plan -- planned once per bucket at warmup,
+  executed with cached programs (zero steady-state recompiles),
+  bounded by ``max_inflight_bytes``, and span-bracketed as
+  ``kv_transfer`` so TTFT decomposes into prefill-tier time + hop
+  time on the same obs spine the meter uses;
+* the **decode tier** owns the resident KV cache and the per-tick
+  decode program, exactly as in the single-tier engine.
+
+:class:`DisaggEngine` presents the single-tier :class:`Engine`
+interface (``prefill``/``decode``/``warmup``/``compile_count``), so
+the continuous batcher and the replay server drive it unchanged, and
+the token-exactness oracle in tests/test_serve.py applies verbatim:
+greedy decode through the disaggregated path must equal the no-cache
+forward pass token for token.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_hpc.models import llama2
+from tpu_hpc.obs import span
+from tpu_hpc.serve.engine import Engine, ServeConfig
+
+
+def split_serving_meshes(
+    n_devices: int,
+    cfg: llama2.LlamaConfig,
+    prefill_devices: Optional[int] = None,
+) -> Tuple[Mesh, Mesh]:
+    """Disjoint (prefill_mesh, decode_mesh) tiers over the visible
+    chips: the first ``prefill_devices`` (default: half) prefill, the
+    rest decode. Each tier uses the same auto TP-capped split policy
+    as the single-tier serving mesh (tp.auto_mesh_axes), so per-tier
+    collective signatures match what the flat engine would run."""
+    from tpu_hpc.parallel import tp
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+
+    if n_devices < 2:
+        raise ValueError(
+            f"disaggregated serving needs >= 2 devices (one per "
+            f"tier), got {n_devices}"
+        )
+    k = prefill_devices if prefill_devices is not None else n_devices // 2
+    if not 1 <= k < n_devices:
+        raise ValueError(
+            f"prefill tier of {k} device(s) leaves "
+            f"{n_devices - k} for decode (need >= 1 each of "
+            f"{n_devices})"
+        )
+    devs = jax.devices()[:n_devices]
+    prefill_mesh = build_mesh(
+        MeshSpec(axes=tp.auto_mesh_axes(
+            k, cfg.n_heads, cfg.kv_heads, cap=4
+        )),
+        devices=devs[:k],
+    )
+    decode_mesh = build_mesh(
+        MeshSpec(axes=tp.auto_mesh_axes(
+            n_devices - k, cfg.n_heads, cfg.kv_heads, cap=4
+        )),
+        devices=devs[k:],
+    )
+    return prefill_mesh, decode_mesh
+
+
+def _kv_rows_pspec(mesh: Mesh, kv_heads: int) -> P:
+    """Layout for one request's extracted KV rows
+    ``[layers, 1, bucket, kv_heads, head_dim]``: KV heads over
+    ``model`` where that axis exists and divides (matching the cache),
+    everything else whole."""
+    names = set(mesh.axis_names)
+    model = (
+        "model"
+        if "model" in names and mesh.shape["model"] > 1
+        and kv_heads % mesh.shape["model"] == 0
+        else None
+    )
+    return P(None, None, None, model, None)
+
+
+class DisaggEngine:
+    """Prefill on one mesh tier, decode on another, KV blocks moved by
+    per-bucket reshard plans. Drop-in for :class:`Engine` from the
+    batcher's point of view."""
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: llama2.LlamaConfig,
+        serve_cfg: ServeConfig,
+        prefill_mesh: Mesh,
+        decode_mesh: Mesh,
+        max_inflight_bytes: Optional[int] = None,
+    ):
+        shared = set(prefill_mesh.devices.flat) & set(
+            decode_mesh.devices.flat
+        )
+        if shared:
+            raise ValueError(
+                f"prefill and decode tiers share {len(shared)} "
+                "device(s); disaggregation needs disjoint tiers"
+            )
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg
+        self.max_inflight_bytes = max_inflight_bytes
+        # Both tiers place the same param tree onto their own mesh --
+        # the decode tier is the latency-critical one and keeps the
+        # single-tier layout; the prefill tier is throughput-bound and
+        # uses the same TP split on its own chips.
+        self.prefill_engine = Engine(params, cfg, serve_cfg,
+                                     prefill_mesh)
+        self.decode_engine = Engine(params, cfg, serve_cfg,
+                                    decode_mesh)
+        self.mesh = decode_mesh  # the resident (decode) tier
+        self.prefill_mesh = prefill_mesh
+        self.decode_mesh = decode_mesh
+        self.cache_bytes = (
+            self.prefill_engine.cache_bytes
+            + self.decode_engine.cache_bytes
+        )
+        self._aot_builds = 0
+        self._extract: Dict[int, Any] = {}
+        self._insert: Dict[int, Any] = {}
+        self._plans: Dict[int, Any] = {}
+        self.transfer_stats = {
+            "kv_transfers": 0, "kv_transfer_bytes": 0,
+        }
+        # Per-ENGINE hop samples for the summary quantiles: the obs
+        # registry histogram is process-wide (a second replay in the
+        # same process would blend runs), so the engine owns its own
+        # window. Warmup's dummy transfers bypass prefill() and stay
+        # out of it.
+        self._hop_s: list = []
+
+    # -- executable/plans table ---------------------------------------
+    @property
+    def compile_count(self) -> int:
+        """Every compiled program across both tiers and the transfer
+        path: the two engines' executable tables, this tier's AOT
+        extract/insert programs, and the reshard plans' cached
+        programs. After :meth:`warmup` it must stay put -- the same
+        zero-recompile guard the single-tier engine pins."""
+        return (
+            self.prefill_engine.compile_count
+            + self.decode_engine.compile_count
+            + self._aot_builds
+            + sum(
+                p.compiled_program_count for p in self._plans.values()
+            )
+        )
+
+    def _rows_shape(self, bucket: int) -> Tuple[int, ...]:
+        c = self.cfg
+        return (c.n_layers, 1, bucket, c.kv_heads, c.head_dim)
+
+    def _build_bucket(self, bucket: int) -> None:
+        """Extract (prefill tier), transfer plan (cross-tier), insert
+        (decode tier) for one prefill bucket, all AOT so steady state
+        never compiles."""
+        from tpu_hpc import reshard
+
+        c = self.cfg
+        pe, de = self.prefill_engine, self.decode_engine
+        rows = self._rows_shape(bucket)
+        src_sh = NamedSharding(
+            self.prefill_mesh,
+            _kv_rows_pspec(self.prefill_mesh, c.kv_heads),
+        )
+        tgt_sh = NamedSharding(
+            self.decode_mesh,
+            _kv_rows_pspec(self.decode_mesh, c.kv_heads),
+        )
+        cache_p = pe._cache_abstract()
+        cache_d = de._cache_abstract()
+        slot_p = jax.ShapeDtypeStruct((), jnp.int32, sharding=pe._rep)
+        slot_d = jax.ShapeDtypeStruct((), jnp.int32, sharding=de._rep)
+
+        def extract(ks, vs, slot):
+            size = (c.n_layers, 1, bucket, c.kv_heads, c.head_dim)
+            start = (0, slot, 0, 0, 0)
+            return (
+                jax.lax.dynamic_slice(ks, start, size),
+                jax.lax.dynamic_slice(vs, start, size),
+            )
+
+        self._extract[bucket] = jax.jit(
+            extract, out_shardings=(src_sh, src_sh)
+        ).lower(cache_p, cache_p, slot_p).compile()
+        self._aot_builds += 1
+
+        def insert(ks, vs, k_rows, v_rows, slot):
+            start = (0, slot, 0, 0, 0)
+            return (
+                jax.lax.dynamic_update_slice(ks, k_rows, start),
+                jax.lax.dynamic_update_slice(vs, v_rows, start),
+            )
+
+        rows_abs = jax.ShapeDtypeStruct(
+            rows, de.ks.dtype, sharding=tgt_sh
+        )
+        self._insert[bucket] = jax.jit(
+            insert,
+            donate_argnums=(0, 1),
+            out_shardings=(de._cache_sharding, de._cache_sharding),
+        ).lower(cache_d, cache_d, rows_abs, rows_abs, slot_d).compile()
+        self._aot_builds += 1
+
+        abstract = {
+            "k": jax.ShapeDtypeStruct(rows, pe.ks.dtype,
+                                      sharding=src_sh),
+            "v": jax.ShapeDtypeStruct(rows, pe.ks.dtype,
+                                      sharding=src_sh),
+        }
+        self._plans[bucket] = reshard.plan_reshard(
+            abstract, {"k": tgt_sh, "v": tgt_sh},
+            max_inflight_bytes=self.max_inflight_bytes,
+            label=f"kv_transfer_b{bucket}",
+        )
+
+    def warmup(self) -> int:
+        """Compile both tiers' program tables, the per-bucket
+        extract/insert executables, and (by a dummy zero-block
+        transfer) every reshard-plan program. Returns the total
+        compiled-program count; after this ``compile_count`` must
+        never move."""
+        self.prefill_engine.warmup()
+        self.decode_engine.warmup()
+        for b in self.serve_cfg.prefill_buckets:
+            self._build_bucket(b)
+            # Dummy transfer of the (all-zero) slot-0 rows: compiles
+            # every plan program now, writes zeros over zeros.
+            self._move_kv(b, 0)
+        return self.compile_count
+
+    # -- serving ops ---------------------------------------------------
+    def _move_kv(self, bucket: int, slot: int) -> int:
+        """One request's KV rows: prefill cache -> decode cache, via
+        the bucket's cached reshard plan. Returns bytes moved."""
+        pe, de = self.prefill_engine, self.decode_engine
+        k, v = self._extract[bucket](
+            pe.ks, pe.vs, pe._rep_arr(slot)
+        )
+        moved = self._plans[bucket].execute({"k": k, "v": v})
+        de.ks, de.vs = self._insert[bucket](
+            de.ks, de.vs, moved["k"], moved["v"], de._rep_arr(slot)
+        )
+        # Block until the decode cache actually holds the rows: every
+        # hop timer (the kv_transfer span, the _hop_s quantiles)
+        # wraps this call, and async dispatch would otherwise read as
+        # a microsecond hop while the real copy cost leaked into the
+        # next decode tick's ITL -- the same dispatch-to-result
+        # bracketing Engine.prefill and comm/bench.py use.
+        de.ks.block_until_ready()
+        de.vs.block_until_ready()
+        return int(k.nbytes + v.nbytes)
+
+    def prefill(self, slot: int, prompt: Sequence[int]) -> int:
+        """Prefill on the prefill tier, then ship the slot's KV block
+        to the decode tier. The hop rides in a ``kv_transfer`` span
+        (tier-tagged), so TTFT = prefill span + kv_transfer span on
+        one timeline."""
+        import time
+
+        tok = self.prefill_engine.prefill(slot, prompt)
+        bucket = self.serve_cfg.bucket_for(len(prompt))
+        t0 = time.perf_counter()
+        with span(
+            "kv_transfer", tier="transfer",
+            hist="serve_kv_transfer_s", n=bucket,
+        ):
+            nbytes = self._move_kv(bucket, slot)
+        self._hop_s.append(time.perf_counter() - t0)
+        self.transfer_stats["kv_transfers"] += 1
+        self.transfer_stats["kv_transfer_bytes"] += nbytes
+        return tok
+
+    def decode(
+        self, tokens: Sequence[int], positions: Sequence[int]
+    ) -> np.ndarray:
+        return self.decode_engine.decode(tokens, positions)
+
+    def describe(self) -> dict:
+        """The summary block the replay server reports per tier,
+        hop-latency quantiles included (this engine's own samples)."""
+        from tpu_hpc.obs import quantile
+
+        plans = {
+            b: p.summary() for b, p in sorted(self._plans.items())
+        }
+        hops = sorted(self._hop_s)
+        return {
+            "kv_transfer_ms_p50": round(
+                quantile(hops, 0.50) * 1e3, 3
+            ) if hops else 0.0,
+            "kv_transfer_ms_p95": round(
+                quantile(hops, 0.95) * 1e3, 3
+            ) if hops else 0.0,
+            "prefill_mesh": {
+                k: int(v) for k, v in self.prefill_mesh.shape.items()
+            },
+            "decode_mesh": {
+                k: int(v) for k, v in self.decode_mesh.shape.items()
+            },
+            "max_inflight_bytes": self.max_inflight_bytes,
+            "kv_transfers": self.transfer_stats["kv_transfers"],
+            "kv_transfer_bytes": self.transfer_stats[
+                "kv_transfer_bytes"
+            ],
+            "kv_plans": plans,
+        }

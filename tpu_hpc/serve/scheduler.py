@@ -386,6 +386,13 @@ class ContinuousBatcher:
         # even if the last transition was shed-from-pending (which
         # never touches a slot).
         self._set_occupancy()
+        # Disaggregated engines count their cross-tier KV hops; fold
+        # them into the batcher stats so the replay summary (and the
+        # regress gate reading it) sees the transfer load next to
+        # admissions/evictions.
+        transfer = getattr(self.engine, "transfer_stats", None)
+        if transfer:
+            self.stats.update(transfer)
         return self.results
 
 

@@ -72,8 +72,13 @@ def run_replay(
     checkpoint_dir: Optional[str] = None,
     metrics_path: Optional[str] = None,
     seed: int = 0,
+    disagg: bool = False,
+    disagg_max_inflight_mb: Optional[int] = None,
 ) -> dict:
-    """Engine bring-up + warmup + replay; returns the summary dict."""
+    """Engine bring-up + warmup + replay; returns the summary dict.
+    ``disagg=True`` splits the chips into disaggregated prefill/decode
+    tiers (serve/disagg.py), KV blocks crossing via bounded reshard
+    plans (``disagg_max_inflight_mb``)."""
     import jax
 
     from tpu_hpc.serve.engine import Engine
@@ -84,7 +89,18 @@ def run_replay(
 
     from tpu_hpc import obs
 
-    mesh = build_serving_mesh(jax.device_count(), cfg)
+    if disagg:
+        from tpu_hpc.serve.disagg import (
+            DisaggEngine,
+            split_serving_meshes,
+        )
+
+        prefill_mesh, decode_mesh = split_serving_meshes(
+            jax.device_count(), cfg
+        )
+        mesh = decode_mesh  # the resident tier: restore targets it
+    else:
+        mesh = build_serving_mesh(jax.device_count(), cfg)
     # Bring-up phases as spans: restore-vs-compile time is the first
     # question about any slow serving start, and these records (to
     # ``metrics_path`` + the flight ring) answer it without a profiler
@@ -95,7 +111,16 @@ def run_replay(
             params = load_serving_params(checkpoint_dir, cfg, mesh)
         else:
             params = llama2.init_llama(jax.random.key(seed), cfg)
-    engine = Engine(params, cfg, serve_cfg, mesh)
+    if disagg:
+        engine = DisaggEngine(
+            params, cfg, serve_cfg, prefill_mesh, decode_mesh,
+            max_inflight_bytes=(
+                disagg_max_inflight_mb * (1 << 20)
+                if disagg_max_inflight_mb else None
+            ),
+        )
+    else:
+        engine = Engine(params, cfg, serve_cfg, mesh)
     with obs.span("warmup", sink=metrics_path, hist="serve_warmup_s"):
         n_programs = engine.warmup()
 
@@ -139,6 +164,13 @@ def run_replay(
         recompiles=engine.compile_count - n_programs,
         batcher=dict(batcher.stats),
     )
+    if disagg:
+        # Per-tier attribution: tier meshes, the cross-tier KV load,
+        # and THIS run's hop-latency quantiles (the engine's own
+        # samples -- the process-wide registry histogram would blend
+        # runs) -- TTFT decomposes into prefill-tier + hop on this
+        # record.
+        summary["disagg"] = engine.describe()
     meter.write_summary(summary)
     # Close the replay's JSONL with the registry snapshot, mirroring
     # the Trainer's run_end discipline -- one schema, two producers.
@@ -292,6 +324,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "clock (deterministic -- the regress gate's input)",
     )
     ap.add_argument(
+        "--disagg", action="store_true",
+        help="disaggregated serving: prefill on one mesh tier, decode "
+        "on another (disjoint halves of the visible chips), KV blocks "
+        "crossing via bounded tpu_hpc.reshard plans; consumed by the "
+        "replay workload only",
+    )
+    ap.add_argument(
+        "--disagg-max-inflight-mb", type=int, default=None,
+        metavar="MB",
+        help="peak per-device transient allowed to a cross-tier KV "
+        "move (reshard max_inflight_bytes); default: unbounded",
+    )
+    ap.add_argument(
         "--checkpoint-dir", type=str, default=None,
         help="restore params from the newest trainer checkpoint here "
         "(serve/weights.py resharding); default: random init",
@@ -348,6 +393,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if record is not None:
                 print(record)
         return rc
+
+    # Misplaced-flag discipline (the --comm-mode / --loadgen-scenario
+    # guard): a disagg flag on a workload that cannot consume it is a
+    # CLI error, not a silent single-tier run. The loadgen harness
+    # charges modeled prefill/decode costs on its virtual clock around
+    # ONE engine's programs; it has no notion of a cross-tier hop, so
+    # "--loadgen --disagg" would measure a single tier while the flag
+    # claims two.
+    if args.disagg and args.loadgen:
+        ap.error(
+            "--disagg is only consumed by the replay workload; the "
+            "--loadgen harness charges single-tier virtual-clock "
+            "costs and would silently ignore the tier split"
+        )
+    if args.disagg_max_inflight_mb is not None and not args.disagg:
+        ap.error(
+            "--disagg-max-inflight-mb is only consumed together with "
+            "--disagg"
+        )
+    if args.disagg_max_inflight_mb is not None \
+            and args.disagg_max_inflight_mb < 1:
+        ap.error(
+            f"--disagg-max-inflight-mb {args.disagg_max_inflight_mb} "
+            "must be >= 1"
+        )
 
     if args.sim_devices:
         from tpu_hpc.runtime import sim
@@ -409,10 +479,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             metrics_path=args.metrics, seed=args.seed,
         )
     else:
+        if args.disagg:
+            import jax
+
+            if jax.device_count() < 2:
+                ap.error(
+                    "--disagg needs >= 2 devices (one per tier); "
+                    f"only {jax.device_count()} visible -- use "
+                    "--sim-devices N for development"
+                )
         summary = run_replay(
             cfg, serve_cfg, args.requests, prompt_lens, args.max_new,
             checkpoint_dir=args.checkpoint_dir,
             metrics_path=args.metrics, seed=args.seed,
+            disagg=args.disagg,
+            disagg_max_inflight_mb=args.disagg_max_inflight_mb,
         )
     print(json.dumps(summary))
     return 0

@@ -35,15 +35,32 @@ def serving_pspecs(params: Any, mesh: Mesh) -> Any:
     return jax.tree.map(lambda _: P(), params)
 
 
-def place_params(params: Any, mesh: Mesh, specs: Any) -> Any:
-    """Reshard a param tree onto the serving mesh per ``specs`` via a
-    jitted identity (fresh buffers -- safe next to donation, same
-    reasoning as the Trainer's placement)."""
+def place_params(
+    params: Any,
+    mesh: Mesh,
+    specs: Any,
+    max_inflight_bytes: Optional[int] = None,
+) -> Any:
+    """Reshard a param tree onto the serving mesh per ``specs``
+    through the general engine (tpu_hpc.reshard): same fresh-buffer
+    contract as the old jitted identity (no donation -- safe next to
+    callers that keep the source tree), but the move is now a planned,
+    introspectable redistribution with optional ``max_inflight_bytes``
+    bounding -- restoring a big checkpoint's params must not transit a
+    full replica per chip just to change layout."""
+    from tpu_hpc import reshard
+
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
-    return jax.jit(lambda t: t, out_shardings=shardings)(params)
+    # copy_noop: already-placed leaves still get fresh buffers, so the
+    # old jitted-identity contract holds exactly -- callers may donate
+    # their source tree after placement.
+    return reshard.apply(
+        params, shardings, max_inflight_bytes=max_inflight_bytes,
+        copy_noop=True, label="serving_params",
+    )
 
 
 def abstract_train_state(
@@ -131,7 +148,15 @@ def load_serving_params(
     )
     mgr = CheckpointManager(checkpoint_dir, async_save=False)
     try:
-        restored = mgr.restore_latest(template)
+        # elastic=False: this template ALREADY encodes the deliberate
+        # train->serve cross-layout move, and the direct orbax
+        # restore lands every shard straight into it in one pass. The
+        # elastic path would first restore the full train state
+        # (fp32 AdamW moments included) into a rebuilt TRAINING
+        # layout and then move it again -- double work and double
+        # transient on exactly the real-size checkpoints this loader
+        # exists for.
+        restored = mgr.restore_latest(template, elastic=False)
     finally:
         mgr.close()
     if restored is None:

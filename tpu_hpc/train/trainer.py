@@ -783,11 +783,38 @@ class Trainer:
         with self.goodput.measure("restore"), obs.span(
             "restore", sink=self._sink(), hist="train_restore_s"
         ):
-            restored = self.checkpoint_manager.restore_latest(self.state)
+            restored = self.checkpoint_manager.restore_latest(
+                self.state,
+                max_inflight_bytes=(
+                    self.cfg.reshard_max_inflight_mb * (1 << 20)
+                    if getattr(self.cfg, "reshard_max_inflight_mb", 0)
+                    else None
+                ),
+            )
         if restored is not None:
             self.state = restored
             step = int(jax.device_get(self.state.step))
             self.logger.info("resumed from checkpoint at step %d", step)
+            info = getattr(
+                self.checkpoint_manager, "last_restore_info", None
+            )
+            if info and info.get("elastic"):
+                # The cross-topology path ran: this relaunch resumed
+                # onto a DIFFERENT mesh shape via tpu_hpc.reshard.
+                # Record it in the run log so the goodput report and
+                # the elastic-resume test can see which restarts were
+                # elastic and what the move cost.
+                self.logger.info(
+                    "elastic resume: checkpoint mesh %s -> live mesh "
+                    "%s", info.get("src_mesh"), info.get("tgt_mesh"),
+                )
+                self._append_metrics({
+                    "event": "elastic_restore",
+                    "from_step": step,
+                    "src_mesh": info.get("src_mesh"),
+                    "tgt_mesh": info.get("tgt_mesh"),
+                    "plan": info.get("plan"),
+                })
             return step
         return 0
 
