@@ -110,6 +110,7 @@ def bench_llama(
     moments_dtype: str = "float32",
     block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
     comm_mode: str = "flat",
+    guard_mode: str = "off",
 ) -> dict:
     """Best measured single-chip config (v5e) -- what the CLI runs by
     default (the *function* defaults are the unaccumulated round-2
@@ -211,6 +212,7 @@ def bench_llama(
         grad_accum_steps=grad_accum_steps,
         adam_moments_dtype=moments_dtype,
         comm_mode=comm_mode,
+        guard_mode=guard_mode,
     )
     ds = datasets.TokenStream(
         vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
@@ -248,6 +250,10 @@ def bench_llama(
         # Gradient-sync strategy: BENCH JSONLs must be able to
         # attribute a step-time delta to the comm layer, not guess it.
         "comm_mode": comm_mode,
+        # Numeric-health guard: the health vector rides the jitted
+        # step, so a guarded row quantifies exactly what the guard
+        # costs (the zero-recompile claim's measured counterpart).
+        "guard_mode": guard_mode,
         **flash_blocks_record(
             attn, block_q, block_k, block_q_bwd, block_k_bwd
         ),
@@ -346,6 +352,7 @@ def bench_llama_long(
     block_q: int = 512, block_k: int = 1024,
     block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
     comm_mode: str = "flat",
+    guard_mode: str = "off",
 ) -> dict:
     """Long-context Llama: seq 8192 (4x the headline bench) -- the
     long-sequence regime the SP family exists for. Same harness as
@@ -362,7 +369,7 @@ def bench_llama_long(
         seq_len=seq_len, grad_accum_steps=grad_accum_steps,
         moments_dtype=moments_dtype,
         block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
-        comm_mode=comm_mode,
+        comm_mode=comm_mode, guard_mode=guard_mode,
     )
     rec["metric"] = f"llama2_seq{seq_len}_tokens_per_s_per_chip"
     return rec
@@ -1045,6 +1052,14 @@ def main(argv=None) -> int:
         "step-time deltas (llama/llama-long workloads)",
     )
     ap.add_argument(
+        "--guard-mode", choices=("off", "skip"), default="off",
+        help="numeric-health guard (config.guard_mode): 'skip' arms "
+        "the in-step health vector + on-device nonfinite-update skip "
+        "so the row measures the guard's steady-state cost "
+        "('rollback' needs a checkpoint manager the bench does not "
+        "run; llama/llama-long workloads)",
+    )
+    ap.add_argument(
         "--moments-dtype", choices=("float32", "bfloat16"),
         default="float32",
         help="AdamW moment storage dtype (bfloat16 halves optimizer-"
@@ -1086,6 +1101,20 @@ def main(argv=None) -> int:
             "--serve-disagg is only consumed by --workload serve; "
             f"--workload {args.workload} would silently run "
             "single-tier"
+        )
+    if args.guard_mode != "off" and (
+        args.all or args.workload not in ("llama", "llama-long")
+    ):
+        # The --comm-mode guard discipline: a guard flag on a workload
+        # that never consumes it must be a CLI error, not a row
+        # labeled guarded that silently ran unguarded.
+        ap.error(
+            f"--guard-mode {args.guard_mode} is only consumed by the "
+            "llama/llama-long workloads; "
+            + ("--all runs fixed rows"
+               if args.all else
+               f"--workload {args.workload} would silently run "
+               "unguarded")
         )
     if args.comm_mode != "flat" and (
         args.all or args.workload not in ("llama", "llama-long")
@@ -1141,6 +1170,7 @@ def main(argv=None) -> int:
             moments_dtype=args.moments_dtype,
             block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
             comm_mode=args.comm_mode,
+            guard_mode=args.guard_mode,
         )
     elif args.workload == "llama-sp":
         batch, accum = resolve_batch_accum(
@@ -1172,6 +1202,7 @@ def main(argv=None) -> int:
             block_q=args.block_q, block_k=args.block_k,
             block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
             comm_mode=args.comm_mode,
+            guard_mode=args.guard_mode,
         )
     elif args.workload == "serve":
         rec = bench_serve(

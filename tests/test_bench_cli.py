@@ -174,6 +174,36 @@ def test_comm_mode_routes_to_bench_llama(bench, monkeypatch):
     assert seen == {"comm_mode": "bucketed_overlap"}
 
 
+def test_guard_mode_routes_to_bench_llama(bench, monkeypatch):
+    """--guard-mode must reach the workload (and through it the
+    Trainer's numeric-health guard); a row labeled guarded that
+    silently ran unguarded would misprice the guard's cost."""
+    seen = {}
+
+    def fake_bench_llama(steps, remat, batch, attn, block_q=512,
+                         block_k=1024, **kw):
+        seen.update(guard_mode=kw.get("guard_mode"))
+        return {"metric": "m", "value": 1, "unit": "u",
+                "vs_baseline": 1}
+
+    monkeypatch.setattr(bench, "bench_llama", fake_bench_llama)
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    rc = bench.main(["--guard-mode", "skip"])
+    assert rc == 0
+    assert seen == {"guard_mode": "skip"}
+
+
+def test_guard_mode_on_nonconsuming_workload_is_cli_error(
+    bench, monkeypatch
+):
+    """The --comm-mode misplaced-flag discipline applies to the guard
+    flag too."""
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    with pytest.raises(SystemExit) as ei:
+        bench.main(["--workload", "serve", "--guard-mode", "skip"])
+    assert ei.value.code == 2
+
+
 def test_llama_records_carry_comm_mode(bench):
     """Training records must be attributable to their gradient-sync
     strategy: bench_llama (and llama-long through it) records

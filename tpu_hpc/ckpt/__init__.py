@@ -1,2 +1,7 @@
 from tpu_hpc.ckpt.checkpoint import CheckpointManager  # noqa: F401
+from tpu_hpc.ckpt.integrity import (  # noqa: F401
+    CkptIntegrityError,
+    leaf_checksums,
+    verify_tree,
+)
 from tpu_hpc.reshard.elastic import TopologyMismatchError  # noqa: F401

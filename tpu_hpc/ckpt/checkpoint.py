@@ -21,6 +21,16 @@ next-older step when the newest snapshot is torn; saves replay over
 existing steps after such a fallback instead of dying on
 StepAlreadyExists.
 
+Content integrity (tpu_hpc.ckpt.integrity, docs/guide/guard.md):
+saves record per-leaf crc32 checksums (computed from the in-memory
+state) in the topology sidecar; restores recompute them from the
+restored tree and treat a mismatch -- silent corruption orbax
+deserializes without complaint -- exactly like a torn write. Every
+fallback quarantines the dead step dir (``<step>.corrupt``) so later
+restarts skip it, and emits schema-stamped ``ckpt_fallback`` /
+``ckpt_integrity`` events (plus registry counters) the obs report and
+the regress gate consume.
+
 Elastic resume (tpu_hpc.reshard, docs/guide/resharding.md): every save
 records the state's topology in a ``.tpu_hpc_meta/<step>.json``
 sidecar; ``restore_latest`` against a template on a DIFFERENT mesh
@@ -35,6 +45,7 @@ topologies instead of a generic orbax error.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Optional
 
 import jax
@@ -58,6 +69,7 @@ class CheckpointManager:
         directory: str,
         max_to_keep: int = 3,
         async_save: bool = True,
+        integrity: bool = True,
     ):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
@@ -73,6 +85,25 @@ class CheckpointManager:
         # and the executed plan summary. The Trainer reads this to
         # emit the ``elastic_restore`` telemetry event.
         self.last_restore_info: Optional[dict] = None
+        # Content integrity (ckpt.integrity): saves record per-leaf
+        # checksums in the topology sidecar; restores recompute and
+        # verify, treating a mismatch like a torn write (fall back
+        # older + quarantine). ``integrity=False`` opts out of both
+        # -- the save-side device_get over the full state and the
+        # restore-side re-hash are host CPU time a latency-critical
+        # caller may not want to pay.
+        self.integrity = integrity
+        # Optional JSONL sink for this manager's schema-stamped
+        # events (ckpt_integrity / ckpt_fallback): the Trainer points
+        # it at the run log on host 0 so silent fallbacks are visible
+        # to obs.report and the regress gate, not just a logger line.
+        self.event_sink: Optional[str] = None
+        # Steps that failed during the current restore_latest, held
+        # until the loop learns whether the failure was step-local
+        # (quarantine) or systemic (leave everything in place).
+        self._pending_fallbacks: list = []
+        self._async = async_save
+        self._sidecar_thread: Optional[threading.Thread] = None
 
     def save(self, state: Any, step: Optional[int] = None, force: bool = False) -> bool:
         """Sharded (per-host) async save at ``step`` (defaults to
@@ -104,21 +135,60 @@ class CheckpointManager:
                 if reload is not None:
                     reload()
         if started:
-            self._write_sidecar(step, state)
+            self._start_sidecar(step, state)
             self._maybe_corrupt(step)
         return started
+
+    def _start_sidecar(self, step: int, state: Any) -> None:
+        """Write the sidecar (topology + integrity checksums).
+        Async managers push it to a background thread: the checksum
+        pass device_gets the full state and crc's it host-side, and
+        paying that synchronously in the training loop would
+        serialize exactly the latency async_save exists to hide. jax
+        arrays are immutable, so the thread reads a stable snapshot;
+        every consumer (restore/save_now/wait/close) joins first."""
+        self._join_sidecar()
+        if self._async:
+            t = threading.Thread(
+                target=self._write_sidecar, args=(step, state),
+                daemon=True,
+            )
+            t.start()
+            self._sidecar_thread = t
+        else:
+            self._write_sidecar(step, state)
+
+    def _join_sidecar(self) -> None:
+        t, self._sidecar_thread = self._sidecar_thread, None
+        if t is not None:
+            t.join()
 
     def _write_sidecar(self, step: int, state: Any) -> None:
         """Record the state's topology (mesh axes + per-leaf specs)
         next to the checkpoint -- what the elastic restore path reads
         to rebuild the SOURCE layout on a relaunch with a different
-        mesh. Failure to write it must never fail the save: a missing
-        sidecar only means the restore falls back to the direct orbax
-        path."""
+        mesh -- plus, when integrity is on, per-leaf content checksums
+        computed from the IN-MEMORY state (ckpt.integrity: whatever
+        the storage stack does to the bytes after this point, the
+        restore-side verify sees it). Failure to write it must never
+        fail the save: a missing sidecar only means the restore falls
+        back to the direct orbax path, unverified."""
         from tpu_hpc.reshard import elastic
 
         try:
-            elastic.write_sidecar(self.directory, step, state)
+            extra = None
+            # Host 0 writes the sidecar; hashing the full state on
+            # every other host would be a synchronous device_get +
+            # crc per save for output that gets thrown away.
+            if self.integrity and jax.process_index() == 0:
+                from tpu_hpc.ckpt import integrity as integrity_mod
+
+                sums = integrity_mod.leaf_checksums(state)
+                if sums:
+                    extra = {"checksums": sums}
+            elastic.write_sidecar(
+                self.directory, step, state, extra=extra
+            )
             elastic.prune_sidecars(
                 self.directory, [*self._mgr.all_steps(), step]
             )
@@ -143,15 +213,22 @@ class CheckpointManager:
         path = os.path.join(self.directory, str(step))
         if not os.path.isdir(path):
             return None
-        aside, k = f"{path}.replaced", 0
-        while os.path.exists(aside):
+        return self._rename_aside(path, "replaced")
+
+    def _rename_aside(self, path: str, suffix: str) -> str:
+        """Rename ``path`` to ``<path>.<suffix>`` (suffix-uniqued --
+        a renamed-aside dir is evidence and is never overwritten) and
+        refresh orbax's step listing. The one rename-out-of-listing
+        primitive shared by replay stashing and quarantine."""
+        dst, k = f"{path}.{suffix}", 0
+        while os.path.exists(dst):
             k += 1
-            aside = f"{path}.replaced.{k}"
-        os.rename(path, aside)
+            dst = f"{path}.{suffix}.{k}"
+        os.rename(path, dst)
         reload = getattr(self._mgr, "reload", None)
         if reload is not None:
             reload()
-        return aside
+        return dst
 
     def save_now(self, state: Any, step: Optional[int] = None) -> int:
         """Emergency SYNCHRONOUS save: force-write at ``step`` and
@@ -170,26 +247,69 @@ class CheckpointManager:
             import shutil
 
             shutil.rmtree(aside, ignore_errors=True)
+        # Synchronous on the emergency path: nothing may stay in
+        # flight when save_now returns (grace-window contract).
+        self._join_sidecar()
         self._write_sidecar(step, state)
         self._maybe_corrupt(step)
         return step
 
     def _maybe_corrupt(self, step: int) -> None:
-        """Fault-injection hook (no-op unless TPU_HPC_FAULTS asks for
-        corrupt_ckpt_at_step): garbage this step's files after the
-        write lands, simulating a torn multi-file write -- the failure
-        restore_latest's fallback exists for."""
+        """Fault-injection hook (no-op unless TPU_HPC_FAULTS asks):
+        ``corrupt_ckpt_at_step`` garbages this step's files after the
+        write lands (a torn multi-file write -- orbax throws, the
+        restore fallback catches it); ``bitflip_ckpt_at_step`` flips
+        ONE BIT in one tensor and rewrites the step through orbax, so
+        every file stays parseable and ONLY the content checksums can
+        tell (the silent-corruption class ckpt.integrity exists for)."""
         plan = fault_plan_from_env()
-        if plan is None or not plan.wants_ckpt_corruption(step):
+        if plan is None:
             return
-        self._mgr.wait_until_finished()  # corrupt AFTER the write lands
-        n = plan.corrupt_checkpoint(
-            os.path.join(self.directory, str(step))
+        if plan.wants_ckpt_corruption(step):
+            self._mgr.wait_until_finished()  # corrupt AFTER the write
+            n = plan.corrupt_checkpoint(
+                os.path.join(self.directory, str(step))
+            )
+            get_logger().warning(
+                "fault injection: corrupted %d files of checkpoint "
+                "step %d", n, step,
+            )
+        if plan.wants_ckpt_bitflip(step):
+            self._mgr.wait_until_finished()
+            plan.announce_bitflip(step)
+            self._bitflip_step(step)
+            get_logger().warning(
+                "fault injection: bit-flipped one tensor of "
+                "checkpoint step %d (files remain parseable; only "
+                "the integrity checksums can catch this)", step,
+            )
+
+    def _bitflip_step(self, step: int) -> None:
+        """Flip the top bit of one byte in the largest tensor of the
+        saved step, rewritten THROUGH orbax: deserialization succeeds,
+        content is wrong -- a faithful SDC. The sidecar (written from
+        the in-memory state before this hook runs) keeps the original
+        checksums, which is the whole point."""
+        tree = self._mgr.restore(step)
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        sizes = [getattr(leaf, "nbytes", 0) for leaf in flat]
+        idx = max(range(len(flat)), key=lambda i: sizes[i])
+        arr = np.array(flat[idx], copy=True)
+        arr.reshape(-1).view(np.uint8)[arr.nbytes // 2] ^= 0x80
+        flat[idx] = arr
+        flipped = jax.tree_util.tree_unflatten(treedef, flat)
+        aside = self._stash_existing(step)
+        self._mgr.save(
+            step, args=ocp.args.StandardSave(flipped), force=True
         )
-        get_logger().warning(
-            "fault injection: corrupted %d files of checkpoint step %d",
-            n, step,
-        )
+        self._mgr.wait_until_finished()
+        if aside is not None:
+            import shutil
+
+            shutil.rmtree(aside, ignore_errors=True)
+        # Deliberately NOT rewriting the sidecar: its checksums
+        # describe the state as it was saved, the flip happened
+        # "after" -- exactly what verification must catch.
 
     def restore_latest(
         self,
@@ -238,27 +358,41 @@ class CheckpointManager:
         abstract = jax.tree.map(
             ocp.utils.to_shape_dtype_struct, template_state
         )
+        self._join_sidecar()  # in-flight sidecar writes land first
         self.last_restore_info = None
         last_exc: Optional[Exception] = None
+        self._pending_fallbacks = []
         for step in steps:
             meta = elastic_mod.read_sidecar(self.directory, step)
             try:
                 if elastic and meta is not None and \
                         elastic_mod.needs_reshard(meta, abstract):
-                    return self._restore_elastic(
+                    restored = self._restore_elastic(
                         step, abstract, meta, retries,
                         max_inflight_bytes,
                     )
-                restored = retry_call(
-                    self._mgr.restore,
-                    (step,),
-                    {"args": ocp.args.StandardRestore(abstract)},
-                    retries=retries, base_delay=0.2, max_delay=5.0,
-                    describe=f"checkpoint restore (step {step})",
-                )
-                self.last_restore_info = {
-                    "step": step, "elastic": False,
-                }
+                else:
+                    restored = retry_call(
+                        self._mgr.restore,
+                        (step,),
+                        {"args": ocp.args.StandardRestore(abstract)},
+                        retries=retries, base_delay=0.2, max_delay=5.0,
+                        describe=f"checkpoint restore (step {step})",
+                    )
+                    self._verify_integrity(step, restored, meta)
+                    self.last_restore_info = {
+                        "step": step, "elastic": False,
+                    }
+                # An OLDER step restored fine, so the failures above
+                # it were step-local (torn write, flipped bits) --
+                # NOW it is safe to quarantine them. Quarantining at
+                # failure time would be wrong: a systemic failure
+                # (structural mismatch from a wrong relaunch config,
+                # a shared-FS outage outlasting the retries) fails
+                # EVERY step, and renaming them all would both lose
+                # the typed loud-failure path below and turn a
+                # recoverable outage into an empty checkpoint dir.
+                self._flush_fallbacks(quarantine=True)
                 return restored
             except Exception as exc:  # noqa: BLE001 - fall back older
                 last_exc = exc
@@ -267,9 +401,140 @@ class CheckpointManager:
                     "back to the previous one",
                     step, type(exc).__name__, exc,
                 )
+                self._pending_fallbacks.append((step, exc))
+        self._flush_fallbacks(quarantine=False)
         if last_exc is not None:
             self._raise_restore_failure(steps, abstract, last_exc)
         return None
+
+    def _emit(self, event: str, **fields) -> None:
+        """Schema-stamped telemetry from the manager itself, routed to
+        the flight ring (every host) and to ``event_sink`` when the
+        owner (the Trainer, host 0) configured one. Best-effort: a
+        broken bus must never turn a restore into a crash."""
+        try:
+            from tpu_hpc import obs
+
+            obs.get_bus().emit(event, sink=self.event_sink, **fields)
+        except Exception:  # pragma: no cover - diagnostics only
+            pass
+
+    def _verify_integrity(
+        self, step: int, restored: Any, meta: Optional[dict]
+    ) -> None:
+        """Recompute content checksums over the restored tree and
+        compare with the sidecar's save-time records (ckpt.integrity).
+        A mismatch raises CkptIntegrityError, which the fallback loop
+        treats exactly like a torn write. No sidecar / no checksums
+        (pre-integrity checkpoints) restore exactly as before."""
+        sums = (meta or {}).get("checksums")
+        if not self.integrity or not sums:
+            return
+        from tpu_hpc.ckpt import integrity as integrity_mod
+
+        bad = integrity_mod.verify_tree(restored, sums)
+        self._emit(
+            "ckpt_integrity",
+            step=step,
+            verdict="mismatch" if bad else "ok",
+            checked=len(sums),
+            mismatched=bad[:8] if bad else None,
+        )
+        try:
+            from tpu_hpc import obs
+
+            obs.get_registry().inc("ckpt_integrity_checks_total")
+            if bad:
+                obs.get_registry().inc("ckpt_integrity_fail_total")
+        except Exception:  # pragma: no cover - diagnostics only
+            pass
+        if bad:
+            raise integrity_mod.CkptIntegrityError(
+                f"checkpoint step {step}: {len(bad)} leaf/leaves "
+                f"restored with content differing from the save-time "
+                f"checksums (first: {bad[:3]}) -- silent corruption; "
+                "treating like a torn write"
+            )
+
+    def quarantine_step(
+        self, step: int, reason: str = "corrupt"
+    ) -> Optional[str]:
+        """Move a dead snapshot out of orbax's step listing: rename
+        ``<step>`` to ``<step>.<reason>`` (suffix-uniqued, never
+        overwritten -- it is evidence) and rename its sidecar aside
+        with it (the save-time checksums are the evidence that can
+        later prove -- or disprove -- the corruption), so every
+        subsequent restart skips it instead of re-probing the same
+        corpse through the full retry/backoff ladder. Host 0 renames;
+        other hosts return None. Returns the quarantine path."""
+        if jax.process_index() != 0:
+            return None
+        src = os.path.join(self.directory, str(step))
+        if not os.path.isdir(src):
+            return None
+        try:
+            dst = self._rename_aside(src, reason)
+        except OSError as exc:
+            get_logger().warning(
+                "could not quarantine checkpoint step %d (%s); the "
+                "next restart will re-probe it", step, exc,
+            )
+            return None
+        from tpu_hpc.reshard import elastic as elastic_mod
+
+        elastic_mod.stash_sidecar(self.directory, step, reason)
+        get_logger().warning(
+            "quarantined checkpoint step %d -> %s (%s)",
+            step, os.path.basename(dst), reason,
+        )
+        return dst
+
+    def _flush_fallbacks(self, quarantine: bool) -> None:
+        """Resolve the restore loop's accumulated failures. Each one
+        was, until this PR, only a logger warning -- now every
+        fallback is a schema-stamped ``ckpt_fallback`` event + counter
+        so obs.report and the regress gate can see them. With
+        ``quarantine=True`` (an older step restored successfully, so
+        the failures were step-local) the dead step dirs are renamed
+        aside so later restarts never re-probe them; with False
+        (every step failed -- a systemic problem, not dead
+        snapshots) everything stays in place for the retry/typed-error
+        path. Structural mismatches (TopologyMismatchError) are never
+        quarantined: the checkpoint itself is fine, the relaunch
+        config is wrong."""
+        from tpu_hpc.reshard.elastic import TopologyMismatchError
+
+        pending, self._pending_fallbacks = self._pending_fallbacks, []
+        for step, exc in pending:
+            quarantined = None
+            # Corruption-class failures only: a TopologyMismatch means
+            # the RELAUNCH is wrong, and an OSError that outlasted the
+            # retries is a filesystem problem -- in both cases the
+            # snapshot itself may be perfectly healthy, and a rename
+            # would permanently discard real progress. Parse errors
+            # (torn writes) and checksum mismatches ARE the snapshot's
+            # own corpse; those never get better on re-probe.
+            if quarantine and not isinstance(
+                exc, (TopologyMismatchError, OSError)
+            ):
+                quarantined = self.quarantine_step(
+                    step, reason="corrupt"
+                )
+            self._emit(
+                "ckpt_fallback",
+                step=step,
+                error=f"{type(exc).__name__}: {exc}"[:500],
+                quarantined=(
+                    os.path.basename(quarantined)
+                    if quarantined else None
+                ),
+            )
+            try:
+                from tpu_hpc import obs
+
+                obs.get_registry().inc("ckpt_fallback_total")
+            except Exception:  # pragma: no cover - diagnostics only
+                pass
 
     def _raise_restore_failure(
         self, steps, abstract, last_exc: Exception
@@ -337,6 +602,7 @@ class CheckpointManager:
                 retries=retries, base_delay=0.2, max_delay=5.0,
                 describe=f"checkpoint restore (step {step})",
             )
+            self._verify_integrity(step, restored, meta)
             self.last_restore_info = {
                 "step": step, "elastic": False,
                 "src_mesh": meta.get("mesh"),
@@ -349,6 +615,10 @@ class CheckpointManager:
             retries=retries, base_delay=0.2, max_delay=5.0,
             describe=f"elastic checkpoint restore (step {step})",
         )
+        # Verify BEFORE the reshard spends wire bytes moving what may
+        # be garbage; the source-layout tree holds the exact restored
+        # content, so the checksums mean the same thing here.
+        self._verify_integrity(step, restored_src, meta)
         targets = elastic.target_shardings(abstract)
         plan = reshard.plan_reshard(
             restored_src, targets,
@@ -401,10 +671,13 @@ class CheckpointManager:
         return list(self._mgr.all_steps())
 
     def wait(self) -> None:
-        """Block until async saves land (call before job exit)."""
+        """Block until async saves (and the sidecar write) land --
+        call before job exit."""
+        self._join_sidecar()
         self._mgr.wait_until_finished()
 
     def close(self) -> None:
+        self._join_sidecar()
         self._mgr.close()
 
     def export_consolidated(self, state: Any, path: str) -> str:

@@ -83,6 +83,36 @@ class TrainingConfig:
     # to stage a full-array transient per device.
     reshard_max_inflight_mb: int = 0
 
+    # Numeric-health guard (tpu_hpc.resilience.guard): the jitted step
+    # emits a fused health vector (loss finiteness, global grad/update
+    # norms, nonfinite-leaf count) and a host-side policy classifies
+    # every step healthy/spike/poisoned at the chunk boundaries the
+    # trainer already owns -- no extra device round trips, no
+    # recompiles. Actions on a poisoned (non-finite) step:
+    #   "off"      -- no health vector, byte-identical step program to
+    #                 a pre-guard trainer (the default).
+    #   "skip"     -- drop the update on-device (params/opt/model
+    #                 state keep their pre-step values), advance the
+    #                 data stream, keep going.
+    #   "rollback" -- quarantine poisoned snapshots, persist a skip
+    #                 window over the poisoned data indices, and exit
+    #                 EXIT_ROLLBACK(77); the supervisor relaunches
+    #                 from the last-good checkpoint (its own
+    #                 --max-rollbacks budget) and the stream
+    #                 fast-forwards past the poisoned batches.
+    #                 Requires a checkpoint manager.
+    guard_mode: str = "off"
+    # A finite step whose global grad norm exceeds guard_spike_factor
+    # x the rolling median of recent healthy norms is a "spike"
+    # (0 = spike detection off). guard_spike_action: "event" records
+    # the schema-stamped guard_verdict and keeps going; "rollback"
+    # treats the spike like a poisoned step (the loss-spike/rewind
+    # discipline of large-scale LLM training).
+    guard_spike_factor: float = 10.0
+    guard_spike_action: str = "event"
+    # Rolling-median window (healthy steps) for spike detection.
+    guard_window: int = 8
+
     # Profiling (reference: utils/config.py:48-50).
     profile: bool = False
     profile_dir: str = "profiles"

@@ -61,9 +61,16 @@ DEFAULT_TOL = 0.10
 # reshard rows) -- more bytes over the wire or a higher transient peak
 # is the regression, so the bank diff catches a plan that started
 # moving or materializing more than its history.
+# "rollback"/"fallback"/"poisoned"/"spike"/"skipped"/"lost_steps"/
+# "integrity_fail": the robustness counters (resilience.guard +
+# ckpt.integrity) -- more guard rollbacks, skipped updates, silent
+# restore fallbacks or checksum failures IS the regression, so the
+# --bank gate fails on robustness drift, not just perf.
 _LOWER_IS_BETTER = (
     "ttft", "itl", "_ms", "latency", "shed", "stall", "queued",
     "wire_bytes", "inflight",
+    "rollback", "fallback", "poisoned", "spike", "skipped",
+    "lost_steps", "integrity_fail", "nonfinite",
 )
 
 
@@ -99,6 +106,19 @@ def report_metrics(rep: dict) -> Dict[str, float]:
                   "shed", "queued"):
             if k in lg:
                 flat[f"loadgen.{k}"] = float(lg[k])
+    g = rep.get("guard")
+    if g:
+        flat["guard.poisoned"] = float(g["poisoned"])
+        flat["guard.spikes"] = float(g["spikes"])
+        flat["guard.skipped"] = float(g["skipped"])
+        flat["guard.rollbacks"] = float(len(g["rollbacks"]))
+        flat["guard.lost_steps"] = float(g["lost_steps"])
+    ck = rep.get("ckpt")
+    if ck:
+        flat["ckpt.fallbacks"] = float(ck["fallbacks"])
+        flat["ckpt.integrity_failures"] = float(
+            ck["integrity_failures"]
+        )
     return flat
 
 

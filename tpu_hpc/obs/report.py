@@ -256,6 +256,72 @@ def _loadgen(records: Sequence[dict]) -> Optional[dict]:
     return out
 
 
+def _guard(records: Sequence[dict]) -> Optional[dict]:
+    """Numeric-health guard breakdown: verdict counts, skip count,
+    and the rollback timeline with its goodput cost (steps re-trained
+    plus poisoned batches skipped -- the price of each anomaly)."""
+    verdicts = [
+        r for r in records if r.get("event") == "guard_verdict"
+    ]
+    rollbacks = [
+        r for r in records if r.get("event") == "guard_rollback"
+    ]
+    if not verdicts and not rollbacks:
+        return None
+    out = {
+        "poisoned": sum(
+            1 for v in verdicts if v["verdict"] == "poisoned"
+        ),
+        "spikes": sum(1 for v in verdicts if v["verdict"] == "spike"),
+        "skipped": sum(
+            1 for v in verdicts if v.get("action") == "skip"
+        ),
+        "rollbacks": [
+            {
+                "to_step": r["to_step"],
+                "first_bad": r["first_bad"],
+                "last_bad": r["last_bad"],
+                "data_from": r["data_from"],
+                "data_to": r["data_to"],
+                "quarantined": r.get("quarantined") or [],
+            }
+            for r in rollbacks
+        ],
+        # Poisoned-window goodput loss, in optimizer steps: each
+        # rollback re-trains [to_step, first_bad) and skips the
+        # anomaly window itself -- all work the anomaly destroyed.
+        "lost_steps": sum(
+            r["last_bad"] + 1 - r["to_step"] for r in rollbacks
+        ),
+    }
+    return out
+
+
+def _ckpt(records: Sequence[dict]) -> Optional[dict]:
+    """Checkpoint-health breakdown: restore fallbacks (each one a
+    snapshot that silently failed to come back) and content-integrity
+    verdicts."""
+    fallbacks = [
+        r for r in records if r.get("event") == "ckpt_fallback"
+    ]
+    integrity = [
+        r for r in records if r.get("event") == "ckpt_integrity"
+    ]
+    if not fallbacks and not integrity:
+        return None
+    return {
+        "fallbacks": len(fallbacks),
+        "fallback_steps": [r["step"] for r in fallbacks],
+        "quarantined": [
+            r["quarantined"] for r in fallbacks if r.get("quarantined")
+        ],
+        "integrity_checks": len(integrity),
+        "integrity_failures": sum(
+            1 for r in integrity if r["verdict"] != "ok"
+        ),
+    }
+
+
 def build_report(
     records: Sequence[dict],
     peak_flops_per_device: Optional[float] = None,
@@ -296,6 +362,8 @@ def build_report(
         ],
         "serve": _serve(records),
         "loadgen": _loadgen(records),
+        "guard": _guard(records),
+        "ckpt": _ckpt(records),
     }
 
 
@@ -381,6 +449,46 @@ def format_report(rep: dict) -> str:
         lines.append(
             f"- injected fault: {f['kind']} at step {f['step']}"
         )
+    g = rep.get("guard")
+    if g is not None:
+        lines += [
+            "",
+            "## Numeric-health guard",
+            "",
+            f"- verdicts: {g['poisoned']} poisoned, {g['spikes']} "
+            f"spike(s); {g['skipped']} update(s) skipped on-device",
+        ]
+        for r in g["rollbacks"]:
+            lines.append(
+                f"- ROLLBACK: anomaly steps [{r['first_bad']}, "
+                f"{r['last_bad']}] -> resumed from last-good step "
+                f"{r['to_step']}, data indices [{r['data_from']}, "
+                f"{r['data_to']}] skipped"
+                + (
+                    f", quarantined snapshots {r['quarantined']}"
+                    if r["quarantined"] else ""
+                )
+            )
+        if g["rollbacks"]:
+            lines.append(
+                f"- poisoned-window goodput loss: {g['lost_steps']} "
+                "optimizer step(s) re-trained or skipped"
+            )
+    ck = rep.get("ckpt")
+    if ck is not None:
+        lines += [
+            "",
+            "## Checkpoint health",
+            "",
+            f"- restore fallbacks: {ck['fallbacks']} "
+            f"(steps {ck['fallback_steps']})",
+            f"- integrity: {ck['integrity_failures']} failure(s) in "
+            f"{ck['integrity_checks']} verified restore(s)",
+        ]
+        if ck["quarantined"]:
+            lines.append(
+                f"- quarantined: {', '.join(ck['quarantined'])}"
+            )
     if rep["serve"] is not None:
         s = rep["serve"]
         lines += [

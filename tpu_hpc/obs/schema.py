@@ -70,7 +70,7 @@ EVENTS: Dict[str, EventSpec] = {
     "eval": EventSpec(("step", "n_steps", "loss"), open=True),
     "run_end": EventSpec((
         "step", "preempted", "attempt", "resumed_from_step", "goodput",
-    )),
+    ), optional=("rolled_back",)),
     # -- the telemetry spine itself (obs/) --
     "span": EventSpec(
         ("name", "dur_s"), optional=("parent", "depth", "n", "tier"),
@@ -129,6 +129,31 @@ EVENTS: Dict[str, EventSpec] = {
     "elastic_restore": EventSpec(
         ("from_step", "src_mesh", "tgt_mesh"),
         optional=("plan", "device_count"),
+    ),
+    # -- numeric-health guard (resilience/guard.py via the Trainer):
+    #    one verdict per anomalous step, one rollback record per
+    #    rollback-to-last-good -- the report's guard section and the
+    #    regress gate's rollback/skip counters read exactly these --
+    "guard_verdict": EventSpec(
+        ("step", "verdict", "action"),
+        optional=(
+            "grad_norm", "update_norm", "loss_finite", "nonfinite",
+            "watermark", "ratio", "data_index",
+        ),
+    ),
+    "guard_rollback": EventSpec(
+        ("to_step", "first_bad", "last_bad", "data_from", "data_to"),
+        optional=("quarantined", "n_rollbacks", "reason"),
+    ),
+    # -- checkpoint integrity + restore fallback (ckpt/checkpoint.py):
+    #    every restore-side checksum verdict, and every fall-back-to-
+    #    older (previously only a logger warning -- a silent fallback
+    #    is a robustness regression the gate must see) --
+    "ckpt_integrity": EventSpec(
+        ("step", "verdict"), optional=("checked", "mismatched"),
+    ),
+    "ckpt_fallback": EventSpec(
+        ("step", "error"), optional=("quarantined",),
     ),
     # -- supervisor attempt log (resilience/supervisor.py) --
     "attempt_start": EventSpec(("attempt", "cmd")),

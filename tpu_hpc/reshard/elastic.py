@@ -73,7 +73,9 @@ def _path_leaves(tree) -> List[Tuple[str, Any]]:
 def topology_of(state: Any) -> Optional[dict]:
     """The topology record for a state tree: mesh axes plus per-leaf
     shape/dtype/spec. None when no leaf carries a ``NamedSharding``
-    (host-local trees -- nothing cross-topology to record)."""
+    (host-local trees -- nothing cross-topology to record; the
+    sidecar is still written for such trees, mesh-less, so the
+    integrity checksums have somewhere to live)."""
     mesh = None
     leaves: Dict[str, dict] = {}
     for path, leaf in _path_leaves(state):
@@ -96,20 +98,43 @@ def topology_of(state: Any) -> Optional[dict]:
     }
 
 
+def _leaves_only(state: Any) -> dict:
+    """The mesh-less sidecar record for host-local trees: per-leaf
+    shape/dtype so structural mismatches still get the typed error,
+    no ``mesh`` key so the elastic path never engages."""
+    return {
+        "leaves": {
+            path: {
+                "shape": [int(d) for d in getattr(leaf, "shape", ())],
+                "dtype": str(getattr(leaf, "dtype", "")),
+            }
+            for path, leaf in _path_leaves(state)
+        },
+    }
+
+
 def _sidecar_path(directory: str, step: int) -> str:
     return os.path.join(directory, SIDECAR_DIR, f"{int(step)}.json")
 
 
-def write_sidecar(directory: str, step: int, state: Any) -> Optional[str]:
+def write_sidecar(
+    directory: str, step: int, state: Any,
+    extra: Optional[dict] = None,
+) -> Optional[str]:
     """Record ``state``'s topology for checkpoint ``step`` (host 0
     only; other hosts return None). A state with no NamedSharding
-    leaves writes nothing."""
+    leaves writes a mesh-less record (leaf shapes/dtypes only): the
+    elastic path never engages for it, but the integrity checksums
+    (``extra={"checksums": ...}``, ckpt.integrity) and the typed
+    structural-mismatch error still work."""
     if jax.process_index() != 0:
         return None
     topo = topology_of(state)
     if topo is None:
-        return None
+        topo = _leaves_only(state)
     topo["step"] = int(step)
+    if extra:
+        topo.update(extra)
     path = _sidecar_path(directory, step)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp"
@@ -117,6 +142,29 @@ def write_sidecar(directory: str, step: int, state: Any) -> Optional[str]:
         json.dump(topo, f)
     os.replace(tmp, path)
     return path
+
+
+def stash_sidecar(
+    directory: str, step: int, suffix: str
+) -> Optional[str]:
+    """Rename one step's sidecar aside (``<step>.json.<suffix>``,
+    uniqued) -- the quarantine path. A renamed-aside step dir must
+    not leave a live-looking topology record, but its save-time
+    checksums are evidence worth keeping: they are what can later
+    prove (or disprove) the corruption. The suffixed name no longer
+    ends in ``.json``, so sidecar pruning leaves it alone."""
+    src = _sidecar_path(directory, step)
+    if not os.path.exists(src):
+        return None
+    dst, k = f"{src}.{suffix}", 0
+    while os.path.exists(dst):
+        k += 1
+        dst = f"{src}.{suffix}.{k}"
+    try:
+        os.rename(src, dst)
+        return dst
+    except OSError:
+        return None
 
 
 def read_sidecar(directory: str, step: int) -> Optional[dict]:
@@ -159,6 +207,10 @@ def needs_reshard(meta: dict, template: Any) -> bool:
     template's -- the cross-topology case the explicit reshard path
     exists for. Same-mesh spec differences stay on the direct restore
     (orbax lands bytes straight into the template's shardings)."""
+    if not meta.get("mesh"):
+        # Mesh-less sidecar (host-local save, or a checksums-only
+        # record): nothing cross-topology to reconcile.
+        return False
     mesh = live_mesh_of(template)
     if mesh is None:
         return False

@@ -21,18 +21,31 @@ argues is mandatory at scale:
                 replacing the shell watchdog; attempt-unique log
                 paths, failure dumps are never overwritten
   faults.py     deterministic fault injection (kill-at-step,
-                preempt-at-step, stall, corrupt-ckpt-write) so all of
+                preempt-at-step, stall, corrupt/bitflip-ckpt-write,
+                nan-loss, grad-spike, straggler delay) so all of
                 the above is testable on CPU
+  guard.py      numeric-health guard: per-step health vector
+                classification (healthy/spike/poisoned) with
+                skip-batch and rollback-to-last-good actions, plus
+                the persisted skip windows that fast-forward the
+                data stream past poisoned batches
 
 Everything here is stdlib-only and import-cheap: the supervisor must
-start (and restart a dead run) without touching jax.
+start (and restart a dead run) without touching jax (guard.py's and
+faults.py's jax-touching closures import it lazily).
 """
 from tpu_hpc.resilience.faults import FaultPlan, fault_plan_from_env  # noqa: F401
+from tpu_hpc.resilience.guard import (  # noqa: F401
+    GuardError,
+    GuardPolicy,
+    StepVerdict,
+)
 from tpu_hpc.resilience.heartbeat import HangWatchdog, Heartbeat  # noqa: F401
 from tpu_hpc.resilience.retry import backoff_delays, retry_call  # noqa: F401
 from tpu_hpc.resilience.signals import (  # noqa: F401
     EXIT_HANG,
     EXIT_RESUMABLE,
+    EXIT_ROLLBACK,
     PreemptionGuard,
     exit_code_for,
 )

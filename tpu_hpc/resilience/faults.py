@@ -22,10 +22,33 @@ boundaries, i.e. at the first progress point where ``step >= N``):
 * ``corrupt_ckpt_at_step=N``  garbage every file of checkpoint step N
                          after it lands -- a torn write; exercises
                          restore fallback to the previous step.
+* ``bitflip_ckpt_at_step=N``  flip ONE BIT in one tensor of checkpoint
+                         step N, rewritten through orbax so every file
+                         stays parseable -- a silent data corruption
+                         (SDC) the torn-write fallback cannot see;
+                         exercises ckpt.integrity checksum
+                         verification + quarantine.
+* ``nan_loss_at_step=N`` force the jitted step's loss AND gradients
+                         non-finite when the DATA INDEX equals N (a
+                         poisoned batch); exercises the numeric-health
+                         guard's skip / rollback-to-last-good paths.
+                         Keyed on the data index, not the step, so a
+                         rollback that really fast-forwards past the
+                         poisoned batch never re-hits it.
+* ``grad_spike_at_step=N`` (scale ``grad_spike_scale``, default 1e4)
+                         multiply the step's gradients at data index N
+                         -- a loss spike; exercises the guard's
+                         rolling-median spike detection.
+* ``straggler_ms=F``     sleep F ms inside every metered chunk (from
+                         ``straggler_at_step``, default 0) -- a
+                         degraded host; exercises the stall watermark.
 
 ``on_attempt`` (default 0) scopes injection to one restart ordinal so
 a supervised run fails once and then completes -- the
 restart-with-resume round trip, deterministic end to end.
+``on_attempt=-1`` arms the fault on EVERY attempt: the guard's
+rollback proof uses it so the only way the relaunch survives is by
+actually skipping the poisoned data index.
 """
 from __future__ import annotations
 
@@ -44,7 +67,17 @@ _INT_KEYS = (
     "preempt_at_step",
     "stall_at_step",
     "corrupt_ckpt_at_step",
+    "bitflip_ckpt_at_step",
+    "nan_loss_at_step",
+    "grad_spike_at_step",
+    "straggler_at_step",
     "on_attempt",
+)
+
+_FLOAT_KEYS = (
+    "stall_s",
+    "grad_spike_scale",
+    "straggler_ms",
 )
 
 
@@ -56,6 +89,12 @@ class FaultPlan:
     preempt_at_step: Optional[int] = None
     stall_at_step: Optional[int] = None
     corrupt_ckpt_at_step: Optional[int] = None
+    bitflip_ckpt_at_step: Optional[int] = None
+    nan_loss_at_step: Optional[int] = None
+    grad_spike_at_step: Optional[int] = None
+    grad_spike_scale: float = 1e4
+    straggler_ms: float = 0.0
+    straggler_at_step: int = 0
     stall_s: float = 3600.0
     on_attempt: int = 0
     attempt: int = 0
@@ -71,8 +110,10 @@ class FaultPlan:
     @property
     def active(self) -> bool:
         """Injection is scoped to one restart ordinal: the fault fires
-        once, and the relaunched attempt runs clean."""
-        return self.attempt == self.on_attempt
+        once, and the relaunched attempt runs clean. ``on_attempt=-1``
+        arms every attempt (the rollback proofs need the poison to
+        persist across the relaunch)."""
+        return self.on_attempt == -1 or self.attempt == self.on_attempt
 
     def _announce(self, kind: str, step: int, dump: bool) -> None:
         """Record the injection in the telemetry spine: a ``fault``
@@ -124,8 +165,80 @@ class FaultPlan:
             self._announce("kill", step, dump=True)
             os.kill(os.getpid(), signal.SIGKILL)
 
+    def maybe_straggle(self, step: int) -> None:
+        """Per-chunk host delay (``straggler_ms``, from
+        ``straggler_at_step``): the trainer calls this INSIDE its
+        metered window so the injected slowness is visible to the
+        stall watermark, exactly like a thermally-throttling host."""
+        if (
+            not self.active
+            or self.straggler_ms <= 0
+            or step < self.straggler_at_step
+        ):
+            return
+        self._announce("straggler", step, dump=False)
+        time.sleep(self.straggler_ms / 1000.0)
+
+    def numeric_fault_fn(self):
+        """A ``(data_index, loss, grads) -> (loss, grads)`` closure
+        perturbing the jitted training step, or None when no numeric
+        fault is armed. Keyed on the DATA index (``step + skip-window
+        offset``), so a guard rollback that fast-forwards the stream
+        past the poisoned batch genuinely never re-hits it -- the
+        end-to-end proof that the skip window works.
+
+        jax is imported inside the closure: this module must stay
+        import-cheap for the supervisor (package contract)."""
+        if not self.active or (
+            self.nan_loss_at_step is None
+            and self.grad_spike_at_step is None
+        ):
+            return None
+        if self.nan_loss_at_step is not None:
+            self._announce("nan_loss", self.nan_loss_at_step, dump=False)
+        if self.grad_spike_at_step is not None:
+            self._announce(
+                "grad_spike", self.grad_spike_at_step, dump=False
+            )
+        nan_at = self.nan_loss_at_step
+        spike_at = self.grad_spike_at_step
+        spike_scale = self.grad_spike_scale
+
+        def apply(data_index, loss, grads):
+            import jax
+            import jax.numpy as jnp
+
+            if nan_at is not None:
+                bad = data_index == nan_at
+                loss = jnp.where(bad, jnp.nan, loss)
+                grads = jax.tree.map(
+                    lambda g: jnp.where(
+                        bad, jnp.asarray(jnp.nan, g.dtype), g
+                    ),
+                    grads,
+                )
+            if spike_at is not None:
+                scale = jnp.where(
+                    data_index == spike_at, spike_scale, 1.0
+                )
+                grads = jax.tree.map(
+                    lambda g: g * scale.astype(g.dtype), grads
+                )
+            return loss, grads
+
+        return apply
+
     def wants_ckpt_corruption(self, step: int) -> bool:
         return self.active and self.corrupt_ckpt_at_step == step
+
+    def wants_ckpt_bitflip(self, step: int) -> bool:
+        """Silent-corruption schedule: the actual flip lives in
+        ckpt.CheckpointManager (it needs orbax to rewrite the step
+        parseably); this module only owns WHEN."""
+        return self.active and self.bitflip_ckpt_at_step == step
+
+    def announce_bitflip(self, step: int) -> None:
+        self._announce("bitflip_ckpt", step, dump=False)
 
     def corrupt_checkpoint(self, step_dir: str) -> int:
         """Garbage every regular file under ``step_dir`` (a torn
@@ -152,6 +265,10 @@ def fault_plan_from_env(env=None) -> Optional[FaultPlan]:
 
     Unknown keys are a hard error: a typo'd fault spec silently
     injecting nothing would make a resilience test pass vacuously.
+    A malformed VALUE is equally hard an error, and names the key and
+    the full spec (same discipline) -- a bare ``int()`` traceback
+    would point at this module instead of the operator's typo.
+    Duplicate keys are last-wins, like the env vars they ride in on.
     """
     env = os.environ if env is None else env
     spec = env.get(ENV_FAULTS, "").strip()
@@ -165,12 +282,19 @@ def fault_plan_from_env(env=None) -> Optional[FaultPlan]:
         key, _, val = part.partition("=")
         key = key.strip()
         if key in _INT_KEYS:
-            fields[key] = int(val)
-        elif key == "stall_s":
-            fields[key] = float(val)
+            cast, kind = int, "an integer"
+        elif key in _FLOAT_KEYS:
+            cast, kind = float, "a number"
         else:
             raise ValueError(
                 f"unknown fault key {key!r} in {ENV_FAULTS}={spec!r} "
-                f"(known: {', '.join(_INT_KEYS + ('stall_s',))})"
+                f"(known: {', '.join(_INT_KEYS + _FLOAT_KEYS)})"
             )
+        try:
+            fields[key] = cast(val.strip())
+        except ValueError:
+            raise ValueError(
+                f"invalid value {val.strip()!r} for fault key "
+                f"{key!r} in {ENV_FAULTS}={spec!r}: expected {kind}"
+            ) from None
     return FaultPlan(attempt=current_attempt(env), **fields)

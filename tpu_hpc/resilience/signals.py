@@ -31,11 +31,20 @@ from typing import Iterable, Optional, Tuple
 EXIT_RESUMABLE = 75
 # Hang-watchdog abort: progress stalled; diagnostics were dumped.
 EXIT_HANG = 76
+# Numeric-health rollback (resilience.guard): poisoned snapshots were
+# quarantined and a skip window recorded; the relaunch resumes from the
+# last-good checkpoint and fast-forwards the data stream past the
+# poisoned batches. Like EXIT_RESUMABLE it means "nothing is wrong
+# with the PROCESS, relaunch me" -- but the supervisor counts it
+# against a separate rollback budget: an unbounded rollback loop means
+# the data (or the model) is poisoned faster than checkpoints land.
+EXIT_ROLLBACK = 77
 
 _MEANINGS = {
     0: "success",
     EXIT_RESUMABLE: "resumable (preemption snapshot taken)",
     EXIT_HANG: "hang-watchdog abort (progress stalled)",
+    EXIT_ROLLBACK: "guard rollback (resume from last-good snapshot)",
 }
 
 
@@ -46,14 +55,20 @@ def describe_exit(code: int) -> str:
     return _MEANINGS.get(code, f"failure (exit {code})")
 
 
-def exit_code_for(preempted: bool) -> int:
+def exit_code_for(preempted: bool, rolled_back: bool = False) -> int:
     """The code a training entry point should exit with after fit():
-    the resumable contract when the run stopped on a preemption
-    notice, plain success otherwise. Usage::
+    the rollback contract when the numeric-health guard rolled the
+    run back (takes precedence -- the supervisor must charge its
+    rollback budget, not the free preemption carve-out), the
+    resumable contract when the run stopped on a preemption notice,
+    plain success otherwise. Usage::
 
         result = trainer.fit(ds)
-        sys.exit(exit_code_for(result.get("preempted", False)))
+        sys.exit(exit_code_for(result.get("preempted", False),
+                               result.get("rolled_back", False)))
     """
+    if rolled_back:
+        return EXIT_ROLLBACK
     return EXIT_RESUMABLE if preempted else 0
 
 

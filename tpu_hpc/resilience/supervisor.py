@@ -20,10 +20,13 @@ automatically):
   interpreter stuck in C++): the supervisor kills and restarts it.
 * Exit 0 ends the run. ``EXIT_RESUMABLE`` (75, a clean preemption
   snapshot) restarts WITHOUT consuming the failure budget -- per the
-  signals.py contract it means "nothing is wrong, relaunch me". Any
-  other nonzero code restarts up to ``--max-restarts`` times; every
-  attempt resumes from the newest checkpoint via the Trainer's own
-  auto-resume.
+  signals.py contract it means "nothing is wrong, relaunch me".
+  ``EXIT_ROLLBACK`` (77, a numeric-health rollback from
+  resilience.guard) also restarts without burning the failure budget,
+  but against its own ``--max-rollbacks`` bound -- a run that keeps
+  poisoning itself must not relaunch forever. Any other nonzero code
+  restarts up to ``--max-restarts`` times; every attempt resumes from
+  the newest checkpoint via the Trainer's own auto-resume.
 
 Provenance rules (VERDICT item 9 -- the overwritten OOM dump): every
 attempt logs to an ATTEMPT-UNIQUE path (``run.attempt<N>.log``; if a
@@ -50,6 +53,7 @@ from tpu_hpc.resilience.retry import backoff_delays
 from tpu_hpc.resilience.signals import (
     EXIT_HANG,
     EXIT_RESUMABLE,
+    EXIT_ROLLBACK,
     describe_exit,
 )
 
@@ -85,6 +89,7 @@ class Supervisor:
         kill_grace_s: float = 10.0,
         poll_s: float = 0.2,
         max_preemptions: int = 100,
+        max_rollbacks: int = 8,
     ):
         if not cmd:
             raise ValueError("empty command")
@@ -94,6 +99,10 @@ class Supervisor:
             raise ValueError(
                 f"max_preemptions {max_preemptions} must be >= 0"
             )
+        if max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks {max_rollbacks} must be >= 0"
+            )
         self.cmd = list(cmd)
         self.max_restarts = max_restarts
         self.log_dir = log_dir
@@ -101,6 +110,7 @@ class Supervisor:
         self.heartbeat_timeout = heartbeat_timeout
         self.backoff = backoff
         self.max_preemptions = max_preemptions
+        self.max_rollbacks = max_rollbacks
         self.no_restart_on = set(no_restart_on)
         self.kill_grace_s = kill_grace_s
         self.poll_s = poll_s
@@ -239,6 +249,7 @@ class Supervisor:
             attempt = 0
             failures = 0
             preemptions = 0
+            rollbacks = 0
             while True:
                 self._event(
                     event="attempt_start", attempt=attempt,
@@ -289,6 +300,38 @@ class Supervisor:
                         event="restarting", next_attempt=attempt + 1,
                         backoff_s=round(self.backoff, 3),
                         why="resumable preemption snapshot",
+                    )
+                    time.sleep(self.backoff)
+                    if self._stop_requested:
+                        return rc
+                    attempt += 1
+                    continue
+                if rc == EXIT_ROLLBACK:
+                    # Numeric-health rollback (resilience.guard): the
+                    # child quarantined poisoned snapshots, recorded a
+                    # skip window, and asked to be relaunched from the
+                    # last-good checkpoint. Healthy-process exits, so
+                    # they never burn the failure budget -- but they
+                    # get their OWN bound, distinct from both the
+                    # restart and the preemption budgets: repeated
+                    # rollbacks mean the run poisons itself faster
+                    # than checkpoints land (bad data shard, diverging
+                    # model), and relaunching forever just burns the
+                    # allocation re-training the same span.
+                    if rollbacks >= self.max_rollbacks:
+                        self._event(
+                            event="giving_up", attempt=attempt, rc=rc,
+                            why=f"rollback budget "
+                            f"({self.max_rollbacks}) exhausted -- the "
+                            "run keeps hitting numeric anomalies "
+                            "faster than it checkpoints past them",
+                        )
+                        return rc
+                    rollbacks += 1
+                    self._event(
+                        event="restarting", next_attempt=attempt + 1,
+                        backoff_s=round(self.backoff, 3),
+                        why="guard rollback to last-good snapshot",
                     )
                     time.sleep(self.backoff)
                     if self._stop_requested:
@@ -398,6 +441,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "exhausting it usually means preemptions outpace checkpoints",
     )
     ap.add_argument(
+        "--max-rollbacks", type=int, default=8,
+        help="separate bound on EXIT_ROLLBACK (77) numeric-health "
+        "rollback restarts (resilience.guard; they never burn "
+        "--max-restarts); exhausting it means the run keeps "
+        "poisoning itself faster than it checkpoints past the bad "
+        "spans",
+    )
+    ap.add_argument(
         "--no-restart-on", type=str, default="",
         help="comma-separated exit codes that end the run immediately "
         "(e.g. '2' for usage errors)",
@@ -419,6 +470,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         backoff=args.backoff,
         no_restart_on=no_restart,
         max_preemptions=args.max_preemptions,
+        max_rollbacks=args.max_rollbacks,
     )
 
 
