@@ -584,14 +584,30 @@ def serve_record(summary: dict, disagg: bool = False) -> dict:
     quantiles riding along. vs_baseline = serving MFU (forward-only
     2N accounting, train.metrics.mfu mode="inference") against the
     same 40% north-star target the training rows use; None on
-    backends with no published peak (CPU sim)."""
+    backends with no published peak (CPU sim). The KV-cache layout
+    (slab|paged, block size, prefix-hit rate) is part of the record's
+    identity -- a paged row must never be diffed against a slab one
+    unlabeled."""
     mfu = summary.get("serve_mfu")
     rec_serve = {
         "requests": summary["requests"],
         "slots": summary["slots"],
         "prefill_buckets": summary["prefill_buckets"],
         "recompiles": summary["recompiles"],
+        "kv_layout": summary.get("kv_layout", "slab"),
     }
+    if summary.get("kv_layout") == "paged":
+        rec_serve.update(
+            kv_block_size=summary.get("kv_block_size"),
+            kv_blocks=summary.get("kv_blocks"),
+            prefix_hit_rate=round(
+                summary.get("prefix_hit_rate", 0.0), 4
+            ),
+            prefix_hit_blocks=summary.get("prefix_hit_blocks", 0),
+            block_stalls=summary.get("batcher", {}).get(
+                "block_stalls", 0
+            ),
+        )
     if disagg:
         d = summary.get("disagg", {})
         rec_serve["disagg"] = {
@@ -617,10 +633,34 @@ def serve_record(summary: dict, disagg: bool = False) -> dict:
     }
 
 
+def _bench_paged_cfg(
+    paged: bool, slots: int, max_seq: int, buckets,
+    block_size=None, kv_blocks=None, prefill_chunk=None,
+):
+    """(PagedConfig | None, page-aligned max_seq) for the serve/
+    loadgen rows. ONE derivation shared with server.py's CLI
+    (paging.derive_paged_config), so the bench rows and the serving
+    CLI can never silently diverge on a default; invalid sizing is a
+    clean CLI error, not a ValueError traceback after model init."""
+    if not paged:
+        return None, max_seq
+    from tpu_hpc.serve.paging import derive_paged_config
+
+    try:
+        return derive_paged_config(
+            slots, max_seq, buckets,
+            block_size=block_size, num_blocks=kv_blocks,
+            prefill_chunk=prefill_chunk, align_capacity=True,
+        )
+    except ValueError as e:
+        raise SystemExit(f"bench.py: {e}")
+
+
 def bench_serve(
     requests: int = 32, slots: int = 8, max_new: int = 64,
     prompt_lens=(96, 192, 384), buckets=(128, 256, 512),
-    model_cfg=None, disagg: bool = False,
+    model_cfg=None, disagg: bool = False, paged: bool = False,
+    block_size=None, kv_blocks=None, prefill_chunk=None,
 ) -> dict:
     """Batched-inference throughput: the SAME ~170M bench architecture
     as the training headline (bench_model_cfg -- one factory, so
@@ -644,18 +684,23 @@ def bench_serve(
             f"tier); only {jax.device_count()} visible"
         )
     model_cfg = model_cfg or bench_model_cfg()
+    paged_cfg, max_seq = _bench_paged_cfg(
+        paged, slots, max(buckets) + max_new, buckets,
+        block_size, kv_blocks, prefill_chunk,
+    )
     serve_cfg = ServeConfig(
         slots=slots,
-        max_seq_len=max(buckets) + max_new,
+        max_seq_len=max_seq,
         prefill_buckets=tuple(buckets),
     )
     summary = run_replay(
         model_cfg, serve_cfg, requests, prompt_lens, max_new,
-        disagg=disagg,
+        disagg=disagg, paged=paged_cfg,
     )
     rec = serve_record(summary, disagg=disagg)
     print(
-        f"serve{'-disagg' if disagg else ''} | "
+        f"serve{'-disagg' if disagg else ''}"
+        f"{'-paged' if paged else ''} | "
         f"{summary['mesh']} slots={slots} | "
         f"{summary['tokens_per_s']:.0f} tokens/s | "
         f"TTFT p50 {summary['ttft_ms_p50']:.0f} ms | "
@@ -673,8 +718,42 @@ def loadgen_record(summary: dict) -> dict:
     the per-tenant shed/queued breakdown riding along so the regress
     gate can hold admission control to its history."""
     tenants = summary.get("tenants", {})
+    lg = {
+        "scenario": summary["scenario"],
+        "seed": summary["seed"],
+        "shed": summary["shed"],
+        "queued": summary["queued"],
+        "occupancy_mean": round(summary["occupancy_mean"], 4),
+        "stall_events": summary["stall_events"],
+        "slo_violations": summary["slo_violations"],
+        "recompiles": summary["recompiles"],
+        "kv_layout": summary.get("kv_layout", "slab"),
+        "tenants": {
+            name: {
+                "shed": t["shed"], "queued": t["queued"],
+                "ttft_ms_p95": round(t["ttft_ms_p95"], 3),
+            }
+            for name, t in tenants.items()
+        },
+    }
+    metric = f"loadgen_{summary['scenario']}_ttft_ms_p95"
+    if summary.get("kv_layout") == "paged":
+        lg.update(
+            kv_block_size=summary.get("kv_block_size"),
+            kv_blocks=summary.get("kv_blocks"),
+            prefix_hit_rate=round(
+                summary.get("prefix_hit_rate", 0.0), 4
+            ),
+            block_stalls=summary.get("batcher", {}).get(
+                "block_stalls", 0
+            ),
+        )
+        # The cache layout is part of the metric's identity: the
+        # --bank gate must track paged and slab trajectories
+        # separately (at equal traffic they are different systems).
+        metric = f"loadgen_{summary['scenario']}_paged_ttft_ms_p95"
     return {
-        "metric": f"loadgen_{summary['scenario']}_ttft_ms_p95",
+        "metric": metric,
         "value": round(summary["ttft_ms_p95"], 3),
         "unit": "virtual_ms",
         "vs_baseline": None,
@@ -682,52 +761,60 @@ def loadgen_record(summary: dict) -> dict:
         "ttft_ms_p99": round(summary["ttft_ms_p99"], 3),
         "itl_ms_p50": round(summary["itl_ms_p50"], 3),
         "itl_ms_p95": round(summary["itl_ms_p95"], 3),
-        "loadgen": {
-            "scenario": summary["scenario"],
-            "seed": summary["seed"],
-            "shed": summary["shed"],
-            "queued": summary["queued"],
-            "occupancy_mean": round(summary["occupancy_mean"], 4),
-            "stall_events": summary["stall_events"],
-            "slo_violations": summary["slo_violations"],
-            "recompiles": summary["recompiles"],
-            "tenants": {
-                name: {
-                    "shed": t["shed"], "queued": t["queued"],
-                    "ttft_ms_p95": round(t["ttft_ms_p95"], 3),
-                }
-                for name, t in tenants.items()
-            },
-        },
+        "loadgen": lg,
     }
 
 
 def bench_loadgen(
     scenario: str = "multi_tenant", requests: int = 64,
     slots: int = 8, max_new: int = 32, seed: int = 0,
+    paged: bool = False, block_size=None, kv_blocks=None,
+    prefill_chunk=None, model: str = "bench",
 ) -> dict:
     """Scenario-diverse load row: the SAME ~170M bench architecture as
     the serve row, driven by the tpu_hpc.loadgen harness. ``recompiles``
     must read 0 like the serve row -- a scenario mix that recompiled
-    would be measuring the compiler."""
+    would be measuring the compiler.
+
+    ``model="tiny"`` swaps in the 8-device-sim dev model
+    (serve/server.tiny_config). This is legal for THIS workload only:
+    loadgen latencies run on the virtual clock, a pure function of
+    (scenario, seed, serve shape, cost model) -- the model provides
+    the real programs but contributes zero virtual time, so the
+    banked quantiles are identical across models. The record still
+    carries ``model`` so no row masquerades as a bench-architecture
+    measurement."""
+    import dataclasses as _dc
+
     from tpu_hpc.runtime import init_distributed
     from tpu_hpc.serve.engine import ServeConfig
-    from tpu_hpc.serve.server import run_loadgen
+    from tpu_hpc.serve.server import run_loadgen, tiny_config
 
     init_distributed(verbose=False)
-    model_cfg = bench_model_cfg()
+    if model == "tiny":
+        # The dev model's capacity must still hold bucket + max_new.
+        model_cfg = _dc.replace(tiny_config(), max_seq_len=1024)
+    else:
+        model_cfg = bench_model_cfg()
     buckets = (128, 256, 512)
+    paged_cfg, max_seq = _bench_paged_cfg(
+        paged, slots, max(buckets) + max_new, buckets,
+        block_size, kv_blocks, prefill_chunk,
+    )
     serve_cfg = ServeConfig(
         slots=slots,
-        max_seq_len=max(buckets) + max_new,
+        max_seq_len=max_seq,
         prefill_buckets=buckets,
     )
     summary = run_loadgen(
-        model_cfg, serve_cfg, scenario, requests, max_new, seed=seed
+        model_cfg, serve_cfg, scenario, requests, max_new, seed=seed,
+        paged=paged_cfg,
     )
     rec = loadgen_record(summary)
+    rec["loadgen"]["model"] = model
     print(
-        f"loadgen {scenario} | shed {summary['shed']} "
+        f"loadgen {scenario}{' paged' if paged else ''} | "
+        f"shed {summary['shed']} "
         f"queued {summary['queued']} | TTFT p95 "
         f"{summary['ttft_ms_p95']:.1f} virtual-ms | occupancy "
         f"{summary['occupancy_mean']:.0%}",
@@ -975,6 +1062,34 @@ def main(argv=None) -> int:
         "input)",
     )
     ap.add_argument(
+        "--serve-paged", action="store_true",
+        help="paged KV cache (tpu_hpc/serve/paging.py): block-table "
+        "pool with prefix reuse + chunked prefill; the record carries "
+        "kv_layout/kv_block_size/prefix-hit rate (--workload serve "
+        "or loadgen)",
+    )
+    ap.add_argument(
+        "--serve-block-size", type=int, default=None, metavar="TOK",
+        help="tokens per KV page for --serve-paged (default 16)",
+    )
+    ap.add_argument(
+        "--serve-kv-blocks", type=int, default=None, metavar="N",
+        help="physical pages in the paged pool incl. scratch "
+        "(default: slab-equivalent capacity) for --serve-paged",
+    )
+    ap.add_argument(
+        "--serve-prefill-chunk", type=int, default=None, metavar="TOK",
+        help="chunked-prefill stride for --serve-paged (0/omitted = "
+        "whole-prompt prefill)",
+    )
+    ap.add_argument(
+        "--serve-model", choices=("bench", "tiny"), default="bench",
+        help="model for --workload loadgen ONLY: 'tiny' runs the "
+        "8-device-sim dev model -- legal because loadgen quantiles "
+        "are virtual-clock (model-independent); the record carries "
+        "the model label",
+    )
+    ap.add_argument(
         "--all", action="store_true",
         help="run every workload family, write BENCH_EXTRA.md/.jsonl",
     )
@@ -1102,6 +1217,34 @@ def main(argv=None) -> int:
             f"--workload {args.workload} would silently run "
             "single-tier"
         )
+    if args.serve_paged and args.workload not in ("serve", "loadgen"):
+        # Same discipline: a cache-layout flag the workload never
+        # consumes must be a CLI error, not a slab row labeled paged.
+        ap.error(
+            "--serve-paged is only consumed by --workload "
+            f"serve/loadgen; --workload {args.workload} would "
+            "silently run the slab cache"
+        )
+    if not args.serve_paged:
+        for flag, val in (
+            ("--serve-block-size", args.serve_block_size),
+            ("--serve-kv-blocks", args.serve_kv_blocks),
+            ("--serve-prefill-chunk", args.serve_prefill_chunk),
+        ):
+            if val is not None:
+                ap.error(
+                    f"{flag} is only consumed together with "
+                    "--serve-paged"
+                )
+    if args.serve_model != "bench" and args.workload != "loadgen":
+        # The dev model is ONLY legal where the virtual clock makes
+        # the row model-independent; a tiny-model wall-clock serve row
+        # would be an incomparable number wearing the bench label.
+        ap.error(
+            "--serve-model tiny is only consumed by --workload "
+            f"loadgen (virtual-clock rows); --workload "
+            f"{args.workload} measures wall clock on the bench model"
+        )
     if args.guard_mode != "off" and (
         args.all or args.workload not in ("llama", "llama-long")
     ):
@@ -1208,6 +1351,10 @@ def main(argv=None) -> int:
         rec = bench_serve(
             requests=args.serve_requests, slots=args.serve_slots,
             max_new=args.serve_max_new, disagg=args.serve_disagg,
+            paged=args.serve_paged,
+            block_size=args.serve_block_size,
+            kv_blocks=args.serve_kv_blocks,
+            prefill_chunk=args.serve_prefill_chunk,
         )
     elif args.workload == "loadgen":
         rec = bench_loadgen(
@@ -1215,6 +1362,11 @@ def main(argv=None) -> int:
             requests=args.serve_requests * 2,
             slots=args.serve_slots,
             max_new=args.serve_max_new,
+            paged=args.serve_paged,
+            block_size=args.serve_block_size,
+            kv_blocks=args.serve_kv_blocks,
+            prefill_chunk=args.serve_prefill_chunk,
+            model=args.serve_model,
         )
     else:
         rec = bench_unet(args.steps)

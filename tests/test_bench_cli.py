@@ -273,9 +273,13 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
     with the serve-specific knobs."""
     seen = {}
 
-    def fake_bench_serve(requests, slots, max_new, disagg=False):
+    def fake_bench_serve(requests, slots, max_new, disagg=False,
+                         paged=False, block_size=None, kv_blocks=None,
+                         prefill_chunk=None):
         seen.update(requests=requests, slots=slots, max_new=max_new,
-                    disagg=disagg)
+                    disagg=disagg, paged=paged,
+                    block_size=block_size, kv_blocks=kv_blocks,
+                    prefill_chunk=prefill_chunk)
         return {"metric": "serve_tokens_per_s_per_chip", "value": 1,
                 "unit": "tokens/s/chip", "vs_baseline": None}
 
@@ -287,14 +291,24 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
     ])
     assert rc == 0
     assert seen == {"requests": 12, "slots": 4, "max_new": 7,
-                    "disagg": False}
+                    "disagg": False, "paged": False,
+                    "block_size": None, "kv_blocks": None,
+                    "prefill_chunk": None}
     seen.clear()
     assert bench.main(["--workload", "serve"]) == 0
-    assert seen == {"requests": 32, "slots": 8, "max_new": 64,
-                    "disagg": False}
+    assert seen["requests"] == 32 and seen["slots"] == 8
+    assert seen["max_new"] == 64 and seen["disagg"] is False
     seen.clear()
     assert bench.main(["--workload", "serve", "--serve-disagg"]) == 0
     assert seen["disagg"] is True
+    seen.clear()
+    assert bench.main([
+        "--workload", "serve", "--serve-paged",
+        "--serve-block-size", "32", "--serve-kv-blocks", "512",
+        "--serve-prefill-chunk", "128",
+    ]) == 0
+    assert seen["paged"] is True and seen["block_size"] == 32
+    assert seen["kv_blocks"] == 512 and seen["prefill_chunk"] == 128
 
 
 def test_serve_alias_conflicts_with_explicit_workload(bench, monkeypatch):
@@ -309,9 +323,12 @@ def test_loadgen_mode_routes_flags(bench, monkeypatch):
     queueing, which needs backlog)."""
     seen = {}
 
-    def fake_bench_loadgen(scenario, requests, slots, max_new):
+    def fake_bench_loadgen(scenario, requests, slots, max_new,
+                           paged=False, block_size=None,
+                           kv_blocks=None, prefill_chunk=None,
+                           model="bench"):
         seen.update(scenario=scenario, requests=requests, slots=slots,
-                    max_new=max_new)
+                    max_new=max_new, paged=paged)
         return {"metric": "loadgen_x_ttft_ms_p95", "value": 1.0,
                 "unit": "virtual_ms", "vs_baseline": None}
 
@@ -324,7 +341,14 @@ def test_loadgen_mode_routes_flags(bench, monkeypatch):
     ])
     assert rc == 0
     assert seen == {"scenario": "bursty", "requests": 32, "slots": 4,
-                    "max_new": 16}
+                    "max_new": 16, "paged": False}
+    seen.clear()
+    assert bench.main([
+        "--workload", "loadgen", "--loadgen-scenario",
+        "shared_prefix", "--serve-paged",
+    ]) == 0
+    assert seen["scenario"] == "shared_prefix"
+    assert seen["paged"] is True
     # Misplaced scenario flag = CLI error (the --comm-mode
     # discipline), never a silently-plain run recorded as the
     # scenario.
@@ -333,6 +357,50 @@ def test_loadgen_mode_routes_flags(bench, monkeypatch):
     with pytest.raises(SystemExit):
         bench.main(["--workload", "serve",
                     "--loadgen-scenario", "colocate"])
+
+
+def test_paged_flags_guarded_like_comm_mode(bench, monkeypatch):
+    """--serve-paged on a workload that never consumes it is a CLI
+    error (a slab row labeled paged would poison the bank), and the
+    paged sizing flags require --serve-paged."""
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "llama", "--serve-paged"])
+    for flag, val in (
+        ("--serve-block-size", "16"),
+        ("--serve-kv-blocks", "64"),
+        ("--serve-prefill-chunk", "128"),
+    ):
+        with pytest.raises(SystemExit):
+            bench.main(["--workload", "serve", flag, val])
+    # The tiny dev model is only legal where quantiles are
+    # virtual-clock (loadgen); a wall-clock serve row on it would
+    # wear the bench label while measuring a different machine.
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "serve", "--serve-model", "tiny"])
+
+
+def test_serve_record_carries_kv_layout(bench):
+    """Serve records are labeled with their cache layout; paged rows
+    add block size + prefix-hit evidence."""
+    base = {
+        "requests": 8, "slots": 4, "prefill_buckets": [8],
+        "recompiles": 0, "tokens_per_s_per_chip": 10.0,
+        "ttft_ms_p50": 1.0, "ttft_ms_p95": 2.0,
+        "itl_ms_p50": 1.0, "itl_ms_p95": 2.0,
+    }
+    rec = bench.serve_record(dict(base, kv_layout="slab"))
+    assert rec["serve"]["kv_layout"] == "slab"
+    assert "prefix_hit_rate" not in rec["serve"]
+    rec = bench.serve_record(dict(
+        base, kv_layout="paged", kv_block_size=16, kv_blocks=64,
+        prefix_hit_rate=0.25, prefix_hit_blocks=12,
+        batcher={"block_stalls": 3},
+    ))
+    assert rec["serve"]["kv_layout"] == "paged"
+    assert rec["serve"]["kv_block_size"] == 16
+    assert rec["serve"]["prefix_hit_rate"] == 0.25
+    assert rec["serve"]["block_stalls"] == 3
 
 
 def test_loadgen_record_schema_matches_training_benches(bench):

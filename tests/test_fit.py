@@ -394,3 +394,65 @@ class TestKVCacheTerm:
         md = fit.to_markdown(with_kv)
         assert "KV cache (decode, 64 slots)" in md
         assert "KV cache" not in fit.to_markdown(full_7b)
+
+
+class TestPagedKVTerm:
+    """The paged-pool HBM model (--kv-blocks/--kv-block-size) and the
+    slab-vs-paged fragmentation-headroom comparison."""
+
+    def test_formula_exact(self):
+        cfg = llama2.LlamaConfig(
+            dim=64, n_layers=3, n_heads=4, n_kv_heads=2,
+            vocab_size=128, multiple_of=16, max_seq_len=32,
+        )
+        # blocks x block_size x layers x kv_heads x head_dim x 2 x bf16
+        want = 100 * 16 * 3 * 2 * 16 * 2 * 2
+        assert fit.kv_paged_bytes(cfg, 100, 16) == want
+        assert fit.kv_paged_bytes(
+            cfg, 100, 16, cache_dtype="float32"
+        ) == 2 * want
+
+    @pytest.fixture(scope="class")
+    def with_paged(self, full_7b):
+        # Slab 64 slots x 4096 worst-case vs a pool provisioned for
+        # the tokens the mix actually occupies (half the worst case).
+        return fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False, kv_slots=64,
+            kv_blocks=8192, kv_block_size=16,
+        )
+
+    def test_paged_term_replaces_slab_in_total(
+        self, full_7b, with_paged
+    ):
+        full = fit.kv_paged_bytes(full_7b.cfg, 8192, 16)
+        # KV heads shard over tp=8; the pool replicates over data.
+        assert with_paged.kv_block_bytes == full // 8
+        assert with_paged.total_bytes == \
+            full_7b.total_bytes + with_paged.kv_block_bytes
+        d = with_paged.to_json()
+        assert d["kv_block_bytes"] == with_paged.kv_block_bytes
+        assert d["kv_blocks"] == 8192
+        assert d["kv_block_size"] == 16
+
+    def test_markdown_headroom_line(self, with_paged):
+        md = fit.to_markdown(with_paged)
+        assert "KV cache (paged, 8192 pages x 16 tok)" in md
+        assert "Fragmentation headroom (per data replica" in md
+        # Per REPLICA (the only sharding-honest comparison): the
+        # slab's share is 64/4 slots x 4096 = 65536 tokens; the pool
+        # is 8192 x 16 = 131072 tokens -- over-provisioned 2x, and
+        # the line must say so rather than flatter the config.
+        assert "MORE** than the slab share" in md
+
+    def test_cli_flags_reach_analyze(self, capsys):
+        rc = fit.main([
+            "--no-compile", "--kv-slots", "64",
+            "--kv-blocks", "4096", "--kv-block-size", "16", "--json",
+        ])
+        import json as _json
+
+        out = _json.loads(capsys.readouterr().out)
+        assert out["kv_blocks"] == 4096
+        assert out["kv_block_bytes"] > 0
+        assert rc in (0, 1)

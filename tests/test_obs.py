@@ -348,6 +348,22 @@ class TestStallDetector:
             # the detector would be silently off forever.
             obs.StallDetector(window=3, min_samples=5)
 
+    def test_zero_watermark_window_never_divides(self):
+        """A window full of zero-duration steps (virtual-clock ticks
+        that did no metered work -- chunked prefill filling every
+        slot) must read as not-warm, not as an infinite-ratio stall:
+        caught live as a ZeroDivisionError in the shared_prefix paged
+        loadgen run."""
+        det = obs.StallDetector(window=8, factor=3.0, min_samples=2)
+        for step in range(4):
+            assert det.observe(step, 0.0) is None
+        assert det.observe(4, 1.0) is None  # no division, no stall
+        # Once real durations dominate the window, breaches fire
+        # again.
+        for step in range(5, 11):
+            det.observe(step, 1.0)
+        assert det.observe(11, 10.0) is not None
+
 
 # ---------------------------------------------------------------------
 # schema.py

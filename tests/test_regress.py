@@ -42,6 +42,33 @@ class TestCompare:
         assert lower_is_better("reshard_exchange_ms")
         assert lower_is_better("reshard_exchange_wire_bytes")
         assert lower_is_better("reshard.peak_inflight_bytes")
+        # Paged KV cache efficiency (serve/paging.py): a DROPPING hit
+        # rate and RISING block stalls are the regressions.
+        assert not lower_is_better("serve.prefix_hit_rate")
+        assert not lower_is_better("serve.kv_blocks_free_min")
+        assert lower_is_better("serve.block_stalls")
+
+    def test_paged_config_fields_not_compared(self):
+        """kv_block_size/kv_blocks (+free_min) are pool CONFIG, and
+        prefill_chunks/raw hit counts DROP when the cache improves: a
+        deliberate re-size or a better trie must not read as a perf
+        regression -- the gate judges prefix_hit_rate and
+        block_stalls only."""
+        from tpu_hpc.obs.regress import report_metrics
+
+        flat = report_metrics({
+            "serve": {
+                "prefix_hit_rate": 0.5, "kv_block_size": 16,
+                "kv_blocks": 64, "kv_blocks_free_min": 3,
+                "prefill_chunks": 9, "prefix_hits": 4,
+                "prefix_hit_blocks": 12, "kv_layout": "paged",
+                "block_stalls": 2, "requests": 8,
+            },
+        })
+        assert flat == {
+            "serve.prefix_hit_rate": 0.5,
+            "serve.block_stalls": 2.0,
+        }
 
     def test_identical_passes(self):
         m = {"serve.ttft_ms_p95": 10.0, "goodput": 0.9}

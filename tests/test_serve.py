@@ -260,6 +260,61 @@ class TestCompileDiscipline:
             ServeConfig(slots=2, max_seq_len=16, prefill_buckets=(32,))
 
 
+class TestPagedMode:
+    """The token-exact oracle holds in PAGED mode (serve/paging.py):
+    the same greedy_oracle that pins the slab engine pins the
+    block-table cache, prefix-hit or miss, with chunked prefill on.
+    The full paged suite (allocator properties, budget discipline,
+    disagg hop) lives in tests/test_paging.py; this section keeps the
+    oracle contract in the file that owns it."""
+
+    @pytest.fixture(scope="class")
+    def warm_paged(self, tiny_params, serve_mesh):
+        from tpu_hpc.serve import PagedConfig, PagedEngine
+
+        engine = PagedEngine(
+            tiny_params, TINY,
+            ServeConfig(slots=4, max_seq_len=48,
+                        prefill_buckets=(8, 16)),
+            serve_mesh,
+            PagedConfig(block_size=4, num_blocks=48, prefill_chunk=8),
+        )
+        engine.warmup()
+        return engine
+
+    def test_paged_decode_token_exact_hit_and_miss(
+        self, warm_paged, tiny_params, greedy_oracle
+    ):
+        rng = np.random.default_rng(20)
+        prompt = rng.integers(0, TINY.vocab_size, size=13).tolist()
+        want = greedy_oracle(tiny_params, prompt, 4)
+        cold = ContinuousBatcher(warm_paged).run(
+            [Request(rid="cold", prompt=prompt, max_new_tokens=4)]
+        )["cold"]
+        warm = ContinuousBatcher(warm_paged).run(
+            [Request(rid="warm", prompt=prompt, max_new_tokens=4)]
+        )["warm"]
+        assert cold == want
+        assert warm == want  # through a prefix hit
+        assert warm_paged.paged_stats["prefix_hits"] >= 1
+
+    def test_paged_zero_recompiles_with_chunking(self, warm_paged):
+        warmed = warm_paged.compile_count
+        rng = np.random.default_rng(21)
+        reqs = [
+            Request(
+                rid=f"pg{i}",
+                prompt=rng.integers(
+                    0, TINY.vocab_size, size=2 + (5 * i) % 14
+                ).tolist(),
+                max_new_tokens=1 + i % 4,
+            )
+            for i in range(7)
+        ]
+        ContinuousBatcher(warm_paged).run(reqs)
+        assert warm_paged.compile_count == warmed
+
+
 class TestServingWeights:
     def test_trainer_checkpoint_restores_into_serving_layout(
         self, tiny_params, serve_mesh, tmp_path

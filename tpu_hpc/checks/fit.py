@@ -99,6 +99,29 @@ def kv_cache_bytes(
     )
 
 
+def kv_paged_bytes(
+    cfg: llama2.LlamaConfig,
+    num_blocks: int,
+    block_size: int,
+    cache_dtype: str = "bfloat16",
+) -> int:
+    """Per-POD bytes of a PAGED decode KV cache
+    (tpu_hpc/serve/paging.py): num_blocks pages x block_size tokens x
+    layers x kv_heads x head_dim x 2 (K and V) x dtype. The paged
+    engine provisions pages for the tokens traffic actually holds,
+    not ``slots x max_seq`` worst case -- the difference against
+    :func:`kv_cache_bytes` at the same traffic mix is the
+    fragmentation/slack headroom paging reclaims, which
+    ``analyze(kv_blocks=...)`` reports next to the slab term. The
+    pool shards KV heads over the model axis only (pages are globally
+    addressable, so the block dim stays whole per replica)."""
+    itemsize = jnp.dtype(cache_dtype).itemsize
+    return (
+        num_blocks * block_size * cfg.n_layers * cfg.kv_heads
+        * cfg.head_dim * 2 * itemsize
+    )
+
+
 @dataclasses.dataclass
 class FitResult:
     cfg: llama2.LlamaConfig
@@ -127,6 +150,9 @@ class FitResult:
     )
     kv_cache_bytes: int = 0      # per chip, decode-config KV cache
     kv_slots: int = 0            # decode batch slots the term assumes
+    kv_block_bytes: int = 0      # per chip, PAGED decode KV pool
+    kv_blocks: int = 0           # physical pages the paged term assumes
+    kv_block_size: int = 0       # tokens per page
 
     @property
     def static_bytes(self) -> int:
@@ -134,9 +160,13 @@ class FitResult:
 
     @property
     def total_bytes(self) -> int:
+        # The paged pool REPLACES the slab cache when both are given
+        # (you deploy one engine); the slab term stays reported for
+        # the fragmentation-headroom comparison.
+        kv = self.kv_block_bytes if self.kv_blocks \
+            else self.kv_cache_bytes
         return (
-            self.static_bytes + sum(self.act_bytes.values())
-            + self.kv_cache_bytes
+            self.static_bytes + sum(self.act_bytes.values()) + kv
         )
 
     @property
@@ -512,6 +542,8 @@ def analyze(
     kv_slots: int = 0,
     kv_seq_len: Optional[int] = None,
     kv_cache_dtype: str = "bfloat16",
+    kv_blocks: int = 0,
+    kv_block_size: int = 16,
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
 
@@ -579,6 +611,26 @@ def analyze(
             denom *= tp_size
         kv_bytes_chip = -(-full // denom)
 
+    # Paged pool term (``kv_blocks > 0``): what the paged engine
+    # (tpu_hpc/serve/paging.py) would provision instead of the slab.
+    # Sharded as the pool is: KV heads over the model axis when they
+    # divide; the block dim replicates over data (pages are globally
+    # addressable within a replica).
+    kv_block_bytes_chip = 0
+    if kv_blocks:
+        if kv_block_size < 1:
+            raise ValueError(
+                f"kv_block_size {kv_block_size} must be >= 1"
+            )
+        full = kv_paged_bytes(
+            cfg, kv_blocks, kv_block_size, kv_cache_dtype
+        )
+        denom = 1
+        if layout == "tp" and tp_size > 1 \
+                and cfg.kv_heads % tp_size == 0:
+            denom *= tp_size
+        kv_block_bytes_chip = -(-full // denom)
+
     if layout == "pp":
         # The stage-shard byte accounting mirrors pp.stage_pspecs
         # (params stage-local, replicated over data -- the PP x DP
@@ -607,6 +659,9 @@ def analyze(
             attn=attn,
             kv_cache_bytes=kv_bytes_chip,
             kv_slots=kv_slots,
+            kv_block_bytes=kv_block_bytes_chip,
+            kv_blocks=kv_blocks,
+            kv_block_size=kv_block_size if kv_blocks else 0,
         )
         result.compiler_options = dict(compiler_options or {})
         if not do_compile:
@@ -670,6 +725,9 @@ def analyze(
         layout=layout,
         kv_cache_bytes=kv_bytes_chip,
         kv_slots=kv_slots,
+        kv_block_bytes=kv_block_bytes_chip,
+        kv_blocks=kv_blocks,
+        kv_block_size=kv_block_size if kv_blocks else 0,
     )
     if attn not in ("xla", "flash"):
         raise ValueError(f"unknown attn {attn!r} (xla|flash)")
@@ -822,11 +880,18 @@ def to_markdown(r: FitResult) -> str:
     ]
     for name, b in r.act_bytes.items():
         lines.append(f"| activations: {name} | {b:,} | {b/GIB:.2f} |")
-    if r.kv_cache_bytes:
+    if r.kv_cache_bytes and not r.kv_blocks:
         lines.append(
             f"| KV cache (decode, {r.kv_slots} slots) | "
             f"{r.kv_cache_bytes:,} | {r.kv_cache_bytes/GIB:.2f} |"
         )
+    if r.kv_blocks:
+        lines.append(
+            f"| KV cache (paged, {r.kv_blocks} pages x "
+            f"{r.kv_block_size} tok) | "
+            f"{r.kv_block_bytes:,} | {r.kv_block_bytes/GIB:.2f} |"
+        )
+    kv_live = r.kv_block_bytes if r.kv_blocks else r.kv_cache_bytes
     lines += [
         f"| **total** | **{r.total_bytes:,}** | "
         f"**{r.total_bytes/GIB:.2f}** |",
@@ -837,10 +902,54 @@ def to_markdown(r: FitResult) -> str:
         f"static {r.static_bytes/GIB:.2f} GiB + activations "
         f"{act_total/GIB:.2f} GiB"
         + (
-            f" + decode KV cache {r.kv_cache_bytes/GIB:.2f} GiB"
-            if r.kv_cache_bytes else ""
+            f" + decode KV cache {kv_live/GIB:.2f} GiB"
+            if kv_live else ""
         )
         + ").",
+    ]
+    if r.kv_blocks and r.kv_cache_bytes:
+        # The fragmentation-headroom comparison: same traffic, two
+        # cache disciplines, compared as LOGICAL capacity bytes --
+        # per-chip numbers would mix different shardings (the slab
+        # shards slots over data, the pool replicates per data
+        # replica) and mislabel a correctly sized pool at dp > 1
+        # (review finding). Reconstruct the unsharded totals from the
+        # per-chip values and the denominators analyze() applied.
+        tp_div = (
+            r.tp_size
+            if r.layout == "tp" and r.tp_size > 1
+            and cfg.kv_heads % r.tp_size == 0 else 1
+        )
+        # Per DATA REPLICA: the slab's per-chip term already divides
+        # by dp (slots shard over data) and tp; multiplying tp back
+        # gives the replica's slab share. The pool IS per-replica by
+        # construction, so the same multiply makes the two directly
+        # comparable at every dp.
+        slab_replica = r.kv_cache_bytes * tp_div
+        paged_replica = r.kv_block_bytes * tp_div
+        saved = slab_replica - paged_replica
+        lines += [
+            "",
+            f"Fragmentation headroom (per data replica -- the slab "
+            f"shards slots over data while each replica runs its own "
+            f"pool, so raw per-chip numbers are not comparable): the "
+            f"slab's replica share ({r.kv_slots} slots over "
+            f"dp={r.dp}, worst-case length) pins {slab_replica:,} "
+            f"bytes ({slab_replica/GIB:.2f} GiB); the paged pool "
+            f"({r.kv_blocks} pages x {r.kv_block_size} tokens) holds "
+            f"the same share in {paged_replica:,} bytes "
+            f"({paged_replica/GIB:.2f} GiB) -- "
+            + (
+                f"**{saved:,} bytes ({saved/GIB:.2f} GiB) of "
+                "slack/fragmentation reclaimed** for more concurrent "
+                "requests at equal HBM."
+                if saved >= 0 else
+                f"**{-saved:,} bytes ({-saved/GIB:.2f} GiB) MORE** "
+                "than the slab share -- this pool out-provisions the "
+                "mix; shrink --kv-blocks."
+            ),
+        ]
+    lines += [
         "",
         "Static accounting is exact (eval_shape + the PartitionSpec "
         "plan); the activation rows are the analytic model described "
@@ -1071,6 +1180,16 @@ def main(argv=None) -> int:
                         choices=("bfloat16", "float32"),
                         default="bfloat16",
                         help="KV-cache storage dtype")
+    parser.add_argument("--kv-blocks", type=int, default=0,
+                        help="add a PAGED decode KV-cache term "
+                        "instead of the slab: physical pages of a "
+                        "co-resident paged serving engine "
+                        "(tpu_hpc/serve/paging.py); with --kv-slots "
+                        "also given, the report adds the "
+                        "fragmentation-headroom comparison line")
+    parser.add_argument("--kv-block-size", type=int, default=16,
+                        help="tokens per page for --kv-blocks "
+                        "(default 16)")
     parser.add_argument("--xla-opt", action="append", default=[],
                         metavar="KEY=VALUE",
                         help="extra XLA compiler option for the "
@@ -1133,6 +1252,8 @@ def main(argv=None) -> int:
         kv_slots=args.kv_slots,
         kv_seq_len=args.kv_seq_len,
         kv_cache_dtype=args.kv_cache_dtype,
+        kv_blocks=args.kv_blocks,
+        kv_block_size=args.kv_block_size,
     )
     md = to_markdown(r)
     if args.markdown:

@@ -83,7 +83,16 @@ class _CostModelEngine:
     time for each. Placed between batcher and engine so the meter's
     timestamps (taken inside the batcher, after each engine call
     returns) see prefill/decode costs without the batcher knowing
-    about clocks."""
+    about clocks.
+
+    Paged engines (serve/paging.py) pass through transparently:
+    ``prefill_step`` charges each CHUNK's padded tokens as they
+    forward (so chunked prefill's TTFT/ITL interleaving shows up on
+    the virtual clock exactly as it would on chips, and a prefix hit's
+    skipped chunks cost nothing -- the hit is visible in the
+    quantiles, not just the counters); everything else of the paged
+    protocol (admit/release/validate_request/stats) delegates via
+    ``__getattr__``."""
 
     def __init__(
         self,
@@ -107,9 +116,11 @@ class _CostModelEngine:
         # scheduling, not on stalls (review finding).
         self.prefill_charged_s = 0.0
 
-    @property
-    def serve_cfg(self):
-        return self._engine.serve_cfg
+    def __getattr__(self, name):
+        # Cost-neutral surface (serve_cfg, the paged protocol's
+        # admit/release/validate_request, stats/occupancy reads)
+        # delegates; only the compute calls below charge time.
+        return getattr(self._engine, name)
 
     def prefill(self, idx: int, prompt: List[int]) -> int:
         out = self._engine.prefill(idx, prompt)
@@ -119,8 +130,21 @@ class _CostModelEngine:
         self._clock.advance(cost)
         return out
 
-    def decode(self, tokens, positions):
-        out = self._engine.decode(tokens, positions)
+    def prefill_step(self, idx: int):
+        before = self._engine.prefill_forwarded_total
+        out = self._engine.prefill_step(idx)
+        cost = self._prefill_s_per_token * (
+            self._engine.prefill_forwarded_total - before
+        )
+        self.prefill_charged_s += cost
+        self._clock.advance(cost)
+        return out
+
+    def decode(self, tokens, positions, active=None):
+        if active is not None:
+            out = self._engine.decode(tokens, positions, active)
+        else:
+            out = self._engine.decode(tokens, positions)
         self._clock.advance(self._decode_s)
         return out
 
@@ -281,10 +305,17 @@ class LoadHarness:
         arrivals = list(sc.requests)  # already arrival-sorted
         i = 0
         tick = 0
-        budget = max_ticks if max_ticks is not None else (
-            sum(r.max_new_tokens + 1 for r in arrivals)
-            + len(arrivals) + 16
-        )
+        if max_ticks is not None:
+            budget = max_ticks
+        else:
+            budget = (
+                sum(r.max_new_tokens + 1 for r in arrivals)
+                + len(arrivals) + 16
+            )
+            if getattr(self.engine, "is_paged", False):
+                from tpu_hpc.serve.scheduler import paged_drain_bound
+
+                budget += paged_drain_bound(self.engine, arrivals)
         while i < len(arrivals) or not self.batcher.done:
             # A request is "queued" iff it was submitted before this
             # iteration began -- stamp the boundary BEFORE this
@@ -331,6 +362,7 @@ class LoadHarness:
                     sink=self.metrics_path, step=tick,
                 )
             prefill_before = self.engine.prefill_charged_s
+            decode_before = self.batcher.stats["decode_steps"]
             self.batcher.step()
             # The watermark watches decode cadence + colocation
             # steals; this tick's prefill admission charges are
@@ -340,10 +372,21 @@ class LoadHarness:
                 self.clock() - t_before
                 - (self.engine.prefill_charged_s - prefill_before)
             )
-            info = self.detector.observe(
-                tick, tick_s, sink=self.metrics_path
-            )
-            self._stalled = info is not None
+            if self.batcher.stats["decode_steps"] > decode_before:
+                info = self.detector.observe(
+                    tick, tick_s, sink=self.metrics_path
+                )
+                self._stalled = info is not None
+            else:
+                # A tick with NO decode step (chunked prefill still
+                # filling every active slot, or an admission-only
+                # tick) has no cadence to measure: feeding its zero
+                # to the window would drag the median watermark to 0
+                # -- and LEAVING the previous verdict standing would
+                # let admission keep shedding on a stall that is
+                # already over. The verdict describes the last decode
+                # tick only; clear it.
+                self._stalled = False
             self._occupancy.append(self.batcher.occupancy)
             if tick_cb is not None:
                 tick_cb(tick)
@@ -392,6 +435,14 @@ class LoadHarness:
                 slo_violations += [f"{t.name}.{k}" for k in violated]
             tenants[t.name] = entry
         occ = sorted(self._occupancy)
+        # The cache layout is part of the run's identity (a paged
+        # quantile must never be diffed against a slab one unlabeled);
+        # paged engines contribute their hit-rate/pool evidence.
+        paged_summary = getattr(self.engine, "paged_summary", None)
+        if callable(paged_summary):
+            summary.update(paged_summary())
+        else:
+            summary["kv_layout"] = "slab"
         summary.update(
             scenario=self.scenario.name,
             seed=self.scenario.seed,

@@ -22,7 +22,11 @@ replay `python -m tpu_hpc.serve` ships:
                         scenario (the lowest class MUST shed);
 * ``colocate``          steady serving while a colocated training job
                         periodically steals the chip -- the stall
-                        watermark's admission input.
+                        watermark's admission input;
+* ``shared_prefix``     multi-tenant with a common per-tenant system
+                        prompt and heavy-tail suffixes -- the paged
+                        engine's prefix-reuse acceptance scenario
+                        (serve/paging.py).
 """
 from __future__ import annotations
 
@@ -195,9 +199,10 @@ def _assemble(
     tenants: Tuple[TenantClass, ...],
     tenant_of: np.ndarray,       # index into tenants, per request
     arrival_ms: np.ndarray,
-    prompt_lens: np.ndarray,
+    prompt_lens: np.ndarray,     # SUFFIX lengths when prefixes given
     max_new: np.ndarray,
     vocab_size: int,
+    prefixes: Optional[Mapping[str, Tuple[int, ...]]] = None,
     **scenario_kw,
 ) -> Scenario:
     order = np.argsort(arrival_ms, kind="stable")
@@ -205,12 +210,13 @@ def _assemble(
     for k, i in enumerate(order):
         t = tenants[int(tenant_of[i])]
         plen = int(prompt_lens[i])
+        prefix = tuple(prefixes.get(t.name, ())) if prefixes else ()
         reqs.append(LoadRequest(
             rid=f"{name[:2]}{k:05d}",
             tenant=t.name,
             priority=t.priority,
             arrival_ms=float(arrival_ms[i]),
-            prompt=tuple(
+            prompt=prefix + tuple(
                 int(x) for x in rng.integers(0, vocab_size, size=plen)
             ),
             max_new_tokens=int(max_new[i]),
@@ -340,6 +346,57 @@ def build_scenario(
             prompt_lens, max_new_arr, vocab_size,
         )
 
+    if name == "shared_prefix":
+        # Multi-tenant with a COMMON per-tenant system prompt: every
+        # request of a tenant opens with the same token prefix (half
+        # the prompt budget), followed by a heavy-tail suffix. On a
+        # paged engine with the prefix trie this is the
+        # cache-efficiency acceptance scenario -- hit rate and the
+        # pages (and prefill FLOPs) it saves are the point; on a slab
+        # engine it degrades to a valid heavy-tail mix, so the same
+        # seeded schedule measures both layouts.
+        tenants = (
+            TenantClass(
+                "assistant", priority=1, share=0.45,
+                slo={"ttft_ms_p95": 800.0},
+            ),
+            TenantClass(
+                "search", priority=1, share=0.35,
+                slo={"ttft_ms_p95": 800.0},
+            ),
+            TenantClass("batch", priority=0, share=0.2),
+        )
+        sys_len = min(max(2, max_prompt // 2), max_prompt - 1)
+        # One fixed system prompt per tenant, drawn ONCE from the same
+        # stream -- (name, seed) stays byte-identical.
+        prefixes = {
+            t.name: tuple(
+                int(x)
+                for x in rng.integers(0, vocab_size, size=sys_len)
+            )
+            for t in tenants
+        }
+        shares = np.array([t.share for t in tenants])
+        tenant_of = rng.choice(
+            len(tenants), size=n, p=shares / shares.sum()
+        )
+        suffix_hi = max(1, max_prompt - sys_len)
+        suffix_lens = heavy_tail_lengths(
+            rng, n, median=max(2.0, suffix_hi / 3), sigma=0.8,
+            lo=1, hi=suffix_hi,
+        )
+        return _assemble(
+            name, seed, rng, tenants, tenant_of,
+            poisson_arrivals(rng, n, rate_per_s),
+            suffix_lens,
+            heavy_tail_lengths(
+                rng, n, median=max(2.0, max_new / 3), sigma=0.8,
+                lo=1, hi=max_new,
+            ),
+            vocab_size,
+            prefixes=prefixes,
+        )
+
     assert name == "colocate"
     # Two classes: when the colocated train step trips the stall
     # watermark, admission control sheds `background` and the
@@ -371,5 +428,5 @@ def build_scenario(
 
 SCENARIOS: Tuple[str, ...] = (
     "steady", "bursty", "heavy_tail", "multi_tenant",
-    "saturating_burst", "colocate",
+    "saturating_burst", "colocate", "shared_prefix",
 )

@@ -66,6 +66,13 @@ DEFAULT_TOL = 0.10
 # ckpt.integrity) -- more guard rollbacks, skipped updates, silent
 # restore fallbacks or checksum failures IS the regression, so the
 # --bank gate fails on robustness drift, not just perf.
+# Paged-KV cache efficiency (serve/paging.py): "stall" already
+# covers serve.block_stalls (admissions waiting on the page pool --
+# more stalls means the cache got less efficient at the same
+# traffic); the prefix-cache gains ride the default direction --
+# "prefix_hit*" matches no token here, so a DROPPING hit rate is the
+# regression (higher-is-better), which is how the --bank gate
+# catches cache-efficiency drift.
 _LOWER_IS_BETTER = (
     "ttft", "itl", "_ms", "latency", "shed", "stall", "queued",
     "wire_bytes", "inflight",
@@ -91,7 +98,21 @@ def report_metrics(rep: dict) -> Dict[str, float]:
     if m:
         flat["mfu"] = float(m["mfu"])
     for key, val in (rep.get("serve") or {}).items():
-        if isinstance(val, (int, float)) and key not in ("requests",):
+        # "requests" is workload size; kv_block_size/kv_blocks are
+        # pool CONFIG and kv_blocks_free_min follows it -- identity,
+        # not performance; diffing them would fail the gate on a
+        # deliberate re-size. prefill_chunks and the raw hit COUNTS
+        # are excluded too: an IMPROVED prefix cache shortens chunk
+        # plans (fewer chunks = better), which the default
+        # higher-is-better direction would flag as a regression --
+        # prefix_hit_rate (normalized, higher-is-better) and
+        # block_stalls (lower) are the two cache-efficiency signals
+        # the gate judges.
+        if isinstance(val, (int, float)) and key not in (
+            "requests", "kv_block_size", "kv_blocks",
+            "kv_blocks_free_min", "prefill_chunks",
+            "prefix_hits", "prefix_hit_blocks",
+        ):
             flat[f"serve.{key}"] = float(val)
     lg = rep.get("loadgen")
     if lg:

@@ -163,11 +163,21 @@ def _serve(records: Sequence[dict]) -> Optional[dict]:
             "requests", "tokens", "tokens_per_s",
             "tokens_per_s_per_chip", "ttft_ms_p50", "ttft_ms_p95",
             "ttft_ms_p99", "itl_ms_p50", "itl_ms_p95", "itl_ms_p99",
+            # Paged KV cache (serve/paging.py): cache-efficiency
+            # numbers next to the latency quantiles, so the regress
+            # gate's serve.* namespace holds hit rate and page
+            # headroom too.
+            "kv_layout", "kv_block_size", "kv_blocks",
+            "kv_blocks_free_min", "prefix_hit_rate", "prefix_hits",
+            "prefix_hit_blocks", "prefill_chunks",
         )
         if k in s
     }
     if "serve_mfu" in s:
         out["serve_mfu"] = s["serve_mfu"]
+    stalls = (s.get("batcher") or {}).get("block_stalls")
+    if stalls is not None:
+        out["block_stalls"] = stalls
     return out
 
 
@@ -506,6 +516,20 @@ def format_report(rep: dict) -> str:
         if "serve_mfu" in s:
             lines.append(f"- serving MFU (2N forward accounting): "
                          f"{s['serve_mfu']:.1%}")
+        if s.get("kv_layout") == "paged":
+            blocks = s.get("kv_blocks", 0)
+            free_min = s.get("kv_blocks_free_min", 0)
+            occ_peak = (
+                1.0 - free_min / max(1, blocks - 1)
+            )
+            lines.append(
+                f"- paged KV cache: {blocks} pages x "
+                f"{s.get('kv_block_size', 0)} tokens, peak occupancy "
+                f"{occ_peak:.0%} (min {free_min} pages free); prefix "
+                f"cache hit rate {s.get('prefix_hit_rate', 0.0):.0%} "
+                f"({s.get('prefix_hit_blocks', 0)} pages reused, "
+                f"{s.get('prefill_chunks', 0)} prefill chunks)"
+            )
     lg = rep.get("loadgen")
     if lg is not None:
         lines += [

@@ -116,6 +116,15 @@ EVENTS: Dict[str, EventSpec] = {
         ("action", "occupancy"),
         optional=("rid", "tenant", "reason", "pending", "by_tenant"),
     ),
+    # -- paged KV cache (serve/paging.py): page lifecycle edges --
+    #    alloc/free/cow/prefix_hit. Page churn runs at admission
+    #    cadence, so producers emit these ring-only (flight-recorder
+    #    forensics, the lg_token discipline); the aggregate hit-rate/
+    #    occupancy numbers ride the serve_summary instead. --
+    "kv_block": EventSpec(
+        ("action",),
+        optional=("rid", "slot", "n", "block", "blocks", "reason"),
+    ),
     # -- resharding engine (tpu_hpc/reshard): one record per executed
     #    plan, modeled wire/peak bytes next to measured moved bytes --
     "reshard_plan": EventSpec(

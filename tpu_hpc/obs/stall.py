@@ -70,6 +70,12 @@ class StallDetector:
         alarming forever."""
         watermark = self.watermark_s
         info = None
+        # A non-positive watermark carries no cadence to breach (a
+        # window of zero-duration steps -- e.g. virtual-clock ticks
+        # that did no metered work); treat it as not-warm rather than
+        # dividing by it.
+        if watermark is not None and watermark <= 0.0:
+            watermark = None
         if watermark is not None and step_s > self.factor * watermark:
             self.stalls += 1
             info = {

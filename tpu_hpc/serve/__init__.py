@@ -12,6 +12,14 @@ across by tpu_hpc.reshard plans (disagg).
 from tpu_hpc.serve.disagg import DisaggEngine, split_serving_meshes
 from tpu_hpc.serve.engine import Engine, ServeConfig
 from tpu_hpc.serve.metrics import ServeMeter
+from tpu_hpc.serve.paging import (
+    BlockAllocator,
+    BlockBudgetError,
+    PagedConfig,
+    PagedEngine,
+    PrefixTrie,
+    UnservableRequestError,
+)
 from tpu_hpc.serve.scheduler import (
     AdmissionPolicy,
     ContinuousBatcher,
@@ -26,12 +34,18 @@ from tpu_hpc.serve.weights import (
 
 __all__ = [
     "AdmissionPolicy",
+    "BlockAllocator",
+    "BlockBudgetError",
     "ContinuousBatcher",
     "DisaggEngine",
     "Engine",
+    "PagedConfig",
+    "PagedEngine",
+    "PrefixTrie",
     "Request",
     "ServeConfig",
     "ServeMeter",
+    "UnservableRequestError",
     "load_serving_params",
     "place_params",
     "replay_requests",

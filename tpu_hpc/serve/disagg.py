@@ -25,6 +25,19 @@ the continuous batcher and the replay server drive it unchanged, and
 the token-exactness oracle in tests/test_serve.py applies verbatim:
 greedy decode through the disaggregated path must equal the no-cache
 forward pass token for token.
+
+**Paged mode** (``paged=PagedConfig(...)``): both tiers run the
+block-table cache (serve/paging.py), and the KV hop ships **block
+tables plus the referenced pages only** -- per-bucket gather programs
+read exactly the pages a request's table names on the prefill tier,
+the bounded reshard plan moves them, and per-bucket scatter programs
+land them at the decode tier's own page ids (each tier has its own
+allocator; physical ids never have to agree across tiers). Prompts
+longer than the largest bucket (chunked prefill) hop as a sequence of
+bucket-sized page groups through the same fixed-shape programs, so
+the zero-recompile pin survives. Prefix reuse lives on the prefill
+tier (a trie hit skips the prefill FLOPs; the pages still hop --
+the decode tier holds no copy).
 """
 from __future__ import annotations
 
@@ -109,6 +122,7 @@ class DisaggEngine:
         prefill_mesh: Mesh,
         decode_mesh: Mesh,
         max_inflight_bytes: Optional[int] = None,
+        paged=None,
     ):
         shared = set(prefill_mesh.devices.flat) & set(
             decode_mesh.devices.flat
@@ -121,14 +135,36 @@ class DisaggEngine:
         self.cfg = cfg
         self.serve_cfg = serve_cfg
         self.max_inflight_bytes = max_inflight_bytes
+        self.paged = paged
+        self.is_paged = paged is not None
         # Both tiers place the same param tree onto their own mesh --
         # the decode tier is the latency-critical one and keeps the
         # single-tier layout; the prefill tier is throughput-bound and
         # uses the same TP split on its own chips.
-        self.prefill_engine = Engine(params, cfg, serve_cfg,
-                                     prefill_mesh)
-        self.decode_engine = Engine(params, cfg, serve_cfg,
-                                    decode_mesh)
+        if paged is not None:
+            from tpu_hpc.serve.paging import PagedEngine
+
+            self.prefill_engine = PagedEngine(
+                params, cfg, serve_cfg, prefill_mesh, paged
+            )
+            self.decode_engine = PagedEngine(
+                params, cfg, serve_cfg, decode_mesh, paged
+            )
+            # Two pools in one process: distinct gauge names, or the
+            # tiers overwrite each other's page readings (the
+            # process-wide-registry blending class the hop quantiles
+            # already dodge via engine-local samples).
+            for eng, suffix in (
+                (self.prefill_engine, "_prefill"),
+                (self.decode_engine, "_decode"),
+            ):
+                eng.gauge_suffix = suffix
+                eng._set_block_gauges()
+        else:
+            self.prefill_engine = Engine(params, cfg, serve_cfg,
+                                         prefill_mesh)
+            self.decode_engine = Engine(params, cfg, serve_cfg,
+                                        decode_mesh)
         self.mesh = decode_mesh  # the resident (decode) tier
         self.prefill_mesh = prefill_mesh
         self.decode_mesh = decode_mesh
@@ -169,7 +205,68 @@ class DisaggEngine:
 
     def _rows_shape(self, bucket: int) -> Tuple[int, ...]:
         c = self.cfg
+        if self.is_paged:
+            bs = self.paged.block_size
+            return (c.n_layers, bucket // bs, bs, c.kv_heads,
+                    c.head_dim)
         return (c.n_layers, 1, bucket, c.kv_heads, c.head_dim)
+
+    def _build_bucket_paged(self, bucket: int) -> None:
+        """Paged hop programs for one bucket: gather exactly the pages
+        a table slice names on the prefill tier, plan the bounded
+        cross-tier move, scatter at the decode tier's own page ids --
+        block tables + referenced pages only, nothing else crosses."""
+        from tpu_hpc import reshard
+
+        c = self.cfg
+        pe, de = self.prefill_engine, self.decode_engine
+        nb = bucket // self.paged.block_size
+        rows = self._rows_shape(bucket)
+        src_sh = NamedSharding(
+            self.prefill_mesh,
+            _kv_rows_pspec(self.prefill_mesh, c.kv_heads),
+        )
+        tgt_sh = NamedSharding(
+            self.decode_mesh,
+            _kv_rows_pspec(self.decode_mesh, c.kv_heads),
+        )
+        cache_p = pe._cache_abstract()
+        cache_d = de._cache_abstract()
+        ids_p = jax.ShapeDtypeStruct((nb,), jnp.int32, sharding=pe._rep)
+        ids_d = jax.ShapeDtypeStruct((nb,), jnp.int32, sharding=de._rep)
+
+        def extract(ks, vs, ids):
+            return ks[:, ids], vs[:, ids]
+
+        self._extract[bucket] = jax.jit(
+            extract, out_shardings=(src_sh, src_sh)
+        ).lower(cache_p, cache_p, ids_p).compile()
+        self._aot_builds += 1
+
+        def insert(ks, vs, k_rows, v_rows, ids):
+            return ks.at[:, ids].set(k_rows), vs.at[:, ids].set(v_rows)
+
+        rows_abs = jax.ShapeDtypeStruct(
+            rows, de.ks.dtype, sharding=tgt_sh
+        )
+        self._insert[bucket] = jax.jit(
+            insert,
+            donate_argnums=(0, 1),
+            out_shardings=(de._cache_sharding, de._cache_sharding),
+        ).lower(cache_d, cache_d, rows_abs, rows_abs, ids_d).compile()
+        self._aot_builds += 1
+
+        abstract = {
+            "k": jax.ShapeDtypeStruct(rows, pe.ks.dtype,
+                                      sharding=src_sh),
+            "v": jax.ShapeDtypeStruct(rows, pe.ks.dtype,
+                                      sharding=src_sh),
+        }
+        self._plans[bucket] = reshard.plan_reshard(
+            abstract, {"k": tgt_sh, "v": tgt_sh},
+            max_inflight_bytes=self.max_inflight_bytes,
+            label=f"kv_pages_b{bucket}",
+        )
 
     def _build_bucket(self, bucket: int) -> None:
         """Extract (prefill tier), transfer plan (cross-tier), insert
@@ -244,10 +341,20 @@ class DisaggEngine:
         self.prefill_engine.warmup()
         self.decode_engine.warmup()
         for b in self.serve_cfg.prefill_buckets:
-            self._build_bucket(b)
-            # Dummy transfer of the (all-zero) slot-0 rows: compiles
-            # every plan program now, writes zeros over zeros.
-            self._move_kv(b, 0)
+            if self.is_paged:
+                self._build_bucket_paged(b)
+                # Dummy move of all-scratch page ids: compiles every
+                # plan program now, writes scratch garbage over
+                # scratch garbage.
+                nb = b // self.paged.block_size
+                zeros = np.zeros((nb,), np.int32)
+                self._move_kv_paged(b, zeros, zeros)
+            else:
+                self._build_bucket(b)
+                # Dummy transfer of the (all-zero) slot-0 rows:
+                # compiles every plan program now, writes zeros over
+                # zeros.
+                self._move_kv(b, 0)
         return self.compile_count
 
     # -- serving ops ---------------------------------------------------
@@ -272,6 +379,42 @@ class DisaggEngine:
         de.vs.block_until_ready()
         return int(k.nbytes + v.nbytes)
 
+    def _move_kv_paged(
+        self, bucket: int, src_ids: np.ndarray, tgt_ids: np.ndarray
+    ) -> int:
+        """One bucket-sized page group: gather ``src_ids`` pages on the
+        prefill tier, reshard, scatter at ``tgt_ids`` on the decode
+        tier. Same dispatch-to-result blocking as :meth:`_move_kv`."""
+        pe, de = self.prefill_engine, self.decode_engine
+        k, v = self._extract[bucket](
+            pe.ks, pe.vs, pe._rep_arr(np.asarray(src_ids, np.int32))
+        )
+        moved = self._plans[bucket].execute({"k": k, "v": v})
+        de.ks, de.vs = self._insert[bucket](
+            de.ks, de.vs, moved["k"], moved["v"],
+            de._rep_arr(np.asarray(tgt_ids, np.int32)),
+        )
+        de.ks.block_until_ready()
+        de.vs.block_until_ready()
+        return int(k.nbytes + v.nbytes)
+
+    def _hop_pieces(self, prompt_len: int):
+        """Bucket-sized page groups covering the prompt region --
+        fixed shapes only, so a chunked prompt longer than the
+        largest bucket hops through the same compiled programs."""
+        bs = self.paged.block_size
+        largest = max(self.serve_cfg.prefill_buckets)
+        total = -(-prompt_len // bs) * bs
+        pieces = []
+        pos = 0
+        while pos < total:
+            rem = total - pos
+            b = largest if rem >= largest \
+                else self.serve_cfg.bucket_for(rem)
+            pieces.append((pos // bs, b))
+            pos += b
+        return pieces
+
     def prefill(self, slot: int, prompt: Sequence[int]) -> int:
         """Prefill on the prefill tier, then ship the slot's KV block
         to the decode tier. The hop rides in a ``kv_transfer`` span
@@ -292,9 +435,111 @@ class DisaggEngine:
         self.transfer_stats["kv_transfer_bytes"] += nbytes
         return tok
 
+    # -- the paged protocol (serve/paging.py), tier-split -------------
+    def validate_request(
+        self, prompt_len: int, max_new: int, rid: str = "?"
+    ) -> None:
+        # The decode tier holds prompt + generation; the prefill tier
+        # only ever holds the prompt (plus its one-token admit pad).
+        self.decode_engine.validate_request(prompt_len, max_new, rid)
+        self.prefill_engine.validate_request(prompt_len, 1, rid)
+
+    def admit(
+        self, slot: int, prompt: Sequence[int], max_new: int
+    ) -> dict:
+        """Reserve pages on BOTH tiers (all-or-nothing: a request must
+        never hold prefill-tier pages it can't decode). The decode
+        tier goes FIRST: its admit is stat-free (no trie), so a
+        failure there never leaves the prefill tier's prefix-hit
+        counters inflated by a rolled-back admission (review
+        finding)."""
+        self.decode_engine.admit(
+            slot, prompt, max_new, run_prefill=False
+        )
+        try:
+            return self.prefill_engine.admit(slot, prompt, 1)
+        except Exception:
+            self.decode_engine.release(slot)
+            raise
+
+    def prefill_step(self, slot: int):
+        """Advance one chunk on the prefill tier; on prompt completion
+        ship the referenced pages to the decode tier's page ids and
+        release the prefill tier's reservation (its trie keeps the
+        prompt pages for future hits)."""
+        import time
+
+        tok = self.prefill_engine.prefill_step(slot)
+        if tok is None:
+            return None
+        pe, de = self.prefill_engine, self.decode_engine
+        plen = len(pe.slot_state(slot).prompt)
+        src_table = pe.slot_table(slot)
+        tgt_table = de.slot_table(slot)
+        t0 = time.perf_counter()
+        nbytes = 0
+        pieces = self._hop_pieces(plen)
+        with span(
+            "kv_transfer", tier="transfer",
+            hist="serve_kv_transfer_s", n=plen,
+        ):
+            for start_blk, b in pieces:
+                nb = b // self.paged.block_size
+                nbytes += self._move_kv_paged(
+                    b,
+                    src_table[start_blk:start_blk + nb],
+                    tgt_table[start_blk:start_blk + nb],
+                )
+        self._hop_s.append(time.perf_counter() - t0)
+        self.transfer_stats["kv_transfers"] += len(pieces)
+        self.transfer_stats["kv_transfer_bytes"] += nbytes
+        pe.release(slot)
+        return tok
+
+    def release(self, slot: int) -> None:
+        self.decode_engine.release(slot)
+
+    def planned_prefill_tokens(self, slot: int) -> int:
+        return self.prefill_engine.planned_prefill_tokens(slot)
+
+    @property
+    def block_occupancy(self) -> float:
+        return max(
+            self.prefill_engine.block_occupancy,
+            self.decode_engine.block_occupancy,
+        )
+
+    @property
+    def prefill_forwarded_total(self) -> int:
+        return self.prefill_engine.prefill_forwarded_total
+
+    @property
+    def paged_stats(self) -> dict:
+        pe = self.prefill_engine.paged_stats
+        de = self.decode_engine.paged_stats
+        return {k: pe[k] + de[k] for k in pe}
+
+    def paged_summary(self) -> dict:
+        """Pool description for the serve summary: the decode tier's
+        resident pool, the prefill tier's prefix/chunk activity."""
+        out = self.decode_engine.paged_summary()
+        src = self.prefill_engine.paged_summary()
+        for k in ("prefix_lookups", "prefix_hits", "prefix_hit_blocks",
+                  "prefix_hit_rate", "prefill_chunks"):
+            out[k] = src[k]
+        out["cow_copies"] = (
+            src["cow_copies"] + out["cow_copies"]
+        )
+        return out
+
     def decode(
-        self, tokens: Sequence[int], positions: Sequence[int]
+        self,
+        tokens: Sequence[int],
+        positions: Sequence[int],
+        active: Optional[Sequence[bool]] = None,
     ) -> np.ndarray:
+        if self.is_paged:
+            return self.decode_engine.decode(tokens, positions, active)
         return self.decode_engine.decode(tokens, positions)
 
     def describe(self) -> dict:

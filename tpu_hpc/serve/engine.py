@@ -327,13 +327,30 @@ class Engine:
         )
         self._rep = NamedSharding(mesh, P())
 
-        cache_dtype = serve_cfg.cache_dtype or cfg.dtype
-        shape = (
-            cfg.n_layers, serve_cfg.slots, serve_cfg.max_seq_len,
-            cfg.kv_heads, cfg.head_dim,
+        self._init_cache()
+
+        self._execs: Dict[Any, Any] = {}
+        self.compile_count = 0
+
+    def _cache_shape(self) -> Tuple[int, ...]:
+        """Resident K (and V) cache shape; the paged engine
+        (serve/paging.py) overrides this with its block pool."""
+        return (
+            self.cfg.n_layers, self.serve_cfg.slots,
+            self.serve_cfg.max_seq_len, self.cfg.kv_heads,
+            self.cfg.head_dim,
         )
+
+    def _cache_pspec(self) -> P:
+        return kv_cache_pspec(
+            self.mesh, self.serve_cfg.slots, self.cfg.kv_heads
+        )
+
+    def _init_cache(self) -> None:
+        cache_dtype = self.serve_cfg.cache_dtype or self.cfg.dtype
+        shape = self._cache_shape()
         self._cache_sharding = NamedSharding(
-            mesh, kv_cache_pspec(mesh, serve_cfg.slots, cfg.kv_heads)
+            self.mesh, self._cache_pspec()
         )
         alloc = jax.jit(
             lambda: (
@@ -346,9 +363,6 @@ class Engine:
         self.cache_bytes = 2 * math.prod(shape) * jnp.dtype(
             cache_dtype
         ).itemsize
-
-        self._execs: Dict[Any, Any] = {}
-        self.compile_count = 0
 
     # -- executable table ---------------------------------------------
     def _cache_abstract(self):

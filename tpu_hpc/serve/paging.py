@@ -1,0 +1,1090 @@
+"""Paged KV cache: block-table attention, prefix reuse, chunked prefill.
+
+The slab engine (serve/engine.py) preallocates one
+``[layers, slots, seq, kv_heads, head_dim]`` cache where a 32-token
+request pins the same HBM as a 4096-token one. This module carves that
+HBM into fixed-size **pages** instead -- the vLLM insight ("Efficient
+Memory Management for Large Language Model Serving with
+PagedAttention", PAPERS.md), rebuilt on this repo's own discipline of
+AOT executable tables and token-exact oracles:
+
+* **cache** ``[layers, num_blocks, block_size, kv_heads, head_dim]``:
+  one physical pool, KV heads sharded over the ``model`` axis (pages
+  are globally addressable, so the block dim stays unsharded -- a
+  multi-slice deployment runs one pool per data-parallel replica);
+* **BlockAllocator** (host side): LIFO free list + refcounts. A block
+  is shared when several owners (request tables, the prefix trie)
+  hold references; it returns to the free list only at refcount zero.
+  Physical block 0 is the **scratch block**: padded-tail writes of a
+  bucketed prefill land there instead of corrupting a neighbour, and
+  the per-slot length mask keeps its garbage unreachable;
+* **block tables**: per-slot ``int32`` rows of physical block ids, fed
+  to the compiled programs as *data* -- shapes never change, so the
+  zero-steady-state-recompile guarantee survives (the compile-counter
+  pins in tests/test_paging.py hold with paging on);
+* **PrefixTrie**: a hash-trie over full prompt token blocks with
+  copy-on-write refcounts. A request whose prompt starts with an
+  already-cached block chain resolves those pages physically and skips
+  their prefill compute entirely -- shared system prompts across
+  tenants cost their FLOPs once. Writes never target shared pages by
+  construction (a request's writes start past its shared prefix);
+  :meth:`BlockAllocator.cow` is the enforcing guard rail -- the decode
+  path checks its write-target page and copies first if it is shared;
+* **chunked prefill**: the scheduler admits a long prompt as a series
+  of block-aligned chunks interleaved with decode steps, so a 4k-token
+  admission no longer stalls every in-flight request's ITL. Each chunk
+  runs through the same per-bucket program -- plain prefill is just
+  the one-chunk case.
+
+Attention reads the logical sequence through a gather over the block
+table (``ks[layer][table]``), the XLA-level reference formulation of
+paged attention: correct on every backend, token-exact against the
+no-cache forward (the tests/test_serve.py oracle applies verbatim).
+A production TPU deployment would drop a Pallas paged-attention kernel
+into the same program slots; the block-table plumbing, allocator and
+scheduler contracts here are what that kernel would inherit.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_hpc.models import llama2
+from tpu_hpc.obs import get_bus, get_registry, span
+from tpu_hpc.serve.engine import (
+    Engine,
+    ServeConfig,
+    _dense,  # noqa: F401  (re-exported for kernel swaps)
+    _embed,
+    _grouped_attention,
+    _logits_head,
+    _mlp,
+    _qkv,
+    _rmsnorm,
+    _attn_out_proj,
+)
+
+SCRATCH_BLOCK = 0
+
+
+class BlockBudgetError(RuntimeError):
+    """Transient: the allocator cannot seat this request *right now*.
+    The batcher keeps the request queued and retries next tick (free
+    blocks appear as in-flight requests finish)."""
+
+
+class UnservableRequestError(ValueError):
+    """Permanent: the request can never fit the configured page budget
+    (prompt + max_new exceeds what the whole pool holds). Raised at
+    submit() so one oversized request cannot abort a mid-flight
+    drain."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedConfig:
+    """Static paged-cache shape: everything the pool layout and the
+    compiled programs depend on.
+
+    ``block_size``: tokens per page. ``num_blocks``: physical pages in
+    the pool, INCLUDING the reserved scratch block 0 (usable pages =
+    ``num_blocks - 1``). ``prefill_chunk``: chunked-prefill stride in
+    tokens (0 = whole-prompt bucketed prefill); must be block-aligned
+    so every chunk starts on a page boundary. ``prefix_cache``: keep
+    finished prompts' full pages in the prefix trie for reuse."""
+
+    block_size: int = 16
+    num_blocks: int = 64
+    prefill_chunk: int = 0
+    prefix_cache: bool = True
+
+    def __post_init__(self):
+        if self.block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {self.block_size}"
+            )
+        if self.num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (scratch + at least one "
+                f"usable page), got {self.num_blocks}"
+            )
+        if self.prefill_chunk < 0 or (
+            self.prefill_chunk % self.block_size
+        ):
+            raise ValueError(
+                f"prefill_chunk {self.prefill_chunk} must be a "
+                f"multiple of block_size {self.block_size} (chunks "
+                "start on page boundaries)"
+            )
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1
+
+    def blocks_for(self, tokens: int) -> int:
+        """Pages needed to hold ``tokens`` cache positions."""
+        return -(-tokens // self.block_size)
+
+
+DEFAULT_BLOCK_SIZE = 16
+
+
+def derive_paged_config(
+    slots: int,
+    max_seq: int,
+    buckets: Sequence[int],
+    block_size: Optional[int] = None,
+    num_blocks: Optional[int] = None,
+    prefill_chunk: Optional[int] = None,
+    align_capacity: bool = False,
+) -> Tuple["PagedConfig", int]:
+    """CLI-shared sizing: ``(PagedConfig, capacity)`` from the flag
+    values, with every invalid combination raising ``ValueError``
+    BEFORE any backend bring-up. One derivation for server.py and
+    bench.py, so the bench rows and the serving CLI can never
+    silently diverge on the default block size, the page-rounding
+    rule, or the slab-equivalent pool default.
+
+    ``align_capacity=True`` rounds a DERIVED capacity up to a whole
+    number of pages; an explicitly chosen capacity must align itself
+    (callers pass False so the mismatch errors loudly)."""
+    bs = block_size or DEFAULT_BLOCK_SIZE
+    if align_capacity:
+        max_seq = -(-max_seq // bs) * bs
+    misaligned = [n for n in (max_seq, *buckets) if n % bs]
+    if misaligned:
+        raise ValueError(
+            f"kv block size {bs} must divide the cache capacity and "
+            f"every prefill bucket; {misaligned} are not multiples"
+        )
+    if (prefill_chunk or 0) > max(buckets):
+        raise ValueError(
+            f"prefill chunk {prefill_chunk} exceeds the largest "
+            f"bucket {max(buckets)} (chunks run through the compiled "
+            "bucket programs)"
+        )
+    cfg = PagedConfig(
+        block_size=bs,
+        num_blocks=(
+            num_blocks if num_blocks is not None
+            # Slab-equivalent HBM by default: same token capacity,
+            # plus the scratch page.
+            else slots * max_seq // bs + 1
+        ),
+        prefill_chunk=prefill_chunk or 0,
+    )
+    return cfg, max_seq
+
+
+def paged_kv_cache_pspec(mesh: Mesh, kv_heads: int) -> P:
+    """Pool layout: KV heads over ``model`` (when the axis exists,
+    divides, and is wider than 1); the block dim stays unsharded --
+    any slot may reference any page, and a data-sharded pool would
+    turn every table gather into a cross-replica collective."""
+    names = set(mesh.axis_names)
+    model = (
+        "model"
+        if "model" in names and mesh.shape["model"] > 1
+        and kv_heads % mesh.shape["model"] == 0
+        else None
+    )
+    return P(None, None, None, model, None)
+
+
+# ---------------------------------------------------------------------
+# Host-side page accounting
+# ---------------------------------------------------------------------
+
+
+class BlockAllocator:
+    """Free-list + refcount accounting over the physical page pool.
+
+    Invariant (pinned by the property suite in tests/test_paging.py):
+    ``1 (scratch) + len(free) + len(referenced) == num_blocks`` at all
+    times -- no page is ever both free and referenced, double-freed,
+    or leaked. ``retain``/``release`` move refcounts; a page frees
+    only at refcount zero, which is what lets the prefix trie keep a
+    finished request's prompt pages alive for future hits."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks {num_blocks} must be >= 2")
+        self.num_blocks = num_blocks
+        # LIFO: the most recently freed page is the next handed out --
+        # it is the page most likely still warm in HBM caches.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._ref)
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` fresh pages at refcount 1."""
+        if n < 0:
+            raise ValueError(f"cannot alloc {n} blocks")
+        if n > len(self._free):
+            raise BlockBudgetError(
+                f"need {n} free pages, have {len(self._free)} "
+                f"(pool {self.num_blocks}, {len(self._ref)} in use)"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def retain(self, blocks: Sequence[int]) -> None:
+        """Add one reference to each (already-referenced) page."""
+        for b in blocks:
+            if b not in self._ref:
+                raise ValueError(
+                    f"retain of unreferenced block {b} (free or "
+                    "scratch) -- a share must start from a live page"
+                )
+            self._ref[b] += 1
+
+    def release(self, blocks: Sequence[int]) -> int:
+        """Drop one reference from each page; pages reaching zero
+        return to the free list. Returns how many pages freed."""
+        freed = 0
+        for b in blocks:
+            n = self._ref.get(b)
+            if n is None:
+                raise ValueError(
+                    f"double free of block {b} (not referenced)"
+                )
+            if n == 1:
+                del self._ref[b]
+                self._free.append(b)
+                freed += 1
+            else:
+                self._ref[b] = n - 1
+        return freed
+
+    def cow(self, block: int) -> Tuple[int, bool]:
+        """Copy-on-write: writing into ``block`` is safe only while
+        this owner holds the sole reference. Returns ``(block,
+        False)`` when exclusive; otherwise drops this owner's
+        reference, allocates a fresh page, and returns ``(new_block,
+        True)`` -- the caller must copy the page contents device-side
+        before writing."""
+        n = self._ref.get(block)
+        if n is None:
+            raise ValueError(f"cow of unreferenced block {block}")
+        if n == 1:
+            return block, False
+        self._ref[block] = n - 1
+        try:
+            new = self.alloc(1)[0]
+        except BlockBudgetError:
+            self._ref[block] = n  # roll back: caller keeps its ref
+            raise
+        return new, True
+
+    def check_invariant(self) -> None:
+        """Raises if the accounting identity is violated (the property
+        suite calls this after every random operation)."""
+        free = set(self._free)
+        held = set(self._ref)
+        if len(free) != len(self._free):
+            raise AssertionError("duplicate pages on the free list")
+        if free & held:
+            raise AssertionError(
+                f"pages both free and referenced: {sorted(free & held)}"
+            )
+        if SCRATCH_BLOCK in free or SCRATCH_BLOCK in held:
+            raise AssertionError("scratch block leaked into the pool")
+        if any(n < 1 for n in self._ref.values()):
+            raise AssertionError("zero/negative refcount retained")
+        total = 1 + len(free) + len(held)
+        if total != self.num_blocks:
+            raise AssertionError(
+                f"page accounting broken: scratch + {len(free)} free "
+                f"+ {len(held)} held = {total} != {self.num_blocks}"
+            )
+
+
+@dataclasses.dataclass
+class _TrieNode:
+    block: int
+    children: Dict[Tuple[int, ...], "_TrieNode"] = dataclasses.field(
+        default_factory=dict
+    )
+    last_used: int = 0
+
+
+class PrefixTrie:
+    """Hash-trie over full prompt token blocks.
+
+    Each edge is one block's worth of token ids; each node owns one
+    reference on a physical page holding that block's K/V. A lookup
+    walks the longest cached chain for a new prompt; an insert
+    registers a finished prefill's full prompt blocks. Eviction is
+    LRU leaf-first (an inner node's page is only reachable through
+    its chain, so leaves must go first), and releasing the trie's
+    reference frees the page only when no live request still holds
+    it -- which is exactly why a prefix hit stays token-exact after
+    the original owner was evicted."""
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self._root: Dict[Tuple[int, ...], _TrieNode] = {}
+        self._clock = 0
+        self.nodes = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _full_blocks(
+        self, prompt: Sequence[int]
+    ) -> List[Tuple[int, ...]]:
+        bs = self.block_size
+        n_full = len(prompt) // bs
+        return [
+            tuple(prompt[i * bs:(i + 1) * bs]) for i in range(n_full)
+        ]
+
+    def match(self, prompt: Sequence[int]) -> List[int]:
+        """Physical pages of the longest cached full-block prefix of
+        ``prompt`` (possibly empty). Bumps LRU clocks; takes no
+        references -- the caller retains what it keeps."""
+        blocks: List[int] = []
+        level = self._root
+        now = self._tick()
+        for key in self._full_blocks(prompt):
+            node = level.get(key)
+            if node is None:
+                break
+            node.last_used = now
+            blocks.append(node.block)
+            level = node.children
+        return blocks
+
+    def insert(
+        self,
+        prompt: Sequence[int],
+        blocks: Sequence[int],
+        allocator: BlockAllocator,
+    ) -> int:
+        """Register a finished prefill's full prompt blocks
+        (``blocks[i]`` holds tokens ``[i*bs, (i+1)*bs)``). Existing
+        nodes win (a concurrent identical prompt already cached the
+        chain; the caller keeps its private copy). Returns how many
+        new nodes (trie references) were created."""
+        level = self._root
+        now = self._tick()
+        created = 0
+        for i, key in enumerate(self._full_blocks(prompt)):
+            node = level.get(key)
+            if node is None:
+                node = _TrieNode(block=int(blocks[i]), last_used=now)
+                allocator.retain([node.block])
+                level[key] = node
+                self.nodes += 1
+                created += 1
+            else:
+                node.last_used = now
+            level = node.children
+        return created
+
+    def evict(
+        self, allocator: BlockAllocator, n_needed: int
+    ) -> int:
+        """Drop LRU leaf nodes until ``n_needed`` pages came FREE (a
+        released page still referenced by a live request frees
+        nothing) or nothing evictable remains. Returns pages freed.
+
+        One walk collects the current leaves; the whole batch drains
+        in LRU order before re-walking (a re-walk is only needed when
+        evicting a batch exposed parents as new leaves), so freeing
+        ``n`` pages costs O(depth) walks, not O(n) -- evict runs
+        inside admit() on every page-short admission, the hot path of
+        a saturated pool.
+
+        Leaves whose page is SHARED with a live request (refcount
+        above the trie's own reference) are skipped: releasing them
+        frees nothing toward the shortage, and deleting the node
+        would throw away a demonstrably-hot prefix -- the next
+        same-prompt request would pay the full prefill again (review
+        finding: one unsatisfiable shortage must not wipe the warm
+        cache)."""
+        freed = 0
+        while freed < n_needed:
+            leaves: List[Tuple[int, Dict, Tuple, _TrieNode]] = []
+
+            def walk(level: Dict) -> None:
+                for key, node in level.items():
+                    if node.children:
+                        walk(node.children)
+                    elif allocator.refcount(node.block) == 1:
+                        leaves.append(
+                            (node.last_used, level, key, node)
+                        )
+
+            walk(self._root)
+            if not leaves:
+                break
+            leaves.sort(key=lambda t: t[0])
+            for _, level, key, node in leaves:
+                del level[key]
+                self.nodes -= 1
+                freed += allocator.release([node.block])
+                if freed >= n_needed:
+                    break
+        return freed
+
+
+# ---------------------------------------------------------------------
+# Compiled programs
+# ---------------------------------------------------------------------
+
+
+def make_chunk_prefill_fn(
+    cfg: llama2.LlamaConfig,
+    bucket: int,
+    block_size: int,
+    max_blocks: int,
+    table_width: int,
+):
+    """One prefill **chunk** at a padded bucket length -- the paged
+    generalisation of the slab prefill program (whole-prompt prefill
+    is the ``start=0`` single-chunk case).
+
+    ``(params, ks, vs, tokens [1, bucket], start, true_len,
+    table [table_width])`` -> ``(ks, vs, next_token)``: the chunk's
+    K/V is scattered into the pages ``table[start/bs :]`` names, then
+    attention runs over the WHOLE logical sequence view (a gather of
+    the first ``max_blocks`` table entries) under the global causal
+    mask ``key_pos <= start + q`` -- so a chunk attends to every
+    previously prefilled chunk and to the shared prefix pages it
+    never computed. The greedy token from row ``true_len - 1`` is
+    meaningful on the final chunk only.
+
+    ``table_width > max_blocks``: the trailing entries are scratch
+    padding, so a bucket-padded write near the capacity edge can
+    never clamp (jax dynamic_slice clamps out-of-range starts, which
+    would silently misalign the scatter) nor touch a real page.
+    """
+    nb_chunk = bucket // block_size
+    cache_cap = max_blocks * block_size
+
+    def chunk_prefill(params, ks, vs, tokens, start, true_len, table):
+        x = _embed(params, tokens, cfg)
+        qpos = start + jnp.arange(bucket)
+        cos, sin = llama2.rope_cos_sin(
+            bucket, cfg.head_dim, positions=qpos
+        )
+        col = jnp.arange(cache_cap)
+        mask = (col[None, :] <= qpos[:, None])[None, None, None, :, :]
+        blk_ids = jax.lax.dynamic_slice(
+            table, (start // block_size,), (nb_chunk,)
+        )
+        view_ids = table[:max_blocks]
+        for i in range(cfg.n_layers):
+            lp = params[f"layers_{i}"]
+            h = _rmsnorm(x, lp["attention_norm"]["scale"], cfg.norm_eps)
+            q, k, v = _qkv(h, lp, cfg)
+            q = llama2.apply_rope(q, cos, sin)
+            k = llama2.apply_rope(k, cos, sin)
+            kb = k[0].astype(ks.dtype).reshape(
+                nb_chunk, block_size, cfg.kv_heads, cfg.head_dim
+            )
+            vb = v[0].astype(vs.dtype).reshape(
+                nb_chunk, block_size, cfg.kv_heads, cfg.head_dim
+            )
+            ks = ks.at[i, blk_ids].set(kb)
+            vs = vs.at[i, blk_ids].set(vb)
+            k_view = ks[i][view_ids].reshape(
+                1, cache_cap, cfg.kv_heads, cfg.head_dim
+            )
+            v_view = vs[i][view_ids].reshape(
+                1, cache_cap, cfg.kv_heads, cfg.head_dim
+            )
+            attn = _grouped_attention(
+                q, k_view.astype(cfg.dtype), v_view.astype(cfg.dtype),
+                mask, cfg,
+            )
+            x = x + _attn_out_proj(attn, lp, cfg)
+            h = _rmsnorm(x, lp["ffn_norm"]["scale"], cfg.norm_eps)
+            x = x + _mlp(h, lp, cfg)
+        last = jax.lax.dynamic_slice(
+            x, (0, true_len - 1, 0), (1, 1, cfg.dim)
+        )
+        logits = _logits_head(last, params, cfg)
+        return ks, vs, jnp.argmax(logits[0, 0], axis=-1).astype(
+            jnp.int32
+        )
+
+    return chunk_prefill
+
+
+def make_paged_decode_fn(
+    cfg: llama2.LlamaConfig,
+    block_size: int,
+    max_blocks: int,
+    table_width: int,
+):
+    """The single-token decode program over every slot, block-table
+    edition.
+
+    ``(params, ks, vs, tokens [slots], pos [slots],
+    tables [slots, table_width], active [slots])`` ->
+    ``(ks, vs, next_tokens)``: each active slot's token K/V is
+    scattered into page ``tables[s, pos/bs]`` at offset ``pos % bs``;
+    inactive slots (free, or still prefilling their prompt) are
+    redirected to the scratch block so their garbage write cannot
+    corrupt a live page. Attention gathers each slot's logical view
+    through its table and masks columns ``> pos`` -- stale pages from
+    an evicted tenant are unreachable, which is what makes page reuse
+    safe (the slab engine's slot-reuse invariant, per page).
+    """
+    cache_cap = max_blocks * block_size
+
+    def decode(params, ks, vs, tokens, pos, tables, active):
+        slots = tokens.shape[0]
+        x = _embed(params, tokens[:, None], cfg)
+        cos, sin = llama2.rope_cos_sin(
+            1, cfg.head_dim, positions=pos
+        )
+        cos, sin = cos[:, None, :], sin[:, None, :]
+        col = jnp.arange(cache_cap)
+        mask = (col[None, :] <= pos[:, None])[:, None, None, None, :]
+        rows = jnp.arange(slots)
+        blk = pos // block_size
+        off = pos % block_size
+        pb = jnp.where(
+            active > 0, tables[rows, blk], SCRATCH_BLOCK
+        )
+        view_ids = tables[:, :max_blocks]
+        for i in range(cfg.n_layers):
+            lp = params[f"layers_{i}"]
+            h = _rmsnorm(x, lp["attention_norm"]["scale"], cfg.norm_eps)
+            q, k, v = _qkv(h, lp, cfg)
+            q = llama2.apply_rope(q, cos, sin)
+            k = llama2.apply_rope(k, cos, sin)
+            ks = ks.at[i, pb, off].set(k[:, 0].astype(ks.dtype))
+            vs = vs.at[i, pb, off].set(v[:, 0].astype(vs.dtype))
+            k_view = ks[i][view_ids].reshape(
+                slots, cache_cap, cfg.kv_heads, cfg.head_dim
+            )
+            v_view = vs[i][view_ids].reshape(
+                slots, cache_cap, cfg.kv_heads, cfg.head_dim
+            )
+            attn = _grouped_attention(
+                q, k_view.astype(cfg.dtype), v_view.astype(cfg.dtype),
+                mask, cfg,
+            )
+            x = x + _attn_out_proj(attn, lp, cfg)
+            h = _rmsnorm(x, lp["ffn_norm"]["scale"], cfg.norm_eps)
+            x = x + _mlp(h, lp, cfg)
+        logits = _logits_head(x, params, cfg)
+        return ks, vs, jnp.argmax(logits[:, 0], axis=-1).astype(
+            jnp.int32
+        )
+
+    return decode
+
+
+def make_copy_block_fn():
+    """``(ks, vs, src, dst)``: copy one physical page (all layers) --
+    the device half of copy-on-write."""
+
+    def copy_block(ks, vs, src, dst):
+        k_page = jax.lax.dynamic_slice_in_dim(ks, src, 1, axis=1)
+        v_page = jax.lax.dynamic_slice_in_dim(vs, src, 1, axis=1)
+        ks = jax.lax.dynamic_update_slice_in_dim(ks, k_page, dst, axis=1)
+        vs = jax.lax.dynamic_update_slice_in_dim(vs, v_page, dst, axis=1)
+        return ks, vs
+
+    return copy_block
+
+
+# ---------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _PagedSlot:
+    """Host-side request state behind one batch slot."""
+
+    prompt: List[int]
+    max_new: int
+    blocks: List[int]          # pages this request references, in order
+    n_shared: int              # leading pages resolved from the trie
+    plan: List[Tuple[int, int, int]]   # (start, run, bucket) chunks
+    next_chunk: int = 0
+    forwarded: int = 0         # padded tokens actually forwarded
+
+
+class PagedEngine(Engine):
+    """AOT prefill/decode over a paged KV pool.
+
+    Presents the slab :class:`Engine`'s compile/warmup surface plus the
+    paged protocol the scheduler drives (``is_paged`` marks it):
+
+    * :meth:`validate_request` -- submit-time page-budget check (typed
+      :class:`UnservableRequestError` for never-servable requests);
+    * :meth:`admit` -- prefix-trie lookup, conservative page
+      reservation for prompt + max_new (no mid-flight OOM: a request
+      that admits always finishes), chunk plan; raises
+      :class:`BlockBudgetError` when the pool is transiently full
+      (after trying to reclaim trie-only pages);
+    * :meth:`prefill_step` -- run the next chunk; returns the first
+      greedy token once the prompt is fully prefilled (and registers
+      the prompt's full pages in the trie);
+    * :meth:`decode` -- one token for every slot, block tables and the
+      active mask riding as data;
+    * :meth:`release` -- drop the request's page references (trie
+      references survive, so its prompt stays hit-able).
+    """
+
+    is_paged = True
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: llama2.LlamaConfig,
+        serve_cfg: ServeConfig,
+        mesh: Mesh,
+        paged: PagedConfig,
+        param_pspecs: Any = None,
+    ):
+        bs = paged.block_size
+        if serve_cfg.max_seq_len % bs:
+            raise ValueError(
+                f"max_seq_len {serve_cfg.max_seq_len} must be a "
+                f"multiple of block_size {bs} (the logical view is a "
+                "whole number of pages)"
+            )
+        bad = [b for b in serve_cfg.prefill_buckets if b % bs]
+        if bad:
+            raise ValueError(
+                f"prefill buckets {bad} are not multiples of "
+                f"block_size {bs} (chunk writes are page-aligned)"
+            )
+        if paged.prefill_chunk > max(serve_cfg.prefill_buckets):
+            raise ValueError(
+                f"prefill_chunk {paged.prefill_chunk} exceeds the "
+                f"largest compiled bucket "
+                f"{max(serve_cfg.prefill_buckets)}"
+            )
+        per_seq = serve_cfg.max_seq_len // bs
+        # A pool SMALLER than one full-capacity sequence is legal --
+        # it simply cannot serve max-length requests, and
+        # validate_request() rejects those at submit with the typed
+        # page-budget error (the whole point of paging is that HBM no
+        # longer has to be provisioned for worst-case length).
+        self.paged = paged
+        self.max_blocks_per_seq = per_seq
+        # Table rows carry extra scratch entries past capacity so a
+        # bucket-padded chunk write at the capacity edge stays
+        # in-range (see make_chunk_prefill_fn).
+        self.table_width = per_seq + max(serve_cfg.prefill_buckets) // bs
+        super().__init__(params, cfg, serve_cfg, mesh, param_pspecs)
+
+        self.allocator = BlockAllocator(paged.num_blocks)
+        self.trie: Optional[PrefixTrie] = (
+            PrefixTrie(bs) if paged.prefix_cache else None
+        )
+        self._tables = np.full(
+            (serve_cfg.slots, self.table_width), SCRATCH_BLOCK,
+            np.int32,
+        )
+        self._tables_dev = None  # rebuilt lazily after table edits
+        self._slot_state: Dict[int, _PagedSlot] = {}
+        self.prefill_forwarded_total = 0
+        # Registry gauge names are process-global: a multi-pool
+        # process (the disagg tiers) must suffix them or the pools
+        # overwrite each other's readings (DisaggEngine sets
+        # "_prefill"/"_decode").
+        self.gauge_suffix = ""
+        self.paged_stats = {
+            "prefix_lookups": 0, "prefix_hits": 0,
+            "prefix_hit_blocks": 0, "prefill_chunks": 0,
+            "cow_copies": 0, "trie_evictions": 0,
+        }
+        self._blocks_free_min = self.allocator.free_blocks
+        self._set_block_gauges()
+
+    # -- cache layout overrides ----------------------------------------
+    def _cache_shape(self) -> Tuple[int, ...]:
+        return (
+            self.cfg.n_layers, self.paged.num_blocks,
+            self.paged.block_size, self.cfg.kv_heads,
+            self.cfg.head_dim,
+        )
+
+    def _cache_pspec(self) -> P:
+        return paged_kv_cache_pspec(self.mesh, self.cfg.kv_heads)
+
+    # -- executable table ----------------------------------------------
+    def _build(self, key):
+        self.compile_count += 1
+        cache = self._cache_abstract()
+        params_abs = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            self.params, self._param_shardings,
+        )
+        scalar = jax.ShapeDtypeStruct((), jnp.int32, sharding=self._rep)
+        slots = self.serve_cfg.slots
+        if key[0] == "prefill":
+            bucket = key[1]
+            fn = make_chunk_prefill_fn(
+                self.cfg, bucket, self.paged.block_size,
+                self.max_blocks_per_seq, self.table_width,
+            )
+            tokens = jax.ShapeDtypeStruct(
+                (1, bucket), jnp.int32, sharding=self._rep
+            )
+            table = jax.ShapeDtypeStruct(
+                (self.table_width,), jnp.int32, sharding=self._rep
+            )
+            args = (params_abs, cache, cache, tokens, scalar, scalar,
+                    table)
+        elif key[0] == "decode":
+            fn = make_paged_decode_fn(
+                self.cfg, self.paged.block_size,
+                self.max_blocks_per_seq, self.table_width,
+            )
+            vec = jax.ShapeDtypeStruct(
+                (slots,), jnp.int32, sharding=self._rep
+            )
+            tables = jax.ShapeDtypeStruct(
+                (slots, self.table_width), jnp.int32, sharding=self._rep
+            )
+            args = (params_abs, cache, cache, vec, vec, tables, vec)
+        else:  # ("copy_block",)
+            fn = make_copy_block_fn()
+            jitted = jax.jit(
+                fn,
+                donate_argnums=(0, 1),
+                out_shardings=(
+                    self._cache_sharding, self._cache_sharding
+                ),
+            )
+            return jitted.lower(cache, cache, scalar, scalar).compile()
+        jitted = jax.jit(
+            fn,
+            donate_argnums=(1, 2),
+            out_shardings=(
+                self._cache_sharding, self._cache_sharding, self._rep
+            ),
+        )
+        return jitted.lower(*args).compile()
+
+    def warmup(self) -> int:
+        for b in self.serve_cfg.prefill_buckets:
+            self._get_exec(("prefill", b))
+        self._get_exec(("decode",))
+        self._get_exec(("copy_block",))
+        return self.compile_count
+
+    # -- page bookkeeping ----------------------------------------------
+    def _set_block_gauges(self) -> None:
+        free = self.allocator.free_blocks
+        self._blocks_free_min = min(self._blocks_free_min, free)
+        get_registry().set_gauge(
+            f"serve_kv_blocks_free{self.gauge_suffix}", free
+        )
+        get_registry().set_gauge(
+            f"serve_kv_blocks_used{self.gauge_suffix}",
+            self.allocator.used_blocks,
+        )
+
+    @property
+    def block_occupancy(self) -> float:
+        """Fraction of the pool held by LIVE requests. Trie-parked
+        pages are deliberately excluded: they are a reclaimable cache
+        (admit evicts them on demand), and counting them would drive
+        the admission policy's occupancy input to permanent
+        saturation as the trie warms -- shedding requests the pool
+        could seat fine."""
+        usable = self.paged.usable_blocks
+        if not usable:
+            return 0.0
+        live: set = set()
+        for st in self._slot_state.values():
+            live.update(st.blocks)
+        return len(live) / usable
+
+    def slot_table(self, slot: int) -> np.ndarray:
+        """Host copy of one slot's block-table row (disagg reads it to
+        ship exactly the referenced pages)."""
+        return self._tables[slot].copy()
+
+    def slot_state(self, slot: int) -> _PagedSlot:
+        return self._slot_state[slot]
+
+    def _tables_device(self):
+        if self._tables_dev is None:
+            self._tables_dev = self._rep_arr(self._tables)
+        return self._tables_dev
+
+    def _write_table(self, slot: int, blocks: Sequence[int]) -> None:
+        row = np.full((self.table_width,), SCRATCH_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        self._tables[slot] = row
+        self._tables_dev = None
+
+    # -- the paged protocol --------------------------------------------
+    def validate_request(
+        self, prompt_len: int, max_new: int, rid: str = "?"
+    ) -> None:
+        """Submit-time discipline: reject only the truly unservable.
+        With chunked prefill any prompt length up to capacity chunks
+        through the compiled buckets; without it, the whole remainder
+        must fit one bucket, so the slab-era bucket check remains."""
+        need = self.paged.blocks_for(prompt_len + max_new)
+        usable = self.paged.usable_blocks
+        if need > usable:
+            raise UnservableRequestError(
+                f"request {rid!r}: prompt {prompt_len} + max_new "
+                f"{max_new} needs {need} pages of "
+                f"{self.paged.block_size} tokens, but the pool budget "
+                f"is {usable} usable pages "
+                f"({self.paged.num_blocks} minus scratch)"
+            )
+        if not self.paged.prefill_chunk:
+            # Worst case (no prefix hit) the whole prompt is one chunk.
+            self.serve_cfg.bucket_for(prompt_len)
+
+    def _chunk_plan(
+        self, start: int, prompt_len: int
+    ) -> List[Tuple[int, int, int]]:
+        plan = []
+        pos = start
+        stride = self.paged.prefill_chunk or None
+        while pos < prompt_len:
+            run = prompt_len - pos
+            if stride is not None:
+                run = min(stride, run)
+            plan.append((pos, run, self.serve_cfg.bucket_for(run)))
+            pos += run
+        return plan
+
+    def admit(
+        self,
+        slot: int,
+        prompt: Sequence[int],
+        max_new: int,
+        run_prefill: bool = True,
+    ) -> Dict[str, int]:
+        """Reserve pages and build the chunk plan for one request.
+
+        Conservative reservation: ``ceil((prompt + max_new) / bs)``
+        pages up front (minus prefix hits), so decode can never hit an
+        empty free list mid-request -- admission is the only place the
+        pool says no. ``run_prefill=False`` (the disagg decode tier)
+        reserves the same pages but skips the trie and the chunk plan:
+        page contents arrive via the cross-tier hop.
+        """
+        if slot in self._slot_state:
+            raise ValueError(f"slot {slot} already admitted")
+        plen = len(prompt)
+        need = self.paged.blocks_for(plen + max_new)
+        shared: List[int] = []
+        if run_prefill and self.trie is not None:
+            shared = self.trie.match(prompt)
+            # Keep at least one prompt token to (re-)prefill: the
+            # first greedy token comes from the last prompt position's
+            # logits, which a fully-cached prompt would never compute.
+            while shared and len(shared) * self.paged.block_size >= plen:
+                shared.pop()
+        self.allocator.retain(shared)
+        fresh_needed = need - len(shared)
+        short = fresh_needed - self.allocator.free_blocks
+        if short > 0 and self.trie is not None:
+            self.paged_stats["trie_evictions"] += self.trie.evict(
+                self.allocator, short
+            )
+        try:
+            fresh = self.allocator.alloc(fresh_needed)
+        except BlockBudgetError:
+            self.allocator.release(shared)
+            raise
+        start = len(shared) * self.paged.block_size
+        plan = self._chunk_plan(start, plen) if run_prefill else []
+        state = _PagedSlot(
+            prompt=list(int(t) for t in prompt),
+            max_new=max_new,
+            blocks=shared + fresh,
+            n_shared=len(shared),
+            plan=plan,
+        )
+        self._slot_state[slot] = state
+        self._write_table(slot, state.blocks)
+        bus = get_bus()
+        # Ring-only page telemetry (no sink): allocation happens at
+        # admission cadence, flight-recorder forensics is the right
+        # volume tier (the lg_token discipline).
+        bus.emit("kv_block", action="alloc", n=len(fresh), slot=slot)
+        # Hit-rate stats count SEATED admissions only, and only after
+        # alloc succeeded: a block-stalled request is re-queued and
+        # retried every tick, and counting each retry as a lookup
+        # would deflate prefix_hit_rate by stall count -- failing the
+        # cache-efficiency gate on pool pressure, not trie behavior
+        # (review finding).
+        if run_prefill and self.trie is not None:
+            self.paged_stats["prefix_lookups"] += 1
+        if shared:
+            self.paged_stats["prefix_hits"] += 1
+            self.paged_stats["prefix_hit_blocks"] += len(shared)
+            get_registry().inc("serve_prefix_hit_total")
+            get_registry().inc(
+                "serve_prefix_hit_blocks_total", len(shared)
+            )
+            bus.emit(
+                "kv_block", action="prefix_hit", n=len(shared),
+                slot=slot,
+            )
+        self._set_block_gauges()
+        return {
+            "shared_blocks": len(shared),
+            "shared_tokens": start,
+            "chunks": len(plan),
+            "planned_prefill_tokens": sum(b for _, _, b in plan),
+        }
+
+    def planned_prefill_tokens(self, slot: int) -> int:
+        return sum(b for _, _, b in self._slot_state[slot].plan)
+
+    def prefill_step(self, slot: int) -> Optional[int]:
+        """Run the next prefill chunk for ``slot``. Returns the first
+        greedy token when the prompt is complete, else ``None``.
+        Span-bracketed like the slab prefill (the token fetch rides
+        inside, so the span measures dispatch-to-result)."""
+        st = self._slot_state[slot]
+        if st.next_chunk >= len(st.plan):
+            raise ValueError(f"slot {slot} has no prefill pending")
+        start, run, bucket = st.plan[st.next_chunk]
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :run] = st.prompt[start:start + run]
+        exec_ = self._get_exec(("prefill", bucket))
+        with span("prefill", hist="serve_prefill_s", n=bucket):
+            self.ks, self.vs, tok = exec_(
+                self.params, self.ks, self.vs,
+                self._rep_arr(padded), self._rep_arr(start),
+                self._rep_arr(run),
+                self._rep_arr(self._tables[slot]),
+            )
+            st.next_chunk += 1
+            st.forwarded += bucket
+            self.prefill_forwarded_total += bucket
+            self.paged_stats["prefill_chunks"] += 1
+            if st.next_chunk < len(st.plan):
+                return None
+            first = int(tok)
+        if self.trie is not None:
+            n_full = len(st.prompt) // self.paged.block_size
+            if n_full:
+                self.trie.insert(
+                    st.prompt, st.blocks[:n_full], self.allocator
+                )
+        return first
+
+    def _cow_write_target(self, slot: int, pos: int) -> None:
+        """Guard rail before a decode write: the target page must be
+        exclusively ours. By construction it always is (writes start
+        past the shared prefix, and the trie only references FULL
+        prompt pages while decode writes land after the prompt) --
+        but if a reference appeared (a test, a future sharing policy),
+        copy the page first instead of corrupting the other owner."""
+        st = self._slot_state[slot]
+        idx = pos // self.paged.block_size
+        blk = st.blocks[idx]
+        if self.allocator.refcount(blk) <= 1:
+            return
+        new, copied = self.allocator.cow(blk)
+        if copied:
+            exec_ = self._get_exec(("copy_block",))
+            self.ks, self.vs = exec_(
+                self.ks, self.vs, self._rep_arr(blk),
+                self._rep_arr(new),
+            )
+            st.blocks[idx] = new
+            self._write_table(slot, st.blocks)
+            self.paged_stats["cow_copies"] += 1
+            get_bus().emit(
+                "kv_block", action="cow", block=int(new), slot=slot
+            )
+            self._set_block_gauges()
+
+    def decode(
+        self,
+        tokens: Sequence[int],
+        positions: Sequence[int],
+        active: Optional[Sequence[bool]] = None,
+    ) -> np.ndarray:
+        """One decode step for every slot; ``active[s]`` False redirects
+        slot ``s``'s write to the scratch page (free slots, and slots
+        still mid-chunked-prefill, must not dirty live pages)."""
+        if active is None:
+            active = [True] * self.serve_cfg.slots
+        for s, (is_on, pos) in enumerate(zip(active, positions)):
+            if is_on and s in self._slot_state:
+                self._cow_write_target(s, int(pos))
+        exec_ = self._get_exec(("decode",))
+        with span("decode", hist="serve_decode_s"):
+            self.ks, self.vs, toks = exec_(
+                self.params, self.ks, self.vs,
+                self._rep_arr(np.asarray(tokens, np.int32)),
+                self._rep_arr(np.asarray(positions, np.int32)),
+                self._tables_device(),
+                self._rep_arr(np.asarray(active, np.int32)),
+            )
+            return np.asarray(toks)
+
+    def release(self, slot: int) -> None:
+        """Drop the request's page references (the trie keeps its own,
+        so the prompt stays reusable) and reset the table row."""
+        st = self._slot_state.pop(slot, None)
+        if st is None:
+            return
+        freed = self.allocator.release(st.blocks)
+        self._write_table(slot, [])
+        get_bus().emit("kv_block", action="free", n=freed, slot=slot)
+        self._set_block_gauges()
+
+    def prefill(self, slot: int, prompt: Sequence[int]) -> int:
+        raise NotImplementedError(
+            "PagedEngine is driven through admit()/prefill_step(); "
+            "the one-shot prefill surface belongs to the slab Engine"
+        )
+
+    # -- reporting ------------------------------------------------------
+    def paged_summary(self) -> Dict[str, Any]:
+        """The serve-summary block describing this pool: layout, hit
+        rate, page headroom -- what the obs report's serving section
+        and the regress gate read."""
+        s = self.paged_stats
+        lookups = s["prefix_lookups"]
+        return {
+            "kv_layout": "paged",
+            "kv_block_size": self.paged.block_size,
+            "kv_blocks": self.paged.num_blocks,
+            "kv_blocks_usable": self.paged.usable_blocks,
+            "kv_blocks_free": self.allocator.free_blocks,
+            "kv_blocks_free_min": self._blocks_free_min,
+            "prefix_lookups": lookups,
+            "prefix_hits": s["prefix_hits"],
+            "prefix_hit_blocks": s["prefix_hit_blocks"],
+            "prefix_hit_rate": (
+                s["prefix_hits"] / lookups if lookups else 0.0
+            ),
+            "prefill_chunks": s["prefill_chunks"],
+            "cow_copies": s["cow_copies"],
+            "trie_evictions": s["trie_evictions"],
+        }

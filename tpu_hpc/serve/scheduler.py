@@ -28,6 +28,21 @@ sheds the lowest-priority tenant class instead of letting every
 tenant's TTFT collapse together. Every decision is emitted as a
 schema-stamped ``admission`` event so the report can attribute the
 shed load per tenant class.
+
+Paged engines (serve/paging.py, ``engine.is_paged``) change what
+"capacity" means: admission budgets KV **pages**, not slots. The
+batcher drives the paged protocol -- ``admit`` (page reservation +
+prefix-trie lookup), ``prefill_step`` (one block-aligned chunk per
+tick per prefilling slot, interleaved with decode so a long admission
+never stalls in-flight ITL), ``release`` on eviction -- and both the
+occupancy the policy reads and the shed decisions consult the
+allocator: a tick where a free slot exists but the pool cannot seat
+the head-of-queue request counts as a ``block_stall`` (the request
+stays queued; the overflow/watermark rules above still bound the
+backlog). ``submit()`` keeps the fail-at-submit discipline only for
+the truly unservable: prompt + max_new exceeding the total page
+budget raises a typed error naming both numbers
+(paging.UnservableRequestError).
 """
 from __future__ import annotations
 
@@ -38,6 +53,22 @@ import numpy as np
 
 from tpu_hpc.obs import get_bus, get_registry
 from tpu_hpc.serve.engine import Engine
+
+
+def paged_drain_bound(engine, requests) -> int:
+    """Upper bound on the EXTRA ticks a paged engine can add to a
+    drain of ``requests``: chunked prefill spreads each prompt over
+    up to ceil(len/stride) ticks, and block stalls wait at most until
+    in-flight requests free pages (trie eviction guarantees progress
+    once the pool empties). One helper so the batcher's and the load
+    harness's drain budgets cannot silently diverge."""
+    requests = list(requests)
+    paged = getattr(engine, "paged", None)
+    stride = getattr(paged, "prefill_chunk", 0) or None
+    return sum(
+        -(-len(r.prompt) // stride) if stride else 1
+        for r in requests
+    ) + 2 * len(requests)
 
 
 @dataclasses.dataclass
@@ -102,10 +133,15 @@ class _Slot:
     pos: int = 0          # next cache write position == tokens held
     last_token: int = 0   # the token the next decode step consumes
     remaining: int = 0    # new tokens still to generate
+    prefilling: bool = False  # paged: prompt chunks still running
 
     @property
     def free(self) -> bool:
         return self.rid is None
+
+    @property
+    def decoding(self) -> bool:
+        return self.rid is not None and not self.prefilling
 
 
 class ContinuousBatcher:
@@ -141,12 +177,15 @@ class ContinuousBatcher:
         self.meter = meter
         self.policy = policy
         self.stall_signal = stall_signal
+        self._paged = bool(getattr(engine, "is_paged", False))
         self.slots = [_Slot() for _ in range(engine.serve_cfg.slots)]
         self.pending: List[Request] = []
         self.results: Dict[str, List[int]] = {}
         self.stats = {
             "admitted": 0, "evicted": 0, "decode_steps": 0, "shed": 0,
         }
+        if self._paged:
+            self.stats["block_stalls"] = 0
         self._requests: Dict[str, Request] = {}
         self._order: Dict[str, int] = {}  # rid -> submission sequence
         # The occupancy gauge exists (at 0) from bring-up: a scraper
@@ -164,10 +203,19 @@ class ContinuousBatcher:
                 f"{len(request.prompt)} + max_new "
                 f"{request.max_new_tokens} exceeds cache capacity {cap}"
             )
-        # Validate against the compiled buckets NOW: failing at
-        # admission time (mid-drain) would abort every other in-flight
-        # request's partial results for one oversized prompt.
-        self.engine.serve_cfg.bucket_for(len(request.prompt))
+        # Validate the truly-unservable NOW: failing at admission time
+        # (mid-drain) would abort every other in-flight request's
+        # partial results for one oversized prompt. Paged engines
+        # budget pages (with chunked prefill a prompt longer than the
+        # largest bucket is perfectly servable); the slab keeps the
+        # bucket check.
+        if self._paged:
+            self.engine.validate_request(
+                len(request.prompt), request.max_new_tokens,
+                rid=request.rid,
+            )
+        else:
+            self.engine.serve_cfg.bucket_for(len(request.prompt))
         self._requests[request.rid] = request
         self._order[request.rid] = len(self._order)
         self.pending.append(request)
@@ -185,7 +233,14 @@ class ContinuousBatcher:
 
     @property
     def occupancy(self) -> float:
-        return self.active / len(self.slots)
+        """The fraction of the scarce resource in use: slots for the
+        slab engine; for paged engines the max of slot and PAGE
+        occupancy -- a pool out of pages is saturated even with free
+        slots (the admission policy's shed/queue input must see it)."""
+        slot_occ = self.active / len(self.slots)
+        if self._paged:
+            return max(slot_occ, self.engine.block_occupancy)
+        return slot_occ
 
     @property
     def done(self) -> bool:
@@ -293,44 +348,127 @@ class ContinuousBatcher:
             )
 
     # -- one decode-granularity tick ----------------------------------
-    def step(self) -> None:
-        """Apply admission policy, admit into free slots, then one
-        decode step for all."""
-        self._admission_control()
+    def _admit_slab(self, idx: int, slot: _Slot) -> bool:
+        req = self._next_pending()
+        if self.meter is not None:
+            self.meter.admitted(
+                req.rid,
+                prefill_tokens=self.engine.serve_cfg.bucket_for(
+                    len(req.prompt)
+                ),
+            )
+        first = self.engine.prefill(idx, req.prompt)
+        self.stats["admitted"] += 1
+        get_registry().inc("serve_admitted_total")
+        slot.rid = req.rid
+        slot.pos = len(req.prompt)
+        slot.last_token = first
+        slot.remaining = req.max_new_tokens - 1
+        self._set_occupancy()
+        self.results[req.rid] = [first]
+        if self.meter is not None:
+            self.meter.token(req.rid, first=True)
+        if slot.remaining == 0 or first == req.eos_id:
+            self._evict(idx, slot)
+        return True
+
+    def _admit_paged(self, idx: int, slot: _Slot) -> bool:
+        """Seat the head-of-queue request if the page pool can hold
+        it; on a transient page shortage the request stays queued
+        (FIFO within its class -- skipping ahead to a smaller request
+        would starve the large one forever) and the tick is counted
+        as a block stall. Returns False to stop this tick's admission
+        loop on a stall."""
+        from tpu_hpc.serve.paging import BlockBudgetError
+
+        req = self._next_pending()
+        try:
+            info = self.engine.admit(
+                idx, req.prompt, req.max_new_tokens
+            )
+        except BlockBudgetError:
+            self.pending.append(req)  # _order keeps its place
+            self.stats["block_stalls"] += 1
+            get_registry().inc("serve_block_stalls_total")
+            get_bus().emit(
+                "admission",
+                sink=self._sink(),
+                action="block_stall",
+                rid=req.rid,
+                tenant=req.tenant,
+                occupancy=self.occupancy,
+                pending=len(self.pending),
+                reason="kv_pool_exhausted",
+            )
+            return False
+        slot.rid = req.rid
+        slot.prefilling = True
+        slot.pos = 0
+        slot.remaining = req.max_new_tokens
+        self.stats["admitted"] += 1
+        get_registry().inc("serve_admitted_total")
+        self._set_occupancy()
+        if self.meter is not None:
+            self.meter.admitted(
+                req.rid,
+                prefill_tokens=info["planned_prefill_tokens"],
+            )
+        return True
+
+    def _prefill_tick(self) -> None:
+        """Advance every prefilling slot by ONE chunk -- the
+        interleave that keeps a long admission from stalling in-flight
+        decode ITL. A slot whose last chunk completes yields its first
+        token and joins the decode batch next tick."""
         for idx, slot in enumerate(self.slots):
-            if not slot.free or not self.pending:
+            if slot.free or not slot.prefilling:
                 continue
-            req = self._next_pending()
-            if self.meter is not None:
-                self.meter.admitted(
-                    req.rid,
-                    prefill_tokens=self.engine.serve_cfg.bucket_for(
-                        len(req.prompt)
-                    ),
-                )
-            first = self.engine.prefill(idx, req.prompt)
-            self.stats["admitted"] += 1
-            get_registry().inc("serve_admitted_total")
-            slot.rid = req.rid
+            first = self.engine.prefill_step(idx)
+            if first is None:
+                continue
+            req = self._requests[slot.rid]
+            slot.prefilling = False
             slot.pos = len(req.prompt)
             slot.last_token = first
             slot.remaining = req.max_new_tokens - 1
-            self._set_occupancy()
             self.results[req.rid] = [first]
             if self.meter is not None:
                 self.meter.token(req.rid, first=True)
             if slot.remaining == 0 or first == req.eos_id:
-                self._evict(slot)
+                self._evict(idx, slot)
 
-        if self.active == 0:
+    def step(self) -> None:
+        """Apply admission policy, admit into free slots, advance
+        prefill chunks (paged), then one decode step for all."""
+        self._admission_control()
+        for idx, slot in enumerate(self.slots):
+            if not slot.free or not self.pending:
+                continue
+            if self._paged:
+                if not self._admit_paged(idx, slot):
+                    break
+            else:
+                self._admit_slab(idx, slot)
+        if self._paged:
+            self._prefill_tick()
+
+        if not any(s.decoding for s in self.slots):
             return
         tokens = [s.last_token for s in self.slots]
         positions = [s.pos for s in self.slots]
-        out = self.engine.decode(tokens, positions)
+        if self._paged:
+            out = self.engine.decode(
+                tokens, positions,
+                active=[s.decoding for s in self.slots],
+            )
+        else:
+            out = self.engine.decode(tokens, positions)
         self.stats["decode_steps"] += 1
         get_registry().inc("serve_decode_steps_total")
-        for slot, tok in zip(self.slots, np.asarray(out)):
-            if slot.free:
+        for idx, (slot, tok) in enumerate(
+            zip(self.slots, np.asarray(out))
+        ):
+            if not slot.decoding:
                 continue
             req = self._requests[slot.rid]
             tok = int(tok)
@@ -341,17 +479,22 @@ class ContinuousBatcher:
             slot.last_token = tok
             slot.remaining -= 1
             if slot.remaining == 0 or tok == req.eos_id:
-                self._evict(slot)
+                self._evict(idx, slot)
 
-    def _evict(self, slot: _Slot) -> None:
+    def _evict(self, idx: int, slot: _Slot) -> None:
         if self.meter is not None:
             self.meter.finished(slot.rid)
+        if self._paged:
+            self.engine.release(idx)
         self.stats["evicted"] += 1
         slot.rid = None
         slot.remaining = 0
+        slot.prefilling = False
+        slot.pos = 0
         self._set_occupancy()
-        # pos/last_token are reset on the next admission's prefill;
-        # leaving them is safe because the length mask bounds reads.
+        # last_token is reset on the next admission; stale cache
+        # contents are safe because the length mask bounds reads (and
+        # paged release returned the pages to the pool).
 
     # -- drain ---------------------------------------------------------
     def run(
@@ -367,11 +510,19 @@ class ContinuousBatcher:
         for r in requests:
             self.submit(r)
         steps = 0
-        budget = max_steps if max_steps is not None else (
+        if max_steps is not None:
+            budget = max_steps
+        else:
             # Worst case: every request runs its full length alone.
-            sum(r.max_new_tokens + 1 for r in self._requests.values())
-            + len(self._requests) + 1
-        )
+            budget = (
+                sum(r.max_new_tokens + 1
+                    for r in self._requests.values())
+                + len(self._requests) + 1
+            )
+            if self._paged:
+                budget += paged_drain_bound(
+                    self.engine, self._requests.values()
+                )
         while not self.done:
             if steps >= budget:
                 raise RuntimeError(
@@ -393,6 +544,11 @@ class ContinuousBatcher:
         transfer = getattr(self.engine, "transfer_stats", None)
         if transfer:
             self.stats.update(transfer)
+        # Paged engines count prefix hits, prefill chunks and CoW
+        # copies; fold them in for the same reason.
+        paged = getattr(self.engine, "paged_stats", None)
+        if paged:
+            self.stats.update(paged)
         return self.results
 
 

@@ -74,15 +74,20 @@ def run_replay(
     seed: int = 0,
     disagg: bool = False,
     disagg_max_inflight_mb: Optional[int] = None,
+    paged=None,
 ) -> dict:
     """Engine bring-up + warmup + replay; returns the summary dict.
     ``disagg=True`` splits the chips into disaggregated prefill/decode
     tiers (serve/disagg.py), KV blocks crossing via bounded reshard
-    plans (``disagg_max_inflight_mb``)."""
+    plans (``disagg_max_inflight_mb``). ``paged`` (a
+    paging.PagedConfig) swaps the slab KV cache for the block-table
+    pool with prefix reuse and chunked prefill -- composable with
+    ``disagg`` (the hop then ships block tables + referenced pages)."""
     import jax
 
     from tpu_hpc.serve.engine import Engine
     from tpu_hpc.serve.metrics import ServeMeter
+    from tpu_hpc.serve.paging import PagedEngine
     from tpu_hpc.serve.scheduler import ContinuousBatcher, replay_requests
     from tpu_hpc.serve.weights import load_serving_params
     from tpu_hpc.resilience.heartbeat import Heartbeat
@@ -118,7 +123,10 @@ def run_replay(
                 disagg_max_inflight_mb * (1 << 20)
                 if disagg_max_inflight_mb else None
             ),
+            paged=paged,
         )
+    elif paged is not None:
+        engine = PagedEngine(params, cfg, serve_cfg, mesh, paged)
     else:
         engine = Engine(params, cfg, serve_cfg, mesh)
     with obs.span("warmup", sink=metrics_path, hist="serve_warmup_s"):
@@ -164,6 +172,13 @@ def run_replay(
         recompiles=engine.compile_count - n_programs,
         batcher=dict(batcher.stats),
     )
+    # The cache layout is part of every serving record's identity:
+    # the regress gate must never diff a paged run against a slab one
+    # without seeing the difference.
+    if paged is not None:
+        summary.update(engine.paged_summary())
+    else:
+        summary["kv_layout"] = "slab"
     if disagg:
         # Per-tier attribution: tier meshes, the cross-tier KV load,
         # and THIS run's hop-latency quantiles (the engine's own
@@ -187,16 +202,20 @@ def run_loadgen(
     checkpoint_dir: Optional[str] = None,
     metrics_path: Optional[str] = None,
     seed: int = 0,
+    paged=None,
 ) -> dict:
     """Engine bring-up + a tpu_hpc.loadgen scenario run; returns the
     harness summary (per-tenant quantiles, shed/queued counts,
     occupancy). The scenario's lengths are aligned to THIS engine's
     buckets/capacity, so any catalog entry runs against any serve
-    shape."""
+    shape. ``paged`` (a paging.PagedConfig) runs the scenario against
+    the block-table cache -- the shared_prefix scenario's hit rate and
+    the admission block stalls come from exactly this path."""
     import jax
 
     from tpu_hpc.loadgen import LoadHarness, build_scenario
     from tpu_hpc.serve.engine import Engine
+    from tpu_hpc.serve.paging import PagedEngine
     from tpu_hpc.serve.weights import load_serving_params
     from tpu_hpc.resilience.heartbeat import Heartbeat
 
@@ -222,7 +241,10 @@ def run_loadgen(
             params = load_serving_params(checkpoint_dir, cfg, mesh)
         else:
             params = llama2.init_llama(jax.random.key(seed), cfg)
-    engine = Engine(params, cfg, serve_cfg, mesh)
+    if paged is not None:
+        engine = PagedEngine(params, cfg, serve_cfg, mesh, paged)
+    else:
+        engine = Engine(params, cfg, serve_cfg, mesh)
     with obs.span("warmup", sink=metrics_path, hist="serve_warmup_s"):
         n_programs = engine.warmup()
     harness = LoadHarness(
@@ -243,19 +265,22 @@ def run_loadgen(
 
     harness.drive(tick_cb=tick_cb)
     peak = peak_flops_per_chip(jax.devices()[0])
+    # kv_layout/hit-rate evidence rides in from harness.summarize()
+    # itself (the harness owns the engine's identity either way).
+    extra = dict(
+        mesh={k: int(v) for k, v in mesh.shape.items()},
+        slots=serve_cfg.slots,
+        prefill_buckets=list(serve_cfg.prefill_buckets),
+        compiled_programs=n_programs,
+        # Evaluated AFTER the drive: recompiles must count the run.
+        recompiles=engine.compile_count - n_programs,
+        batcher=dict(harness.batcher.stats),
+    )
     return harness.summarize(
         n_devices=jax.device_count(),
         n_params=llama2.count_params(cfg),
         peak_flops_per_device=peak,
-        # Evaluated AFTER the drive: recompiles must count the run.
-        extra=dict(
-            mesh={k: int(v) for k, v in mesh.shape.items()},
-            slots=serve_cfg.slots,
-            prefill_buckets=list(serve_cfg.prefill_buckets),
-            compiled_programs=n_programs,
-            recompiles=engine.compile_count - n_programs,
-            batcher=dict(harness.batcher.stats),
-        ),
+        extra=extra,
     )
 
 
@@ -335,6 +360,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         metavar="MB",
         help="peak per-device transient allowed to a cross-tier KV "
         "move (reshard max_inflight_bytes); default: unbounded",
+    )
+    ap.add_argument(
+        "--paged", action="store_true",
+        help="paged KV cache (serve/paging.py): HBM carved into "
+        "fixed-size pages with a block-table per slot, prefix reuse "
+        "over shared prompts, chunked prefill; composable with "
+        "--disagg (the KV hop then ships block tables + referenced "
+        "pages only)",
+    )
+    ap.add_argument(
+        "--kv-block-size", type=int, default=None, metavar="TOKENS",
+        help="tokens per KV page (default 16; must divide every "
+        "bucket and the cache capacity); requires --paged",
+    )
+    ap.add_argument(
+        "--kv-blocks", type=int, default=None, metavar="N",
+        help="physical pages in the pool incl. the scratch page "
+        "(default: slab-equivalent capacity, slots x max-seq-len / "
+        "block-size + 1); requires --paged",
+    )
+    ap.add_argument(
+        "--prefill-chunk", type=int, default=None, metavar="TOKENS",
+        help="chunked prefill stride: long prompts prefill in "
+        "block-aligned chunks interleaved with decode steps (0 = "
+        "whole-prompt prefill; with chunking, prompts LONGER than "
+        "the largest bucket are servable); requires --paged",
     )
     ap.add_argument(
         "--checkpoint-dir", type=str, default=None,
@@ -418,6 +469,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             f"--disagg-max-inflight-mb {args.disagg_max_inflight_mb} "
             "must be >= 1"
         )
+    # Paged sizing flags only mean something with --paged: a sizing
+    # flag on a slab run silently doing nothing is exactly the
+    # misplaced-flag failure mode this CLI bans.
+    if not args.paged:
+        for flag, val in (
+            ("--kv-block-size", args.kv_block_size),
+            ("--kv-blocks", args.kv_blocks),
+            ("--prefill-chunk", args.prefill_chunk),
+        ):
+            if val is not None:
+                ap.error(
+                    f"{flag} is only consumed together with --paged"
+                )
 
     if args.sim_devices:
         from tpu_hpc.runtime import sim
@@ -433,10 +497,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     too_long = [p for p in prompt_lens if p > max(buckets)]
     # --loadgen sizes its own prompt distribution to the buckets; the
     # replay mix's --prompt-lens is unused there and must not block.
-    if too_long and not args.loadgen:
+    # With chunked prefill, prompts longer than the largest bucket
+    # chunk through it and are perfectly servable.
+    chunked = bool(args.paged and args.prefill_chunk)
+    if too_long and not args.loadgen and not chunked:
         ap.error(
             f"prompt lens {too_long} exceed the largest bucket "
-            f"{max(buckets)}"
+            f"{max(buckets)} (chunked prefill -- --paged "
+            "--prefill-chunk N -- lifts this limit)"
         )
     # `is not None`, not truthiness: an explicit --max-seq-len 0 must
     # fail capacity validation loudly, not silently take the default.
@@ -444,6 +512,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         args.max_seq_len if args.max_seq_len is not None
         else max(buckets) + args.max_new
     )
+    paged = None
+    if args.paged:
+        from tpu_hpc.serve.paging import derive_paged_config
+
+        try:
+            # The derived default capacity rounds up to a whole
+            # number of pages; an explicit --max-seq-len must align
+            # itself (loud). One shared derivation with bench.py --
+            # the rows and the CLI must agree on every default.
+            paged, max_seq = derive_paged_config(
+                args.slots, max_seq, buckets,
+                block_size=args.kv_block_size,
+                num_blocks=args.kv_blocks,
+                prefill_chunk=args.prefill_chunk,
+                align_capacity=args.max_seq_len is None,
+            )
+        except ValueError as e:
+            ap.error(str(e))
     if max_seq > cfg.max_seq_len:
         ap.error(
             f"cache capacity {max_seq} exceeds the model's "
@@ -477,6 +563,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             cfg, serve_cfg, args.loadgen, args.requests, args.max_new,
             checkpoint_dir=args.checkpoint_dir,
             metrics_path=args.metrics, seed=args.seed,
+            paged=paged,
         )
     else:
         if args.disagg:
@@ -494,6 +581,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             metrics_path=args.metrics, seed=args.seed,
             disagg=args.disagg,
             disagg_max_inflight_mb=args.disagg_max_inflight_mb,
+            paged=paged,
         )
     print(json.dumps(summary))
     return 0
