@@ -85,6 +85,20 @@ SLOW_NODEIDS = frozenset(nodeid for nodeid, _ in [
     ("tests/test_resnet.py::test_forward_shape[50]", "14s"),
     ("tests/test_serve.py::TestReplayServerCLI::test_main_runs_replay_and_prints_summary", "8s"),
     ("tests/test_serve.py::TestServingWeights::test_trainer_checkpoint_restores_into_serving_layout", "9s"),
+    # Speculative decoding (tests/test_spec.py): the tier-1 core keeps
+    # one oracle test per draft source (ngram + independent draft),
+    # the churn compile pin, the batch-composition determinism pin and
+    # the CLI guards; the heavier variants (self-draft accept-all,
+    # draft-mode sampled determinism, loadgen determinism, drain
+    # accounting, eos/prefix-hit long streams) ride the slow tier.
+    ("tests/test_serve.py::TestSpecOracle::test_spec_greedy_token_exact_hit_and_miss[draft]", "9s"),
+    ("tests/test_spec.py::TestGreedyOracle::test_self_draft_accepts_everything", "9s"),
+    ("tests/test_spec.py::TestGreedyOracle::test_eos_mid_acceptance_truncates_exactly", "8s"),
+    ("tests/test_spec.py::TestGreedyOracle::test_prefix_hit_and_long_stream_acceptance", "7s"),
+    ("tests/test_spec.py::TestSeededSampling::test_seed_changes_the_stream", "9s"),
+    ("tests/test_spec.py::TestSeededSampling::test_draft_mode_sampling_deterministic", "16s"),
+    ("tests/test_spec.py::TestPageAccounting::test_pools_drain_to_idle_and_invariants_hold", "9s"),
+    ("tests/test_spec.py::TestServerCLI::test_loadgen_with_spec_is_deterministic", "14s"),
     ("tests/test_reshard.py::TestLongShapes::test_long_shape_bounded_parity_sweep", "35s"),
     ("tests/test_resnet.py::test_fsdp_training_step", "60s"),
     ("tests/test_run_metrics.py::TestMetricsLog::test_appends_across_runs", "13s"),

@@ -275,11 +275,13 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
 
     def fake_bench_serve(requests, slots, max_new, disagg=False,
                          paged=False, block_size=None, kv_blocks=None,
-                         prefill_chunk=None):
+                         prefill_chunk=None, spec="off", spec_k=None,
+                         draft_ckpt=None):
         seen.update(requests=requests, slots=slots, max_new=max_new,
                     disagg=disagg, paged=paged,
                     block_size=block_size, kv_blocks=kv_blocks,
-                    prefill_chunk=prefill_chunk)
+                    prefill_chunk=prefill_chunk, spec=spec,
+                    spec_k=spec_k, draft_ckpt=draft_ckpt)
         return {"metric": "serve_tokens_per_s_per_chip", "value": 1,
                 "unit": "tokens/s/chip", "vs_baseline": None}
 
@@ -293,7 +295,8 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
     assert seen == {"requests": 12, "slots": 4, "max_new": 7,
                     "disagg": False, "paged": False,
                     "block_size": None, "kv_blocks": None,
-                    "prefill_chunk": None}
+                    "prefill_chunk": None, "spec": "off",
+                    "spec_k": None, "draft_ckpt": None}
     seen.clear()
     assert bench.main(["--workload", "serve"]) == 0
     assert seen["requests"] == 32 and seen["slots"] == 8
@@ -309,6 +312,12 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
     ]) == 0
     assert seen["paged"] is True and seen["block_size"] == 32
     assert seen["kv_blocks"] == 512 and seen["prefill_chunk"] == 128
+    seen.clear()
+    assert bench.main([
+        "--workload", "serve", "--serve-paged",
+        "--serve-spec", "ngram", "--spec-k", "3",
+    ]) == 0
+    assert seen["spec"] == "ngram" and seen["spec_k"] == 3
 
 
 def test_serve_alias_conflicts_with_explicit_workload(bench, monkeypatch):
@@ -326,9 +335,10 @@ def test_loadgen_mode_routes_flags(bench, monkeypatch):
     def fake_bench_loadgen(scenario, requests, slots, max_new,
                            paged=False, block_size=None,
                            kv_blocks=None, prefill_chunk=None,
-                           model="bench"):
+                           model="bench", spec="off", spec_k=None,
+                           draft_ckpt=None):
         seen.update(scenario=scenario, requests=requests, slots=slots,
-                    max_new=max_new, paged=paged)
+                    max_new=max_new, paged=paged, spec=spec)
         return {"metric": "loadgen_x_ttft_ms_p95", "value": 1.0,
                 "unit": "virtual_ms", "vs_baseline": None}
 
@@ -341,7 +351,7 @@ def test_loadgen_mode_routes_flags(bench, monkeypatch):
     ])
     assert rc == 0
     assert seen == {"scenario": "bursty", "requests": 32, "slots": 4,
-                    "max_new": 16, "paged": False}
+                    "max_new": 16, "paged": False, "spec": "off"}
     seen.clear()
     assert bench.main([
         "--workload", "loadgen", "--loadgen-scenario",
@@ -378,6 +388,83 @@ def test_paged_flags_guarded_like_comm_mode(bench, monkeypatch):
     # wear the bench label while measuring a different machine.
     with pytest.raises(SystemExit):
         bench.main(["--workload", "serve", "--serve-model", "tiny"])
+
+
+def test_spec_flags_guarded_like_comm_mode(bench, monkeypatch):
+    """The speculative flags follow the misplaced-flag discipline: a
+    spec flag on a workload (or cache layout) that cannot consume it
+    is a CLI error, not a greedy row wearing a spec label."""
+    monkeypatch.setenv("TPU_HPC_BENCH_NO_PROBE", "1")
+    # Non-consuming workload.
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "llama", "--serve-spec", "ngram"])
+    # Spec rides the paged engine only.
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "serve", "--serve-spec", "ngram"])
+    # Disagg cannot consume the verify program.
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "serve", "--serve-paged",
+                    "--serve-spec", "ngram", "--serve-disagg"])
+    # Spec knobs require --serve-spec (and the ckpt requires draft
+    # mode specifically).
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "serve", "--serve-paged",
+                    "--spec-k", "4"])
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "serve", "--serve-paged",
+                    "--serve-draft-ckpt", "/tmp/x"])
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "serve", "--serve-paged",
+                    "--serve-spec", "ngram",
+                    "--serve-draft-ckpt", "/tmp/x"])
+    # k=0 must error loudly, not coerce to the default 4 (server.py's
+    # guard, mirrored).
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "serve", "--serve-paged",
+                    "--serve-spec", "ngram", "--spec-k", "0"])
+
+
+def test_serve_record_carries_spec_identity(bench):
+    """Speculative rows are labeled with mode/k and carry the two
+    judged signals (acceptance rate, draft cost)."""
+    base = {
+        "requests": 8, "slots": 4, "prefill_buckets": [8],
+        "recompiles": 0, "tokens_per_s_per_chip": 10.0,
+        "ttft_ms_p50": 1.0, "ttft_ms_p95": 2.0,
+        "itl_ms_p50": 1.0, "itl_ms_p95": 2.0,
+        "kv_layout": "paged", "kv_block_size": 16, "kv_blocks": 64,
+        "prefix_hit_rate": 0.0, "prefix_hit_blocks": 0,
+        "spec_mode": "ngram", "spec_k": 4,
+        "acceptance_rate": 0.875, "verify_steps": 10,
+        "draft_ms": 1.5,
+    }
+    rec = bench.serve_record(base)
+    # Spec rows bank under their own per-mode metric family: the
+    # --bank reduction reads only top-level value + side keys, so a
+    # spec row under the greedy family would set itl/ttft marks the
+    # next greedy row gets judged against.
+    assert rec["metric"] == "serve_spec_ngram_tokens_per_s_per_chip"
+    assert rec["acceptance_rate"] == 0.875  # top level: gate-visible
+    assert rec["serve"]["spec_mode"] == "ngram"
+    assert rec["serve"]["spec_k"] == 4
+    assert rec["serve"]["acceptance_rate"] == 0.875
+    assert rec["serve"]["draft_ms"] == 1.5
+    # Loadgen rows bank under their own spec metric family.
+    lg = bench.loadgen_record({
+        "scenario": "heavy_tail", "seed": 0, "shed": 0, "queued": 1,
+        "occupancy_mean": 0.5, "stall_events": 0,
+        "slo_violations": [], "recompiles": 0, "tenants": {},
+        "kv_layout": "paged", "kv_block_size": 16, "kv_blocks": 64,
+        "prefix_hit_rate": 0.1, "spec_mode": "ngram", "spec_k": 4,
+        "acceptance_rate": 0.9, "verify_steps": 5, "draft_ms": 0.0,
+        "ttft_ms_p50": 1.0, "ttft_ms_p95": 2.0, "ttft_ms_p99": 3.0,
+        "itl_ms_p50": 1.0, "itl_ms_p95": 2.0,
+    })
+    assert lg["metric"] == \
+        "loadgen_heavy_tail_paged_spec_ngram_ttft_ms_p95"
+    assert lg["loadgen"]["spec_mode"] == "ngram"
+    assert lg["loadgen"]["acceptance_rate"] == 0.9
+    assert lg["acceptance_rate"] == 0.9  # top level: gate-visible
 
 
 def test_serve_record_carries_kv_layout(bench):

@@ -456,3 +456,62 @@ class TestPagedKVTerm:
         assert out["kv_blocks"] == 4096
         assert out["kv_block_bytes"] > 0
         assert rc in (0, 1)
+
+
+class TestSpecDraftTerm:
+    """The speculative-draft HBM budget (serve/spec.py via
+    --spec-draft): draft params + the mirrored paged pool must land
+    in the total, and an oversized draft must flip the verdict --
+    fail the fit report, not OOM at serving bring-up."""
+
+    def test_draft_terms_add_to_total(self, full_7b):
+        from tpu_hpc.serve.spec import default_draft_config
+
+        draft = default_draft_config(full_7b.cfg)
+        r = fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False,
+            kv_blocks=8192, kv_block_size=16, draft_cfg=draft,
+        )
+        base = fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False,
+            kv_blocks=8192, kv_block_size=16,
+        )
+        assert r.draft_n_params == llama2.count_params(draft)
+        # fp32 serving params, TP-sharded over model=8.
+        assert r.draft_param_bytes == -(-r.draft_n_params * 4 // 8)
+        assert r.draft_kv_block_bytes == \
+            fit.kv_paged_bytes(draft, 8192, 16) // 8
+        assert r.total_bytes == (
+            base.total_bytes + r.draft_param_bytes
+            + r.draft_kv_block_bytes
+        )
+        md = fit.to_markdown(r)
+        assert "spec draft params" in md
+        assert "spec draft KV pool (mirrored 8192 pages)" in md
+
+    def test_oversized_draft_fails_the_verdict(self, full_7b):
+        # A "draft" as big as the target on an HBM budget that held
+        # exactly the target: must flip to DOES NOT FIT.
+        fits_alone = fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False,
+            kv_blocks=4096, kv_block_size=16,
+        )
+        gib = fits_alone.total_bytes / (1 << 30) + 0.5
+        r = fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, hbm_gib=gib, do_compile=False,
+            kv_blocks=4096, kv_block_size=16,
+            draft_cfg=full_7b.cfg,
+        )
+        assert not r.fits
+
+    def test_draft_requires_paged_pool(self, full_7b):
+        with pytest.raises(ValueError, match="kv_blocks"):
+            fit.analyze(
+                cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+                seq_len=4096, do_compile=False,
+                draft_cfg=full_7b.cfg,
+            )

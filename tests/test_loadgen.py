@@ -132,6 +132,19 @@ class TestScenarios:
         seen = {r.tenant for r in sc.requests}
         assert seen == names
 
+    def test_decode_heavy_is_decode_bound(self):
+        """The chat-style mix: prompts at most a quarter of the
+        budget, generation budgets in the top quarter -- decode work
+        dominates by construction (the speculative-decoding
+        acceptance scenario)."""
+        sc = _scenario("decode_heavy")
+        for r in sc.requests:
+            assert len(r.prompt) <= max(2, MAX_PROMPT // 4)
+            assert r.max_new_tokens >= max(2, (3 * MAX_NEW) // 4)
+        total_prompt = sum(len(r.prompt) for r in sc.requests)
+        total_new = sum(r.max_new_tokens for r in sc.requests)
+        assert total_new > total_prompt
+
     def test_heavy_tail_has_a_tail(self):
         import numpy as np
 

@@ -47,6 +47,43 @@ class TestCompare:
         assert not lower_is_better("serve.prefix_hit_rate")
         assert not lower_is_better("serve.kv_blocks_free_min")
         assert lower_is_better("serve.block_stalls")
+        # Speculative decoding (serve/spec.py): acceptance_rate and
+        # accepted regress by DROPPING; draft_ms and rejected by
+        # RISING -- the --bank gate judges speculative rows instead
+        # of skipping them.
+        assert not lower_is_better("serve.acceptance_rate")
+        assert not lower_is_better("loadgen_heavy_tail_accepted")
+        assert lower_is_better("serve.draft_ms")
+        assert lower_is_better("loadgen_heavy_tail_rejected")
+        # Composite banked names take their direction from the LEAF:
+        # an acceptance side key must not inherit the headline
+        # latency metric's "ttft" token.
+        assert not lower_is_better(
+            "loadgen_x_paged_spec_ngram_ttft_ms_p95.acceptance_rate"
+        )
+        assert lower_is_better(
+            "serve_spec_ngram_tokens_per_s_per_chip.itl_ms_p50"
+        )
+
+    def test_spec_config_fields_not_compared(self):
+        """spec_k is config; drafted/accepted/rejected/verify_steps
+        are raw workload-scaled counts (an IMPROVED acceptance rate
+        means FEWER verify steps) -- the gate judges acceptance_rate
+        and draft_ms only."""
+        from tpu_hpc.obs.regress import report_metrics
+
+        flat = report_metrics({
+            "serve": {
+                "spec_mode": "ngram", "spec_k": 4,
+                "acceptance_rate": 0.9, "draft_ms": 2.5,
+                "drafted": 100, "accepted": 90, "rejected": 10,
+                "verify_steps": 30, "requests": 8,
+            },
+        })
+        assert flat == {
+            "serve.acceptance_rate": 0.9,
+            "serve.draft_ms": 2.5,
+        }
 
     def test_paged_config_fields_not_compared(self):
         """kv_block_size/kv_blocks (+free_min) are pool CONFIG, and
@@ -316,6 +353,27 @@ class TestBank:
         bad.write_text(json.dumps({"whatever": 1}))
         assert bank_main([str(bad), "-o", str(tmp_path / "o")]) == 2
         capsys.readouterr()
+
+    def test_bank_lifts_acceptance_rate_side_key(self):
+        """acceptance_rate is a banked side key: a speculative row's
+        mechanism metric rides the gate next to its latency
+        quantiles (higher-is-better), so a stale draft fails --bank
+        even when ttft/itl still ride within tolerance."""
+        from tpu_hpc.obs.regress import bank_metrics, compare
+
+        def row(acc):
+            return {
+                "event": "bench",
+                "metric": "loadgen_x_paged_spec_ngram_ttft_ms_p95",
+                "value": 100.0, "acceptance_rate": acc,
+            }
+
+        base = bank_metrics([row(0.9)])
+        key = "loadgen_x_paged_spec_ngram_ttft_ms_p95.acceptance_rate"
+        assert base[key] == 0.9
+        violations, _ = compare(base, bank_metrics([row(0.5)]))
+        assert [v["metric"] for v in violations] == [key]
+        assert compare(base, bank_metrics([row(0.95)]))[0] == []
 
     def test_bank_metrics_keep_high_water_mark(self):
         records = [

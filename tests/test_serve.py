@@ -315,6 +315,82 @@ class TestPagedMode:
         assert warm_paged.compile_count == warmed
 
 
+class TestSpecOracle:
+    """The token-exact oracle holds in SPECULATIVE mode
+    (serve/spec.py): the same greedy_oracle that pins the slab and
+    paged engines pins draft-model and prompt-lookup speculation --
+    prefix-hit and miss, with chunked prefill on, accept and reject
+    paths both exercised. Speculation must provably change latency
+    only, never the greedy token stream. The full suite (seeded
+    sampling, compile pins, page accounting) lives in
+    tests/test_spec.py; this section keeps the oracle contract in
+    the file that owns it."""
+
+    def _spec_engine(self, tiny_params, serve_mesh, mode, draft=None):
+        from tpu_hpc.serve import (
+            PagedConfig,
+            PagedEngine,
+            SpecConfig,
+            attach_spec,
+        )
+
+        engine = PagedEngine(
+            tiny_params, TINY,
+            ServeConfig(slots=4, max_seq_len=48,
+                        prefill_buckets=(8, 16)),
+            serve_mesh,
+            PagedConfig(block_size=4, num_blocks=48, prefill_chunk=8),
+        )
+        attach_spec(
+            engine, SpecConfig(mode=mode, k=3),
+            draft_params=draft[0] if draft else None,
+            draft_cfg=draft[1] if draft else None,
+        )
+        engine.warmup()
+        return engine
+
+    @pytest.mark.parametrize("mode", ("ngram", "draft"))
+    def test_spec_greedy_token_exact_hit_and_miss(
+        self, tiny_params, serve_mesh, greedy_oracle, mode
+    ):
+        import dataclasses
+
+        draft = None
+        if mode == "draft":
+            dcfg = dataclasses.replace(TINY, n_layers=1)
+            draft = (
+                llama2.init_llama(jax.random.key(9), dcfg), dcfg
+            )
+        engine = self._spec_engine(
+            tiny_params, serve_mesh, mode, draft=draft
+        )
+        rng = np.random.default_rng(30)
+        prompt = rng.integers(0, TINY.vocab_size, size=13).tolist()
+        want = greedy_oracle(tiny_params, prompt, 8)
+        cold = ContinuousBatcher(engine).run(
+            [Request(rid="cold", prompt=prompt, max_new_tokens=8)]
+        )["cold"]
+        warm = ContinuousBatcher(engine).run(
+            [Request(rid="warm", prompt=prompt, max_new_tokens=8)]
+        )["warm"]
+        assert cold == want
+        assert warm == want  # through a prefix hit
+        assert engine.paged_stats["prefix_hits"] >= 1
+        assert engine.spec.stats["verify_steps"] > 0
+
+    def test_disagg_cannot_consume_spec(self, tiny_params, serve_mesh):
+        """The verify program is a single-mesh paged program; a
+        disagg engine wearing a spec label would silently decode
+        greedy -- attach must refuse (the CLI guards mirror this)."""
+        from tpu_hpc.serve import SpecConfig, attach_spec
+        from tpu_hpc.serve.disagg import DisaggEngine
+
+        with pytest.raises(ValueError, match="paged"):
+            attach_spec(
+                object.__new__(DisaggEngine), SpecConfig(mode="ngram")
+            )
+
+
 class TestServingWeights:
     def test_trainer_checkpoint_restores_into_serving_layout(
         self, tiny_params, serve_mesh, tmp_path

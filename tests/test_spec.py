@@ -1,0 +1,616 @@
+"""Speculative decoding + seeded sampling (serve/spec.py).
+
+Four invariant families:
+  * **greedy oracle** -- the speculative greedy stream is
+    byte-identical to the non-speculative greedy stream (which
+    tests/test_serve.py pins against the no-cache forward): draft and
+    n-gram modes, prefix hit and miss, chunked prefill, accept and
+    reject paths. Speculation changes latency only, never tokens.
+  * **seeded sampling** -- same (request seed, temperature, top_p)
+    replays the same tokens regardless of batch composition or slot
+    placement; different seeds diverge; greedy co-residents of a
+    sampled batch stay oracle-exact.
+  * **compile discipline** -- accept/reject churn (and the draft
+    engine) adds ZERO executables after warmup
+    (``compile_count_total`` is the pinned counter).
+  * **page accounting** -- speculative writes stay inside the
+    admission-time reservation: the allocator invariant holds after
+    churn and BOTH pools drain back to idle.
+
+All on the 8-device simulated mesh, fp32 compute so byte-identical
+means exact.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_hpc.models import llama2
+from tpu_hpc.runtime import MeshSpec, build_mesh
+from tpu_hpc.serve import (
+    ContinuousBatcher,
+    PagedConfig,
+    PagedEngine,
+    Request,
+    ServeConfig,
+    SpecConfig,
+    attach_spec,
+    derive_request_seed,
+)
+from tpu_hpc.serve.spec import (
+    NgramIndex, ngram_propose, sampling_probs,
+)
+
+TINY = llama2.LlamaConfig(
+    dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+    multiple_of=16, max_seq_len=256, dtype=jnp.float32,
+)
+DRAFT = llama2.LlamaConfig(
+    dim=64, n_layers=1, n_heads=4, n_kv_heads=2, vocab_size=128,
+    multiple_of=16, max_seq_len=256, dtype=jnp.float32,
+)
+K = 3
+
+
+@pytest.fixture(scope="module")
+def spec_mesh(devices):
+    return build_mesh(MeshSpec(axes={"data": 4, "model": 2}))
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama2.init_llama(jax.random.key(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return llama2.init_llama(jax.random.key(7), DRAFT)
+
+
+def make_engine(params, mesh, spec=None, draft=None):
+    engine = PagedEngine(
+        params, TINY,
+        ServeConfig(slots=4, max_seq_len=48, prefill_buckets=(8, 16)),
+        mesh,
+        PagedConfig(block_size=4, num_blocks=48, prefill_chunk=8),
+    )
+    if spec is not None:
+        attach_spec(
+            engine, spec,
+            draft_params=draft[0] if draft else None,
+            draft_cfg=draft[1] if draft else None,
+        )
+    engine.warmup()
+    return engine
+
+
+@pytest.fixture(scope="module")
+def baseline_streams(tiny_params, spec_mesh):
+    """The non-speculative greedy streams every spec mode must
+    reproduce byte-identically (itself pinned against the no-cache
+    oracle in tests/test_serve.py)."""
+    engine = make_engine(tiny_params, spec_mesh)
+    return ContinuousBatcher(engine).run(_mix())
+
+
+def _mix():
+    rng = np.random.default_rng(11)
+    shapes = [(11, 6), (5, 8), (13, 3), (7, 5), (9, 7), (4, 2)]
+    return [
+        Request(
+            rid=f"r{i}",
+            prompt=rng.integers(
+                0, TINY.vocab_size, size=plen
+            ).tolist(),
+            max_new_tokens=mnew,
+        )
+        for i, (plen, mnew) in enumerate(shapes)
+    ]
+
+
+class TestNgramProposer:
+    def test_matches_most_recent_occurrence(self):
+        h = [1, 2, 3, 9, 9, 2, 3, 7, 7, 2, 3]
+        # Trailing 2-gram (2, 3): most recent earlier occurrence at
+        # index 5 -> propose what followed it.
+        assert ngram_propose(h, 3, max_n=2) == [7, 7, 2]
+
+    def test_falls_back_to_shorter_grams(self):
+        h = [5, 6, 1, 2, 6]
+        # No earlier (2, 6) bigram; unigram 6 at index 1 -> [1, 2, 6].
+        assert ngram_propose(h, 4, max_n=2) == [1, 2, 6]
+
+    def test_no_match_is_empty(self):
+        assert ngram_propose([1, 2, 3, 4], 4) == []
+        assert ngram_propose([7], 4) == []
+        assert ngram_propose([], 4) == []
+
+    def test_proposal_capped_at_k(self):
+        h = [1, 2, 3, 4, 5, 1, 2]
+        assert ngram_propose(h, 2, max_n=2) == [3, 4]
+
+    def test_index_matches_rescan_incrementally(self):
+        # The batcher's incremental NgramIndex must propose
+        # byte-identically to the reference rescan at EVERY prefix of
+        # a random history (repetitive small alphabet so bigram and
+        # unigram matches, fallbacks, and no-match all occur), for
+        # every (k, max_n) the config space allows.
+        rng = np.random.default_rng(11)
+        for max_n in (1, 2, 3):
+            for k in (1, 4):
+                toks = rng.integers(0, 5, size=200).tolist()
+                index = NgramIndex(max_n=max_n)
+                for i, tok in enumerate(toks):
+                    index.append(tok)
+                    h = toks[:i + 1]
+                    assert index.propose(k) == ngram_propose(
+                        h, k, max_n=max_n
+                    ), (max_n, k, i)
+
+    def test_index_seeded_from_history(self):
+        h = [1, 2, 3, 9, 9, 2, 3, 7, 7, 2, 3]
+        assert NgramIndex(h).propose(3) == ngram_propose(
+            h, 3, max_n=2
+        )
+        assert NgramIndex([]).propose(3) == []
+        assert NgramIndex([7]).propose(3) == []
+
+
+class TestSamplingHead:
+    def test_greedy_is_exact_onehot_argmax(self):
+        logits = jnp.asarray(
+            np.random.default_rng(0).normal(size=(2, 3, 16)),
+            jnp.float32,
+        )
+        p = sampling_probs(
+            logits, jnp.zeros(2), jnp.ones(2)
+        )
+        want = jax.nn.one_hot(
+            jnp.argmax(logits, -1), 16, dtype=jnp.float32
+        )
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(want))
+
+    def test_top_p_filters_the_tail(self):
+        logits = jnp.log(jnp.asarray(
+            [[[0.5, 0.3, 0.15, 0.05]]], jnp.float32
+        ))
+        p = sampling_probs(
+            logits, jnp.ones(1), jnp.asarray([0.7], jnp.float32)
+        )[0, 0]
+        # 0.5 + 0.3 crosses 0.7 -> only the top two survive.
+        assert float(p[2]) == 0.0 and float(p[3]) == 0.0
+        assert float(p[0]) == pytest.approx(0.625, abs=1e-5)
+        assert float(jnp.sum(p)) == pytest.approx(1.0, abs=1e-5)
+
+    def test_top_p_one_keeps_everything(self):
+        logits = jnp.asarray(
+            np.random.default_rng(1).normal(size=(1, 1, 8)),
+            jnp.float32,
+        )
+        p = sampling_probs(logits, jnp.ones(1), jnp.ones(1))[0, 0]
+        soft = jax.nn.softmax(logits[0, 0])
+        np.testing.assert_allclose(
+            np.asarray(p), np.asarray(soft), rtol=1e-5
+        )
+
+
+class TestGreedyOracle:
+    """Speculation must change latency only -- never the greedy
+    stream. Every mode, against the same churned request mix the
+    non-speculative engine produced."""
+
+    def test_ngram_stream_byte_identical(
+        self, tiny_params, spec_mesh, baseline_streams
+    ):
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="ngram", k=K)
+        )
+        got = ContinuousBatcher(engine).run(_mix())
+        assert got == baseline_streams
+
+    def test_draft_stream_byte_identical(
+        self, tiny_params, draft_params, spec_mesh, baseline_streams
+    ):
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="draft", k=K),
+            draft=(draft_params, DRAFT),
+        )
+        got = ContinuousBatcher(engine).run(_mix())
+        assert got == baseline_streams
+        # An independent random draft rarely guesses the argmax:
+        # the reject path demonstrably ran.
+        assert engine.spec.stats["rejected"] > 0
+
+    def test_self_draft_accepts_everything(
+        self, tiny_params, spec_mesh, baseline_streams
+    ):
+        """draft == target: every draft must pass verification (q and
+        p are the same one-hot), the stream stays byte-identical, and
+        the accept path demonstrably ran."""
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="draft", k=K),
+            draft=(tiny_params, TINY),
+        )
+        got = ContinuousBatcher(engine).run(_mix())
+        assert got == baseline_streams
+        s = engine.spec.stats
+        assert s["drafted"] > 0
+        assert s["accepted"] == s["drafted"]
+
+    def test_prefix_hit_and_long_stream_acceptance(
+        self, tiny_params, spec_mesh
+    ):
+        """Warm-trie admissions (prefix hit) keep the oracle, and a
+        long greedy continuation (which cycles) gives prompt lookup
+        real acceptance -- the mechanism behind the banked ITL win."""
+        rng = np.random.default_rng(21)
+        prompt = rng.integers(0, TINY.vocab_size, size=13).tolist()
+        base = make_engine(tiny_params, spec_mesh)
+        want = ContinuousBatcher(base).run(
+            [Request(rid="w", prompt=prompt, max_new_tokens=30)]
+        )["w"]
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="ngram", k=K)
+        )
+        cold = ContinuousBatcher(engine).run(
+            [Request(rid="cold", prompt=prompt, max_new_tokens=30)]
+        )["cold"]
+        warm = ContinuousBatcher(engine).run(
+            [Request(rid="warm", prompt=prompt, max_new_tokens=30)]
+        )["warm"]
+        assert cold == want
+        assert warm == want
+        assert engine.paged_stats["prefix_hits"] >= 1
+        s = engine.spec.stats
+        assert s["accepted"] > 0, "cycling stream should accept"
+
+    def test_eos_mid_acceptance_truncates_exactly(
+        self, tiny_params, spec_mesh
+    ):
+        """An EOS inside an accepted run must cut the stream exactly
+        where non-speculative decode stops (inclusive), discarding
+        the speculative tail."""
+        prompt = [3, 1, 4, 1, 5]
+        base = make_engine(tiny_params, spec_mesh)
+        free = ContinuousBatcher(base).run(
+            [Request(rid="f", prompt=prompt, max_new_tokens=24)]
+        )["f"]
+        # Pick an EOS from the middle of the free-run stream.
+        eos = free[len(free) // 2]
+        cut = free[:free.index(eos) + 1]
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="ngram", k=K)
+        )
+        got = ContinuousBatcher(engine).run([
+            Request(rid="e", prompt=prompt, max_new_tokens=24,
+                    eos_id=eos)
+        ])["e"]
+        assert got == cut
+
+    def test_max_new_budget_exact(self, tiny_params, spec_mesh):
+        """Emission caps: every request generates EXACTLY max_new
+        tokens (n_valid = min(k, remaining - 1) keeps the last verify
+        step from overshooting), including max_new 1 and 2."""
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="ngram", k=K)
+        )
+        rng = np.random.default_rng(5)
+        reqs = [
+            Request(
+                rid=f"b{i}",
+                prompt=rng.integers(0, 128, size=6 + i).tolist(),
+                max_new_tokens=m,
+            )
+            for i, m in enumerate((1, 2, 3, 7))
+        ]
+        got = ContinuousBatcher(engine).run(reqs)
+        for r in reqs:
+            assert len(got[r.rid]) == r.max_new_tokens, r.rid
+
+
+class TestSeededSampling:
+    def _sampled(self, rid="x", seed=42, temperature=0.8, top_p=0.9,
+                 max_new=8):
+        rng = np.random.default_rng(33)
+        return Request(
+            rid=rid, prompt=rng.integers(0, 128, size=9).tolist(),
+            max_new_tokens=max_new, temperature=temperature,
+            top_p=top_p, seed=seed,
+        )
+
+    def _others(self, n=3):
+        rng = np.random.default_rng(34)
+        return [
+            Request(
+                rid=f"o{i}",
+                prompt=rng.integers(0, 128, size=5 + 2 * i).tolist(),
+                max_new_tokens=5, temperature=0.5, top_p=0.95, seed=i,
+            )
+            for i in range(n)
+        ]
+
+    def test_batch_composition_invariance(
+        self, tiny_params, spec_mesh
+    ):
+        """Same (seed, temperature, top_p) -> same tokens whether the
+        request runs alone, with company, or admitted last (different
+        slot). The key folds in (request seed, position) only."""
+        solo = ContinuousBatcher(
+            make_engine(tiny_params, spec_mesh,
+                        SpecConfig(mode="ngram", k=K))
+        ).run([self._sampled()])["x"]
+        batched = ContinuousBatcher(
+            make_engine(tiny_params, spec_mesh,
+                        SpecConfig(mode="ngram", k=K))
+        ).run(self._others() + [self._sampled()])["x"]
+        assert solo == batched
+        # Replay: bit-identical run-to-run too.
+        again = ContinuousBatcher(
+            make_engine(tiny_params, spec_mesh,
+                        SpecConfig(mode="ngram", k=K))
+        ).run([self._sampled()])["x"]
+        assert again == solo
+
+    def test_seed_changes_the_stream(self, tiny_params, spec_mesh):
+        a = ContinuousBatcher(
+            make_engine(tiny_params, spec_mesh,
+                        SpecConfig(mode="ngram", k=K))
+        ).run([self._sampled(seed=42)])["x"]
+        b = ContinuousBatcher(
+            make_engine(tiny_params, spec_mesh,
+                        SpecConfig(mode="ngram", k=K))
+        ).run([self._sampled(seed=43)])["x"]
+        assert a != b
+
+    def test_greedy_coresident_stays_oracle_exact(
+        self, tiny_params, spec_mesh, baseline_streams
+    ):
+        """Greedy requests sharing a batch with sampled ones must
+        still match the non-speculative greedy streams exactly."""
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="ngram", k=K)
+        )
+        got = ContinuousBatcher(engine).run(
+            _mix() + [self._sampled(rid="s")]
+        )
+        for r in _mix():
+            assert got[r.rid] == baseline_streams[r.rid], r.rid
+
+    def test_draft_mode_sampling_deterministic(
+        self, tiny_params, draft_params, spec_mesh
+    ):
+        """Rejection sampling through a draft model is deterministic
+        per seed too (draft draw, acceptance u, and residual draw all
+        fold the same per-request streams)."""
+        runs = [
+            ContinuousBatcher(
+                make_engine(
+                    tiny_params, spec_mesh,
+                    SpecConfig(mode="draft", k=K),
+                    draft=(draft_params, DRAFT),
+                )
+            ).run(self._others() + [self._sampled()])["x"]
+            for _ in range(2)
+        ]
+        assert runs[0] == runs[1]
+
+    def test_sampling_requires_spec_engine(
+        self, tiny_params, spec_mesh
+    ):
+        engine = make_engine(tiny_params, spec_mesh)
+        batcher = ContinuousBatcher(engine)
+        with pytest.raises(ValueError, match="speculative"):
+            batcher.submit(self._sampled())
+
+    def test_derive_request_seed_stable(self):
+        assert derive_request_seed("r1") == derive_request_seed("r1")
+        assert derive_request_seed("r1") != derive_request_seed("r2")
+        assert derive_request_seed("r1", seed=5) == 5
+
+
+class TestCompileDiscipline:
+    def test_zero_recompiles_across_accept_reject_churn(
+        self, tiny_params, draft_params, spec_mesh
+    ):
+        """The acceptance guard: accept/reject churn, sampled AND
+        greedy requests, prefix hits, chunked prefill -- ZERO new
+        executables on either engine after warmup."""
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="draft", k=K),
+            draft=(draft_params, DRAFT),
+        )
+        warmed = engine.compile_count_total
+        rng = np.random.default_rng(3)
+        reqs = [
+            Request(
+                rid=f"m{i}",
+                prompt=rng.integers(
+                    0, TINY.vocab_size, size=4 + (5 * i) % 13
+                ).tolist(),
+                max_new_tokens=1 + i % 5,
+                temperature=0.7 if i % 2 else 0.0,
+                seed=i,
+            )
+            for i in range(9)
+        ]
+        ContinuousBatcher(engine).run(reqs)
+        assert engine.compile_count_total == warmed
+
+    def test_spec_engine_compiles_its_own_program_set(
+        self, tiny_params, spec_mesh
+    ):
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="ngram", k=K)
+        )
+        # 2 spec prefill buckets + verify + copy_block; no draft side.
+        assert engine.compile_count_total == 4
+
+    def test_spec_validation(self, tiny_params, spec_mesh):
+        from tpu_hpc.serve.engine import Engine
+
+        with pytest.raises(ValueError, match="unknown spec mode"):
+            SpecConfig(mode="medusa")
+        with pytest.raises(ValueError, match="k must be >= 1"):
+            SpecConfig(k=0)
+        slab = Engine(
+            tiny_params, TINY,
+            ServeConfig(slots=2, max_seq_len=48,
+                        prefill_buckets=(16,)),
+            spec_mesh,
+        )
+        with pytest.raises(ValueError, match="paged"):
+            attach_spec(slab, SpecConfig(mode="ngram"))
+        paged = PagedEngine(
+            tiny_params, TINY,
+            ServeConfig(slots=2, max_seq_len=48,
+                        prefill_buckets=(16,)),
+            spec_mesh,
+            PagedConfig(block_size=4, num_blocks=32),
+        )
+        with pytest.raises(ValueError, match="draft_params"):
+            attach_spec(paged, SpecConfig(mode="draft"))
+        with pytest.raises(ValueError, match="largest prefill"):
+            attach_spec(paged, SpecConfig(mode="ngram", k=17))
+        draft_bad_vocab = llama2.LlamaConfig(
+            dim=64, n_layers=1, n_heads=4, n_kv_heads=2,
+            vocab_size=64, multiple_of=16, max_seq_len=256,
+            dtype=jnp.float32,
+        )
+        with pytest.raises(ValueError, match="vocab"):
+            attach_spec(
+                paged, SpecConfig(mode="draft"),
+                draft_params=llama2.init_llama(
+                    jax.random.key(1), draft_bad_vocab
+                ),
+                draft_cfg=draft_bad_vocab,
+            )
+        # Attach-after-warmup would leave the spec programs to
+        # lazy-compile mid-traffic: fail fast instead.
+        paged.warmup()
+        with pytest.raises(ValueError, match="BEFORE engine.warmup"):
+            attach_spec(paged, SpecConfig(mode="ngram"))
+
+
+class TestPageAccounting:
+    def test_pools_drain_to_idle_and_invariants_hold(
+        self, tiny_params, draft_params, spec_mesh
+    ):
+        """Speculative writes stay inside the admission reservation:
+        after a churned drain the allocator identity holds on BOTH
+        pools and every non-trie page is back on the free list."""
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="draft", k=K),
+            draft=(draft_params, DRAFT),
+        )
+        ContinuousBatcher(engine).run(_mix())
+        engine.allocator.check_invariant()
+        engine.spec.draft.allocator.check_invariant()
+        # No live requests -> every held page belongs to the trie.
+        assert not engine._slot_state
+        assert not engine.spec.draft._slot_state
+
+    def test_request_seed_rides_slot_state(
+        self, tiny_params, spec_mesh
+    ):
+        engine = make_engine(
+            tiny_params, spec_mesh, SpecConfig(mode="ngram", k=K)
+        )
+        engine.admit(0, [1, 2, 3, 4, 5], 4, sampling=(99, 0.5, 0.9))
+        st = engine.slot_state(0)
+        assert (st.seed, st.temperature, st.top_p) == (99, 0.5, 0.9)
+        engine.release(0)
+
+
+class TestServerCLI:
+    def test_replay_with_spec_reports_summary(self, capsys):
+        from tpu_hpc.serve import server
+        import json
+
+        rc = server.main([
+            "--requests", "3", "--max-new", "6", "--slots", "2",
+            "--buckets", "8", "--prompt-lens", "3,6", "--vocab", "64",
+            "--paged", "--kv-block-size", "4",
+            "--spec", "ngram", "--spec-k", "2",
+        ])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.splitlines()[-1])
+        assert summary["spec_mode"] == "ngram"
+        assert summary["spec_k"] == 2
+        assert summary["recompiles"] == 0
+        assert "acceptance_rate" in summary
+        assert summary["batcher"]["verify_steps"] > 0
+
+    def test_spec_flags_guarded(self):
+        from tpu_hpc.serve import server
+
+        # --spec rides --paged.
+        with pytest.raises(SystemExit):
+            server.main(["--spec", "ngram"])
+        # --spec + --disagg is a parse error.
+        with pytest.raises(SystemExit):
+            server.main(["--paged", "--spec", "ngram", "--disagg"])
+        # Spec knobs require --spec.
+        with pytest.raises(SystemExit):
+            server.main(["--paged", "--spec-k", "4"])
+        with pytest.raises(SystemExit):
+            server.main(["--paged", "--temperature", "0.8"])
+        # Draft knobs require --spec draft specifically.
+        with pytest.raises(SystemExit):
+            server.main(["--paged", "--spec", "ngram",
+                         "--spec-draft-ckpt", "/tmp/x"])
+        # --top-p rides --temperature.
+        with pytest.raises(SystemExit):
+            server.main(["--paged", "--spec", "ngram",
+                         "--top-p", "0.9"])
+        # --temperature is replay-only.
+        with pytest.raises(SystemExit):
+            server.main(["--paged", "--spec", "ngram",
+                         "--loadgen", "steady",
+                         "--temperature", "0.5"])
+        # Out-of-range sampling knobs are parse errors too -- not a
+        # post-bring-up Request.__post_init__ traceback.
+        with pytest.raises(SystemExit):
+            server.main(["--paged", "--spec", "ngram",
+                         "--temperature", "-0.5"])
+        with pytest.raises(SystemExit):
+            server.main(["--paged", "--spec", "ngram",
+                         "--temperature", "0.7", "--top-p", "1.5"])
+
+    def test_loadgen_with_spec_is_deterministic(self):
+        """The virtual-clock summary stays byte-identical per
+        (scenario, seed) with speculation on -- and speculation
+        improves ITL p50 vs the plain paged run at the same shape
+        (the banked-row mechanism, in miniature)."""
+        from tpu_hpc.serve import server
+
+        def run(spec):
+            args = [
+                "--loadgen", "steady", "--requests", "8",
+                "--max-new", "24", "--slots", "2",
+                "--buckets", "16,32", "--vocab", "64",
+                "--paged",
+            ]
+            if spec:
+                args += ["--spec", "ngram"]
+            from tpu_hpc.serve.engine import ServeConfig  # noqa: F401
+            import io
+            import contextlib
+            import json
+
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = server.main(args)
+            assert rc == 0
+            return json.loads(buf.getvalue().splitlines()[-1])
+
+        a = run(spec=True)
+        b = run(spec=True)
+        for key in ("ttft_ms_p50", "ttft_ms_p95", "itl_ms_p50",
+                    "itl_ms_p95", "tokens", "acceptance_rate",
+                    "draft_ms"):
+            assert a[key] == b[key], key
+        assert a["recompiles"] == 0
+        plain = run(spec=False)
+        assert a["itl_ms_p50"] <= plain["itl_ms_p50"]
+        assert a["spec_mode"] == "ngram"

@@ -153,6 +153,13 @@ class FitResult:
     kv_block_bytes: int = 0      # per chip, PAGED decode KV pool
     kv_blocks: int = 0           # physical pages the paged term assumes
     kv_block_size: int = 0       # tokens per page
+    # Speculative-decode draft model (serve/spec.py): its params live
+    # on the same chips and its KV pool mirrors the target's pages --
+    # a draft that does not fit must fail THIS report, not OOM at
+    # serving bring-up.
+    draft_n_params: int = 0
+    draft_param_bytes: int = 0   # per chip, serving-layout fp32
+    draft_kv_block_bytes: int = 0  # per chip, mirrored paged pool
 
     @property
     def static_bytes(self) -> int:
@@ -167,6 +174,7 @@ class FitResult:
             else self.kv_cache_bytes
         return (
             self.static_bytes + sum(self.act_bytes.values()) + kv
+            + self.draft_param_bytes + self.draft_kv_block_bytes
         )
 
     @property
@@ -544,6 +552,7 @@ def analyze(
     kv_cache_dtype: str = "bfloat16",
     kv_blocks: int = 0,
     kv_block_size: int = 16,
+    draft_cfg: Optional[llama2.LlamaConfig] = None,
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
 
@@ -631,6 +640,40 @@ def analyze(
             denom *= tp_size
         kv_block_bytes_chip = -(-full // denom)
 
+    # Speculative-draft term (``draft_cfg``, serve/spec.py): the
+    # draft's serving params (fp32, TP-sharded over the model axis
+    # where its heads divide, else replicated -- serve/weights.py's
+    # layout, approximated at the whole-tree level) plus its mirrored
+    # paged KV pool (same page COUNT as the target's -- the runner
+    # mirrors admissions one-for-one -- but smaller pages: fewer
+    # layers/heads).
+    draft_params_chip = 0
+    draft_kv_chip = 0
+    draft_n_params = 0
+    if draft_cfg is not None:
+        if not kv_blocks:
+            raise ValueError(
+                "a speculative draft budget needs the paged pool "
+                "term too (kv_blocks > 0): the draft's KV pool "
+                "mirrors the target's pages"
+            )
+        draft_n_params = llama2.count_params(draft_cfg)
+        tp_div = (
+            tp_size
+            if layout == "tp" and tp_size > 1
+            and draft_cfg.n_heads % tp_size == 0 else 1
+        )
+        draft_params_chip = -(-draft_n_params * 4 // tp_div)
+        full = kv_paged_bytes(
+            draft_cfg, kv_blocks, kv_block_size, kv_cache_dtype
+        )
+        kv_div = (
+            tp_size
+            if layout == "tp" and tp_size > 1
+            and draft_cfg.kv_heads % tp_size == 0 else 1
+        )
+        draft_kv_chip = -(-full // kv_div)
+
     if layout == "pp":
         # The stage-shard byte accounting mirrors pp.stage_pspecs
         # (params stage-local, replicated over data -- the PP x DP
@@ -662,6 +705,9 @@ def analyze(
             kv_block_bytes=kv_block_bytes_chip,
             kv_blocks=kv_blocks,
             kv_block_size=kv_block_size if kv_blocks else 0,
+            draft_n_params=draft_n_params,
+            draft_param_bytes=draft_params_chip,
+            draft_kv_block_bytes=draft_kv_chip,
         )
         result.compiler_options = dict(compiler_options or {})
         if not do_compile:
@@ -728,6 +774,9 @@ def analyze(
         kv_block_bytes=kv_block_bytes_chip,
         kv_blocks=kv_blocks,
         kv_block_size=kv_block_size if kv_blocks else 0,
+        draft_n_params=draft_n_params,
+        draft_param_bytes=draft_params_chip,
+        draft_kv_block_bytes=draft_kv_chip,
     )
     if attn not in ("xla", "flash"):
         raise ValueError(f"unknown attn {attn!r} (xla|flash)")
@@ -891,6 +940,20 @@ def to_markdown(r: FitResult) -> str:
             f"{r.kv_block_size} tok) | "
             f"{r.kv_block_bytes:,} | {r.kv_block_bytes/GIB:.2f} |"
         )
+    if r.draft_param_bytes:
+        # The speculative-draft budget (serve/spec.py): params + the
+        # mirrored paged pool. Landing here means a too-big draft
+        # flips the verdict below to DOES NOT FIT -- the whole point.
+        lines.append(
+            f"| spec draft params ({r.draft_n_params/1e9:.2f}B, "
+            f"fp32 serving layout) | {r.draft_param_bytes:,} | "
+            f"{r.draft_param_bytes/GIB:.2f} |"
+        )
+        lines.append(
+            f"| spec draft KV pool (mirrored {r.kv_blocks} pages) | "
+            f"{r.draft_kv_block_bytes:,} | "
+            f"{r.draft_kv_block_bytes/GIB:.2f} |"
+        )
     kv_live = r.kv_block_bytes if r.kv_blocks else r.kv_cache_bytes
     lines += [
         f"| **total** | **{r.total_bytes:,}** | "
@@ -904,6 +967,10 @@ def to_markdown(r: FitResult) -> str:
         + (
             f" + decode KV cache {kv_live/GIB:.2f} GiB"
             if kv_live else ""
+        )
+        + (
+            f" + spec draft {(r.draft_param_bytes + r.draft_kv_block_bytes)/GIB:.2f} GiB"
+            if r.draft_param_bytes else ""
         )
         + ").",
     ]
@@ -1190,6 +1257,16 @@ def main(argv=None) -> int:
     parser.add_argument("--kv-block-size", type=int, default=16,
                         help="tokens per page for --kv-blocks "
                         "(default 16)")
+    parser.add_argument("--spec-draft", type=str, default=None,
+                        choices=("half", *sorted(llama2.PRESETS)),
+                        help="budget a speculative-decode draft model "
+                        "(serve/spec.py) co-resident with this "
+                        "config: its fp32 serving params + a KV pool "
+                        "mirroring --kv-blocks. 'half' = the target "
+                        "at half depth (the dev default); a draft "
+                        "that does not fit fails this report instead "
+                        "of OOMing at serving bring-up (requires "
+                        "--kv-blocks)")
     parser.add_argument("--xla-opt", action="append", default=[],
                         metavar="KEY=VALUE",
                         help="extra XLA compiler option for the "
@@ -1239,6 +1316,22 @@ def main(argv=None) -> int:
     }
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
+    draft_cfg = None
+    if args.spec_draft is not None:
+        if not args.kv_blocks:
+            parser.error(
+                "--spec-draft needs --kv-blocks: the draft's KV pool "
+                "mirrors the target's paged pool"
+            )
+        if args.spec_draft == "half":
+            from tpu_hpc.serve.spec import default_draft_config
+
+            draft_cfg = default_draft_config(cfg)
+        else:
+            draft_cfg = dataclasses.replace(
+                llama2.PRESETS[args.spec_draft],
+                max_seq_len=args.seq_len,
+            )
     r = analyze(
         cfg=cfg, dp=args.dp, tp_size=args.pp or args.cp or args.tp,
         global_batch=args.global_batch, seq_len=args.seq_len,
@@ -1254,6 +1347,7 @@ def main(argv=None) -> int:
         kv_cache_dtype=args.kv_cache_dtype,
         kv_blocks=args.kv_blocks,
         kv_block_size=args.kv_block_size,
+        draft_cfg=draft_cfg,
     )
     md = to_markdown(r)
     if args.markdown:

@@ -101,6 +101,7 @@ class _CostModelEngine:
         decode_step_ms: float,
         prefill_ms_per_token: float,
         faults: Dict[str, float],
+        draft_cost_frac: float = 0.15,
     ):
         self._engine = engine
         self._clock = clock
@@ -108,6 +109,16 @@ class _CostModelEngine:
         self._prefill_s_per_token = (
             prefill_ms_per_token / 1e3 * faults["prefill_delay"]
         )
+        # Speculative cost model (serve/spec.py): one verify step
+        # charges ONE decode forward -- the whole premise is that a
+        # (k+1)-token forward is latency-bound like a 1-token one --
+        # plus, for draft-model speculation, k draft steps at
+        # ``draft_cost_frac`` of a target step each (a ~10x smaller
+        # draft is ~0.1-0.2x per step). Prompt-lookup drafting is
+        # host-side and charges nothing. The draft's prefill charges
+        # at the same fraction per forwarded token.
+        self._draft_frac = draft_cost_frac
+        self.draft_charged_s = 0.0
         # Cumulative prefill charge: the harness subtracts its
         # per-tick delta before feeding the stall detector -- an
         # admission tick is EXPECTED to be long (one 512-token bucket
@@ -122,6 +133,12 @@ class _CostModelEngine:
         # delegates; only the compute calls below charge time.
         return getattr(self._engine, name)
 
+    def _draft_forwarded(self) -> int:
+        spec = getattr(self._engine, "spec", None)
+        if spec is None or spec.draft is None:
+            return 0
+        return spec.draft.prefill_forwarded_total
+
     def prefill(self, idx: int, prompt: List[int]) -> int:
         out = self._engine.prefill(idx, prompt)
         bucket = self._engine.serve_cfg.bucket_for(len(prompt))
@@ -132,12 +149,18 @@ class _CostModelEngine:
 
     def prefill_step(self, idx: int):
         before = self._engine.prefill_forwarded_total
+        d_before = self._draft_forwarded()
         out = self._engine.prefill_step(idx)
         cost = self._prefill_s_per_token * (
             self._engine.prefill_forwarded_total - before
         )
-        self.prefill_charged_s += cost
-        self._clock.advance(cost)
+        draft_cost = (
+            self._prefill_s_per_token * self._draft_frac
+            * (self._draft_forwarded() - d_before)
+        )
+        self.draft_charged_s += draft_cost
+        self.prefill_charged_s += cost + draft_cost
+        self._clock.advance(cost + draft_cost)
         return out
 
     def decode(self, tokens, positions, active=None):
@@ -146,6 +169,17 @@ class _CostModelEngine:
         else:
             out = self._engine.decode(tokens, positions)
         self._clock.advance(self._decode_s)
+        return out
+
+    def spec_decode(self, *args, **kwargs):
+        out = self._engine.spec_decode(*args, **kwargs)
+        spec = self._engine.spec
+        cost = self._decode_s
+        if spec.draft is not None:
+            draft_cost = self._decode_s * self._draft_frac * spec.cfg.k
+            self.draft_charged_s += draft_cost
+            cost += draft_cost
+        self._clock.advance(cost)
         return out
 
 
@@ -421,6 +455,16 @@ class LoadHarness:
                 "itl_ms_p50": quantile(itls, 0.50),
                 "itl_ms_p95": quantile(itls, 0.95),
             }
+            st = self.batcher.spec_by_tenant.get(t.name)
+            if st is not None:
+                # Per-request-class acceptance evidence: the banked
+                # rows report acceptance per scenario AND per tenant.
+                entry["spec_drafted"] = st["drafted"]
+                entry["spec_accepted"] = st["accepted"]
+                entry["acceptance_rate"] = (
+                    st["accepted"] / st["drafted"]
+                    if st["drafted"] else 0.0
+                )
             if t.slo:
                 # entry[k], not .get(): TenantClass validated the SLO
                 # keys against SLO_METRICS, and a drift between that
@@ -443,6 +487,17 @@ class LoadHarness:
             summary.update(paged_summary())
         else:
             summary["kv_layout"] = "slab"
+        spec = getattr(self.engine, "spec", None)
+        if spec is not None:
+            spec_block = spec.spec_summary()
+            # The runner's draft_ms is WALL time -- machine noise a
+            # byte-identical virtual-clock summary must not carry.
+            # Substitute the cost model's modeled charge (a pure
+            # function of scenario, seed and the draft fraction).
+            spec_block["draft_ms"] = round(
+                self.engine.draft_charged_s * 1e3, 3
+            )
+            summary.update(spec_block)
         summary.update(
             scenario=self.scenario.name,
             seed=self.scenario.seed,

@@ -26,7 +26,13 @@ replay `python -m tpu_hpc.serve` ships:
 * ``shared_prefix``     multi-tenant with a common per-tenant system
                         prompt and heavy-tail suffixes -- the paged
                         engine's prefix-reuse acceptance scenario
-                        (serve/paging.py).
+                        (serve/paging.py);
+* ``decode_heavy``      chat-style short prompts with near-full
+                        generation budgets -- the decode-bound mix
+                        where ITL (not TTFT) is the product metric,
+                        and the speculative-decoding acceptance
+                        scenario (serve/spec.py): the prefill-bound
+                        mixes above cannot show a decode-side win.
 """
 from __future__ import annotations
 
@@ -397,6 +403,26 @@ def build_scenario(
             prefixes=prefixes,
         )
 
+    if name == "decode_heavy":
+        # Chat-style decode-bound traffic: prompts a fraction of the
+        # budget, generation budgets near max_new -- the inverse of
+        # heavy_tail's long-prompt/short-output skew. Here the decode
+        # loop IS the latency (prefill is one short bucket per
+        # request), so this is where speculative decoding's
+        # tokens-per-verify win lands in the ITL quantiles.
+        tenants = (TenantClass("chat", priority=0, share=1.0),)
+        hi_p = max(lo_p, max_prompt // 4)
+        return _assemble(
+            name, seed, rng, tenants,
+            tenant_of=np.zeros(n, np.int64),
+            arrival_ms=poisson_arrivals(rng, n, rate_per_s),
+            prompt_lens=rng.integers(lo_p, hi_p + 1, size=n),
+            max_new=rng.integers(
+                max(2, (3 * max_new) // 4), max_new + 1, size=n
+            ),
+            vocab_size=vocab_size,
+        )
+
     assert name == "colocate"
     # Two classes: when the colocated train step trips the stall
     # watermark, admission control sheds `background` and the
@@ -428,5 +454,5 @@ def build_scenario(
 
 SCENARIOS: Tuple[str, ...] = (
     "steady", "bursty", "heavy_tail", "multi_tenant",
-    "saturating_burst", "colocate", "shared_prefix",
+    "saturating_burst", "colocate", "shared_prefix", "decode_heavy",
 )

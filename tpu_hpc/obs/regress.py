@@ -73,16 +73,31 @@ DEFAULT_TOL = 0.10
 # "prefix_hit*" matches no token here, so a DROPPING hit rate is the
 # regression (higher-is-better), which is how the --bank gate
 # catches cache-efficiency drift.
+# Speculative decoding (serve/spec.py): "rejected" lower-is-better
+# (more rejected drafts at the same traffic = a worse draft source);
+# "draft_ms" rides the "_ms" token (a costlier draft is the
+# regression); "acceptance_rate"/"accepted" match NO token here, so
+# they judge higher-is-better by absence -- a dropping acceptance
+# rate fails the --bank gate exactly like a dropping prefix-hit
+# rate. All four pinned in tests/test_regress.py so the speculative
+# rows are judged, never skipped.
 _LOWER_IS_BETTER = (
     "ttft", "itl", "_ms", "latency", "shed", "stall", "queued",
-    "wire_bytes", "inflight",
+    "wire_bytes", "inflight", "rejected",
     "rollback", "fallback", "poisoned", "spike", "skipped",
     "lost_steps", "integrity_fail", "nonfinite",
 )
 
 
 def lower_is_better(name: str) -> bool:
-    low = name.lower()
+    # Direction comes from the LEAF segment only: composite names
+    # ("<headline metric>.<side key>", "loadgen.<tenant>.<stat>")
+    # must not inherit the parent's tokens -- a banked
+    # "..._ttft_ms_p95.acceptance_rate" is an acceptance rate
+    # (higher-is-better), not a latency, and judging it by the
+    # headline's "ttft" would wave a collapsing draft source through
+    # the gate.
+    low = name.lower().rsplit(".", 1)[-1]
     return any(tok in low for tok in _LOWER_IS_BETTER)
 
 
@@ -108,10 +123,20 @@ def report_metrics(rep: dict) -> Dict[str, float]:
         # prefix_hit_rate (normalized, higher-is-better) and
         # block_stalls (lower) are the two cache-efficiency signals
         # the gate judges.
+        # Speculative rows follow the same split (serve/spec.py):
+        # spec_k is config, drafted/accepted/rejected/verify_steps
+        # are raw counts that scale with the workload (an IMPROVED
+        # acceptance rate means FEWER verify steps for the same
+        # tokens, which a naive direction would flag) --
+        # acceptance_rate (higher-is-better by token absence) and
+        # draft_ms (lower, via "_ms") are the two judged speculative
+        # signals.
         if isinstance(val, (int, float)) and key not in (
             "requests", "kv_block_size", "kv_blocks",
             "kv_blocks_free_min", "prefill_chunks",
             "prefix_hits", "prefix_hit_blocks",
+            "spec_k", "drafted", "accepted", "rejected",
+            "verify_steps",
         ):
             flat[f"serve.{key}"] = float(val)
     lg = rep.get("loadgen")
@@ -143,9 +168,17 @@ def report_metrics(rep: dict) -> Dict[str, float]:
     return flat
 
 
-_QUANTILE_KEYS = (
+# Side metrics banked alongside a record's headline value: the
+# latency quantiles, MFU -- and the speculative acceptance rate
+# (serve/spec.py), the MECHANISM metric: a draft source going stale
+# must fail the --bank gate even while the latency outcome still
+# rides within tolerance. Producers lift these to the record's top
+# level (bench.serve_record / loadgen_record); sub-dict fields are
+# deliberately not walked.
+_BANKED_SIDE_KEYS = (
     "ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
     "itl_ms_p50", "itl_ms_p95", "itl_ms_p99", "mfu",
+    "acceptance_rate",
 )
 
 
@@ -186,7 +219,7 @@ def bank_metrics(
         if not metric:
             continue
         consider(metric, rec.get("value"))
-        for k in _QUANTILE_KEYS:
+        for k in _BANKED_SIDE_KEYS:
             if k in rec:
                 consider(f"{metric}.{k}", rec[k])
     return out

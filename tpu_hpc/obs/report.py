@@ -170,6 +170,11 @@ def _serve(records: Sequence[dict]) -> Optional[dict]:
             "kv_layout", "kv_block_size", "kv_blocks",
             "kv_blocks_free_min", "prefix_hit_rate", "prefix_hits",
             "prefix_hit_blocks", "prefill_chunks",
+            # Speculative decoding (serve/spec.py): mode/k are
+            # identity, acceptance_rate and draft_ms are the judged
+            # signals (regress excludes the identity + raw counts).
+            "spec_mode", "spec_k", "acceptance_rate", "draft_ms",
+            "drafted", "accepted", "rejected", "verify_steps",
         )
         if k in s
     }
@@ -529,6 +534,15 @@ def format_report(rep: dict) -> str:
                 f"cache hit rate {s.get('prefix_hit_rate', 0.0):.0%} "
                 f"({s.get('prefix_hit_blocks', 0)} pages reused, "
                 f"{s.get('prefill_chunks', 0)} prefill chunks)"
+            )
+        if s.get("spec_mode"):
+            lines.append(
+                f"- speculative decode ({s['spec_mode']}, "
+                f"k={s.get('spec_k')}): acceptance "
+                f"{s.get('acceptance_rate', 0.0):.0%} "
+                f"({s.get('accepted', 0)}/{s.get('drafted', 0)} "
+                f"drafts over {s.get('verify_steps', 0)} verify "
+                f"steps), draft cost {s.get('draft_ms', 0.0):.1f} ms"
             )
     lg = rep.get("loadgen")
     if lg is not None:

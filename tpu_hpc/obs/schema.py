@@ -116,6 +116,15 @@ EVENTS: Dict[str, EventSpec] = {
         ("action", "occupancy"),
         optional=("rid", "tenant", "reason", "pending", "by_tenant"),
     ),
+    # -- speculative decoding (serve/spec.py): one record per verify
+    #    step with the accepted/drafted counts. Verify-step cadence
+    #    is decode cadence, so producers emit it ring-only (the
+    #    lg_token discipline); acceptance_rate/draft_ms aggregates
+    #    ride the serve_summary instead. --
+    "spec_step": EventSpec(
+        ("accepted",),
+        optional=("drafted", "slot", "rid", "n_valid"),
+    ),
     # -- paged KV cache (serve/paging.py): page lifecycle edges --
     #    alloc/free/cow/prefix_hit. Page churn runs at admission
     #    cadence, so producers emit these ring-only (flight-recorder

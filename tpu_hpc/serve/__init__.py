@@ -26,6 +26,12 @@ from tpu_hpc.serve.scheduler import (
     Request,
     replay_requests,
 )
+from tpu_hpc.serve.spec import (
+    SpecConfig,
+    SpecRunner,
+    attach_spec,
+    derive_request_seed,
+)
 from tpu_hpc.serve.weights import (
     load_serving_params,
     place_params,
@@ -45,7 +51,11 @@ __all__ = [
     "Request",
     "ServeConfig",
     "ServeMeter",
+    "SpecConfig",
+    "SpecRunner",
     "UnservableRequestError",
+    "attach_spec",
+    "derive_request_seed",
     "load_serving_params",
     "place_params",
     "replay_requests",

@@ -415,6 +415,14 @@ class Engine:
         self._get_exec(("decode",))
         return self.compile_count
 
+    @property
+    def compile_count_total(self) -> int:
+        """Executable builds across the whole serving unit. The slab
+        engine IS the unit; the paged engine adds its attached
+        speculative draft engine's builds (serve/spec.py) -- the one
+        number every recompile guard should read."""
+        return self.compile_count
+
     # -- serving ops ----------------------------------------------------
     def _rep_arr(self, value, dtype=jnp.int32):
         return jax.device_put(jnp.asarray(value, dtype), self._rep)

@@ -182,6 +182,14 @@ class ServeMeter:
                     "serve_mfu"):
             if key in summary:
                 reg.set_gauge(f"serve_{key}", summary[key])
+        # Speculative-decode health on the scrape surface: a falling
+        # acceptance rate is the first sign a draft went stale
+        # against its target (serve/spec.py).
+        if "acceptance_rate" in summary:
+            reg.set_gauge(
+                "serve_spec_acceptance_rate",
+                summary["acceptance_rate"],
+            )
         # Textfile-collector exposition (no-op unless
         # $TPU_HPC_PROM_FILE is set), now carrying the serving gauges.
         reg.write_prometheus()

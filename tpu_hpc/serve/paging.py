@@ -451,7 +451,7 @@ class PrefixTrie:
 # ---------------------------------------------------------------------
 
 
-def make_chunk_prefill_fn(
+def make_chunk_logits_fn(
     cfg: llama2.LlamaConfig,
     bucket: int,
     block_size: int,
@@ -460,7 +460,11 @@ def make_chunk_prefill_fn(
 ):
     """One prefill **chunk** at a padded bucket length -- the paged
     generalisation of the slab prefill program (whole-prompt prefill
-    is the ``start=0`` single-chunk case).
+    is the ``start=0`` single-chunk case). Returns the raw logits row
+    (``[vocab]``) at ``true_len - 1``; :func:`make_chunk_prefill_fn`
+    argmaxes it (greedy serving) and serve/spec.py's sampled prefill
+    applies the seeded temperature/top-p head instead -- one layer
+    loop, two token rules.
 
     ``(params, ks, vs, tokens [1, bucket], start, true_len,
     table [table_width])`` -> ``(ks, vs, next_token)``: the chunk's
@@ -480,7 +484,7 @@ def make_chunk_prefill_fn(
     nb_chunk = bucket // block_size
     cache_cap = max_blocks * block_size
 
-    def chunk_prefill(params, ks, vs, tokens, start, true_len, table):
+    def chunk_logits(params, ks, vs, tokens, start, true_len, table):
         x = _embed(params, tokens, cfg)
         qpos = start + jnp.arange(bucket)
         cos, sin = llama2.rope_cos_sin(
@@ -523,9 +527,29 @@ def make_chunk_prefill_fn(
             x, (0, true_len - 1, 0), (1, 1, cfg.dim)
         )
         logits = _logits_head(last, params, cfg)
-        return ks, vs, jnp.argmax(logits[0, 0], axis=-1).astype(
-            jnp.int32
+        return ks, vs, logits[0, 0]
+
+    return chunk_logits
+
+
+def make_chunk_prefill_fn(
+    cfg: llama2.LlamaConfig,
+    bucket: int,
+    block_size: int,
+    max_blocks: int,
+    table_width: int,
+):
+    """The greedy chunk-prefill program: :func:`make_chunk_logits_fn`
+    with the argmax token rule (meaningful on the final chunk only)."""
+    inner = make_chunk_logits_fn(
+        cfg, bucket, block_size, max_blocks, table_width
+    )
+
+    def chunk_prefill(params, ks, vs, tokens, start, true_len, table):
+        ks, vs, logits = inner(
+            params, ks, vs, tokens, start, true_len, table
         )
+        return ks, vs, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     return chunk_prefill
 
@@ -627,6 +651,11 @@ class _PagedSlot:
     plan: List[Tuple[int, int, int]]   # (start, run, bucket) chunks
     next_chunk: int = 0
     forwarded: int = 0         # padded tokens actually forwarded
+    # Per-request sampling contract (serve/spec.py): the seeded
+    # temperature/top-p head of the spec prefill program reads these.
+    seed: int = 0
+    temperature: float = 0.0
+    top_p: float = 1.0
 
 
 class PagedEngine(Engine):
@@ -695,6 +724,11 @@ class PagedEngine(Engine):
         self.table_width = per_seq + max(serve_cfg.prefill_buckets) // bs
         super().__init__(params, cfg, serve_cfg, mesh, param_pspecs)
 
+        # Speculative decoding (serve/spec.py): attach_spec sets the
+        # runner + the extra program builders the executable table
+        # dispatches to; None means plain greedy single-token decode.
+        self.spec = None
+        self._spec_builders: Dict[str, Any] = {}
         self.allocator = BlockAllocator(paged.num_blocks)
         self.trie: Optional[PrefixTrie] = (
             PrefixTrie(bs) if paged.prefix_cache else None
@@ -733,6 +767,12 @@ class PagedEngine(Engine):
     # -- executable table ----------------------------------------------
     def _build(self, key):
         self.compile_count += 1
+        # Speculative programs (spec_verify / spec_draft /
+        # spec_prefill) are built by the attached SpecRunner against
+        # THIS engine's cache and param abstracts -- same table, same
+        # counter, so the zero-recompile pins cover them too.
+        if key[0] in self._spec_builders:
+            return self._spec_builders[key[0]](key)
         cache = self._cache_abstract()
         params_abs = jax.tree.map(
             lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
@@ -786,11 +826,35 @@ class PagedEngine(Engine):
         return jitted.lower(*args).compile()
 
     def warmup(self) -> int:
+        if self.spec is not None:
+            # Speculative steady state: the sampled prefill variant
+            # per bucket, the batched verify step, CoW -- and the
+            # draft side's programs. The plain greedy decode program
+            # is deliberately NOT compiled (the verify step IS the
+            # decode step here); a stray call would count as a
+            # recompile and trip the pins, keeping the table honest.
+            for b in self.serve_cfg.prefill_buckets:
+                self._get_exec(("spec_prefill", b))
+            self._get_exec(("spec_verify",))
+            self._get_exec(("copy_block",))
+            self.spec.warmup_draft()
+            return self.compile_count_total
         for b in self.serve_cfg.prefill_buckets:
             self._get_exec(("prefill", b))
         self._get_exec(("decode",))
         self._get_exec(("copy_block",))
         return self.compile_count
+
+    @property
+    def compile_count_total(self) -> int:
+        """Executable builds across the WHOLE serving unit: this
+        engine plus the attached draft engine -- the number the
+        recompile guards must pin (a draft-side rebuild is just as
+        much a steady-state violation as a target one)."""
+        n = self.compile_count
+        if self.spec is not None:
+            n += self.spec.draft_compile_count
+        return n
 
     # -- page bookkeeping ----------------------------------------------
     def _set_block_gauges(self) -> None:
@@ -881,6 +945,7 @@ class PagedEngine(Engine):
         prompt: Sequence[int],
         max_new: int,
         run_prefill: bool = True,
+        sampling: Optional[Tuple[int, float, float]] = None,
     ) -> Dict[str, int]:
         """Reserve pages and build the chunk plan for one request.
 
@@ -890,6 +955,11 @@ class PagedEngine(Engine):
         pool says no. ``run_prefill=False`` (the disagg decode tier)
         reserves the same pages but skips the trie and the chunk plan:
         page contents arrive via the cross-tier hop.
+
+        ``sampling`` (``(seed, temperature, top_p)``, spec engines
+        only) is the request's seeded-sampling contract; the spec
+        prefill program's first-token head reads it, and the attached
+        draft pool mirrors the admission one-for-one.
         """
         if slot in self._slot_state:
             raise ValueError(f"slot {slot} already admitted")
@@ -917,15 +987,20 @@ class PagedEngine(Engine):
             raise
         start = len(shared) * self.paged.block_size
         plan = self._chunk_plan(start, plen) if run_prefill else []
+        seed, temperature, top_p = sampling or (0, 0.0, 1.0)
         state = _PagedSlot(
             prompt=list(int(t) for t in prompt),
             max_new=max_new,
             blocks=shared + fresh,
             n_shared=len(shared),
             plan=plan,
+            seed=int(seed), temperature=float(temperature),
+            top_p=float(top_p),
         )
         self._slot_state[slot] = state
         self._write_table(slot, state.blocks)
+        if self.spec is not None:
+            self.spec.on_admit(slot, prompt, max_new)
         bus = get_bus()
         # Ring-only page telemetry (no sink): allocation happens at
         # admission cadence, flight-recorder forensics is the right
@@ -972,14 +1047,27 @@ class PagedEngine(Engine):
         start, run, bucket = st.plan[st.next_chunk]
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :run] = st.prompt[start:start + run]
-        exec_ = self._get_exec(("prefill", bucket))
+        args = [
+            self.params, self.ks, self.vs,
+            self._rep_arr(padded), self._rep_arr(start),
+            self._rep_arr(run),
+            self._rep_arr(self._tables[slot]),
+        ]
+        if self.spec is not None:
+            # The sampled prefill variant: same layer loop, seeded
+            # temperature/top-p first-token head (only the final
+            # chunk's token is consumed). Greedy requests (temp 0)
+            # get exactly the argmax token -- the oracle's contract.
+            exec_ = self._get_exec(("spec_prefill", bucket))
+            args += [
+                self._rep_arr(st.seed),
+                self._rep_arr(st.temperature, jnp.float32),
+                self._rep_arr(st.top_p, jnp.float32),
+            ]
+        else:
+            exec_ = self._get_exec(("prefill", bucket))
         with span("prefill", hist="serve_prefill_s", n=bucket):
-            self.ks, self.vs, tok = exec_(
-                self.params, self.ks, self.vs,
-                self._rep_arr(padded), self._rep_arr(start),
-                self._rep_arr(run),
-                self._rep_arr(self._tables[slot]),
-            )
+            self.ks, self.vs, tok = exec_(*args)
             st.next_chunk += 1
             st.forwarded += bucket
             self.prefill_forwarded_total += bucket
@@ -993,6 +1081,8 @@ class PagedEngine(Engine):
                 self.trie.insert(
                     st.prompt, st.blocks[:n_full], self.allocator
                 )
+        if self.spec is not None:
+            self.spec.on_prefill_done(slot)
         return first
 
     def _cow_write_target(self, slot: int, pos: int) -> None:
@@ -1057,6 +1147,20 @@ class PagedEngine(Engine):
         self._write_table(slot, [])
         get_bus().emit("kv_block", action="free", n=freed, slot=slot)
         self._set_block_gauges()
+        if self.spec is not None:
+            self.spec.on_release(slot)
+
+    def spec_decode(self, *args, **kwargs):
+        """One speculative decode step (serve/spec.py): draft k
+        candidates per slot, verify all k+1 positions in one batched
+        target forward. A named method (not a bare runner call) so
+        the loadgen cost-model proxy can intercept and charge the
+        modeled draft + verify costs on the virtual clock."""
+        if self.spec is None:
+            raise ValueError(
+                "spec_decode on an engine with no attached SpecRunner"
+            )
+        return self.spec.decode(*args, **kwargs)
 
     def prefill(self, slot: int, prompt: Sequence[int]) -> int:
         raise NotImplementedError(

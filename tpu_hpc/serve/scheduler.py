@@ -47,7 +47,7 @@ budget raises a typed error naming both numbers
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -77,7 +77,17 @@ class Request:
 
     ``tenant``/``priority`` classify the request for multi-tenant
     admission control: higher ``priority`` admits first and sheds
-    last. The defaults make single-tenant callers policy-free."""
+    last. The defaults make single-tenant callers policy-free.
+
+    ``temperature``/``top_p``/``seed`` are the per-request sampling
+    contract (serve/spec.py): temperature 0 is greedy (the default --
+    byte-exact against the no-cache oracle); temperature > 0 samples
+    with top-p nucleus filtering under a seeded key that folds in
+    (request seed, position) only, so the stream replays identically
+    regardless of batch composition or slot reassignment. ``seed``
+    None derives a stable seed from ``rid``. Sampling rides the
+    speculative-decode path, so temperature > 0 needs a spec-attached
+    paged engine (submit() enforces it)."""
 
     rid: str
     prompt: List[int]
@@ -85,6 +95,9 @@ class Request:
     eos_id: Optional[int] = None
     tenant: str = "default"
     priority: int = 0
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: Optional[int] = None
 
     def __post_init__(self):
         if not self.prompt:
@@ -92,6 +105,14 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"request {self.rid!r}: max_new_tokens must be >= 1"
+            )
+        if self.temperature < 0:
+            raise ValueError(
+                f"request {self.rid!r}: temperature must be >= 0"
+            )
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"request {self.rid!r}: top_p must be in (0, 1]"
             )
 
 
@@ -178,6 +199,7 @@ class ContinuousBatcher:
         self.policy = policy
         self.stall_signal = stall_signal
         self._paged = bool(getattr(engine, "is_paged", False))
+        self._spec = getattr(engine, "spec", None) is not None
         self.slots = [_Slot() for _ in range(engine.serve_cfg.slots)]
         self.pending: List[Request] = []
         self.results: Dict[str, List[int]] = {}
@@ -186,6 +208,22 @@ class ContinuousBatcher:
         }
         if self._paged:
             self.stats["block_stalls"] = 0
+        # Per-tenant acceptance evidence ("per request class" in the
+        # obs registry): the batcher is the one layer that knows both
+        # the tenant and the per-slot verify outcome.
+        self.spec_by_tenant: Dict[str, Dict[str, int]] = {}
+        # rid -> incremental prompt-lookup index (ngram mode only):
+        # the batcher commits every token, so it is the one layer
+        # that can keep proposals O(1) in history length instead of
+        # rescanning prompt+results per slot per tick.
+        self._spec_ngram = (
+            self._spec and engine.spec.cfg.mode == "ngram"
+        )
+        self._ngram_idx: Dict[str, Any] = {}
+        # rid -> derived sampling seed, computed ONCE at submit (the
+        # crc32 derivation would otherwise rerun per slot per tick on
+        # the decode hot path).
+        self._seeds: Dict[str, int] = {}
         self._requests: Dict[str, Request] = {}
         self._order: Dict[str, int] = {}  # rid -> submission sequence
         # The occupancy gauge exists (at 0) from bring-up: a scraper
@@ -216,8 +254,26 @@ class ContinuousBatcher:
             )
         else:
             self.engine.serve_cfg.bucket_for(len(request.prompt))
+        if request.temperature > 0 and not self._spec:
+            # Sampling rides the speculative path (the verify program
+            # with zero drafts IS the sampled single-token decode);
+            # silently serving a sampled request greedily would be a
+            # correctness lie, so fail at submit like the capacity
+            # checks do.
+            raise ValueError(
+                f"request {request.rid!r}: temperature "
+                f"{request.temperature} needs a speculative engine "
+                "(serve/spec.py attach_spec; mode 'ngram' works "
+                "without a draft checkpoint)"
+            )
         self._requests[request.rid] = request
         self._order[request.rid] = len(self._order)
+        if self._spec:
+            from tpu_hpc.serve.spec import derive_request_seed
+
+            self._seeds[request.rid] = derive_request_seed(
+                request.rid, request.seed
+            )
         self.pending.append(request)
         if self.meter is not None:
             self.meter.submitted(request.rid)
@@ -366,11 +422,25 @@ class ContinuousBatcher:
         slot.remaining = req.max_new_tokens - 1
         self._set_occupancy()
         self.results[req.rid] = [first]
+        self._track_ngram(req, first)
         if self.meter is not None:
             self.meter.token(req.rid, first=True)
         if slot.remaining == 0 or first == req.eos_id:
             self._evict(idx, slot)
         return True
+
+    def _track_ngram(self, req: Request, first: int) -> None:
+        """Seed the request's incremental prompt-lookup index with
+        prompt + first token (exactly the ``prompt + results`` history
+        the rescan used to rebuild per tick)."""
+        if not self._spec_ngram:
+            return
+        from tpu_hpc.serve.spec import NgramIndex
+
+        spec = self.engine.spec
+        index = NgramIndex(req.prompt, max_n=spec.cfg.ngram)
+        index.append(first)
+        self._ngram_idx[req.rid] = index
 
     def _admit_paged(self, idx: int, slot: _Slot) -> bool:
         """Seat the head-of-queue request if the page pool can hold
@@ -382,10 +452,23 @@ class ContinuousBatcher:
         from tpu_hpc.serve.paging import BlockBudgetError
 
         req = self._next_pending()
-        try:
-            info = self.engine.admit(
-                idx, req.prompt, req.max_new_tokens
+        sampling = None
+        if self._spec:
+            sampling = (
+                self._seeds[req.rid], req.temperature, req.top_p,
             )
+        try:
+            # Positional-only when no spec is attached: the disagg
+            # engine's admit has its own (spec-free) signature.
+            if sampling is not None:
+                info = self.engine.admit(
+                    idx, req.prompt, req.max_new_tokens,
+                    sampling=sampling,
+                )
+            else:
+                info = self.engine.admit(
+                    idx, req.prompt, req.max_new_tokens
+                )
         except BlockBudgetError:
             self.pending.append(req)  # _order keeps its place
             self.stats["block_stalls"] += 1
@@ -432,6 +515,7 @@ class ContinuousBatcher:
             slot.last_token = first
             slot.remaining = req.max_new_tokens - 1
             self.results[req.rid] = [first]
+            self._track_ngram(req, first)
             if self.meter is not None:
                 self.meter.token(req.rid, first=True)
             if slot.remaining == 0 or first == req.eos_id:
@@ -453,6 +537,9 @@ class ContinuousBatcher:
             self._prefill_tick()
 
         if not any(s.decoding for s in self.slots):
+            return
+        if self._spec:
+            self._spec_tick()
             return
         tokens = [s.last_token for s in self.slots]
         positions = [s.pos for s in self.slots]
@@ -481,9 +568,91 @@ class ContinuousBatcher:
             if slot.remaining == 0 or tok == req.eos_id:
                 self._evict(idx, slot)
 
+    def _spec_tick(self) -> None:
+        """One speculative decode tick (serve/spec.py): every decoding
+        slot drafts up to ``min(k, remaining - 1)`` candidates and the
+        target verifies all of them in ONE batched forward; the
+        accepted prefix plus the corrected/bonus token commit as this
+        tick's emissions. One tick still counts ONE decode step --
+        that is the latency win the ITL quantiles measure."""
+        slots = self.slots
+        spec = self.engine.spec
+        k = spec.cfg.k
+        # Proposals feed the prompt-lookup draft source only; the
+        # draft-model path never reads them (the decode hot path).
+        ngram = self._spec_ngram
+        tokens, positions, active, n_valid = [], [], [], []
+        seeds, temps, top_ps, proposals = [], [], [], []
+        for s in slots:
+            active.append(s.decoding)
+            tokens.append(s.last_token)
+            positions.append(s.pos)
+            if s.decoding:
+                req = self._requests[s.rid]
+                n_valid.append(min(k, s.remaining - 1))
+                seeds.append(self._seeds[req.rid])
+                temps.append(req.temperature)
+                top_ps.append(req.top_p)
+                # Each request's OWN incremental n-gram index (prompt
+                # + emitted) proposes -- per request, so batch
+                # composition cannot leak in, and O(1) in history
+                # length where the rescan was O(T) per slot per tick.
+                proposals.append(
+                    self._ngram_idx[s.rid].propose(k) if ngram
+                    else []
+                )
+            else:
+                n_valid.append(0)
+                seeds.append(0)
+                temps.append(0.0)
+                top_ps.append(1.0)
+                proposals.append([])
+        out, n_acc, drafted = self.engine.spec_decode(
+            tokens, positions, active, n_valid, seeds, temps, top_ps,
+            proposals=proposals if ngram else None,
+        )
+        self.stats["decode_steps"] += 1
+        reg = get_registry()
+        reg.inc("serve_decode_steps_total")
+        for idx, slot in enumerate(slots):
+            if not slot.decoding:
+                continue
+            req = self._requests[slot.rid]
+            t = self.spec_by_tenant.setdefault(
+                req.tenant, {"drafted": 0, "accepted": 0}
+            )
+            t["drafted"] += int(drafted[idx])
+            t["accepted"] += int(n_acc[idx])
+            reg.inc(
+                f"serve_spec_drafted_{req.tenant}_total",
+                int(drafted[idx]),
+            )
+            reg.inc(
+                f"serve_spec_accepted_{req.tenant}_total",
+                int(n_acc[idx]),
+            )
+            index = self._ngram_idx.get(slot.rid)
+            for tok in out[idx, :int(n_acc[idx]) + 1]:
+                tok = int(tok)
+                self.results[slot.rid].append(tok)
+                if index is not None:
+                    index.append(tok)
+                if self.meter is not None:
+                    self.meter.token(slot.rid)
+                slot.pos += 1
+                slot.last_token = tok
+                slot.remaining -= 1
+                if slot.remaining == 0 or tok == req.eos_id:
+                    # EOS inside an accepted run truncates the stream
+                    # exactly where non-speculative decode would have
+                    # stopped -- the tail beyond it is discarded.
+                    self._evict(idx, slot)
+                    break
+
     def _evict(self, idx: int, slot: _Slot) -> None:
         if self.meter is not None:
             self.meter.finished(slot.rid)
+        self._ngram_idx.pop(slot.rid, None)
         if self._paged:
             self.engine.release(idx)
         self.stats["evicted"] += 1
@@ -549,6 +718,13 @@ class ContinuousBatcher:
         paged = getattr(self.engine, "paged_stats", None)
         if paged:
             self.stats.update(paged)
+        # Speculative engines count drafts/accepts per verify step;
+        # fold the counts (deterministic -- draft wall time stays out
+        # of the batcher stats so virtual-clock replays stay
+        # byte-identical).
+        spec = getattr(self.engine, "spec", None)
+        if spec is not None:
+            self.stats.update(spec.stats)
         return self.results
 
 
@@ -558,10 +734,14 @@ def replay_requests(
     prompt_lens: Sequence[int],
     max_new_tokens: int,
     seed: int = 0,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
 ) -> List[Request]:
     """Deterministic synthetic request mix for the replay server and
     benches: random prompts cycling through ``prompt_lens`` (so every
-    prefill bucket gets traffic)."""
+    prefill bucket gets traffic). ``temperature``/``top_p`` sample
+    the whole mix under per-request seeds derived from the rid --
+    still fully deterministic (the seeded-sampling contract)."""
     rng = np.random.default_rng(seed)
     out = []
     for i in range(n_requests):
@@ -570,5 +750,7 @@ def replay_requests(
             rid=f"r{i:04d}",
             prompt=rng.integers(0, vocab_size, size=n).tolist(),
             max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_p=top_p,
         ))
     return out

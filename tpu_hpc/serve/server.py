@@ -63,6 +63,42 @@ def build_serving_mesh(n_devices: int, cfg: llama2.LlamaConfig):
     )))
 
 
+def build_spec(
+    engine,
+    cfg: llama2.LlamaConfig,
+    spec_cfg,
+    mesh,
+    draft_ckpt: Optional[str] = None,
+    draft_cfg: Optional[llama2.LlamaConfig] = None,
+    seed: int = 0,
+):
+    """Attach speculative decoding (serve/spec.py) to a paged engine:
+    restore (or dev-mode random-init) the draft model for
+    ``mode="draft"``, nothing extra for prompt-lookup. One helper for
+    server.py and bench.py -- the draft-restore path and the default
+    draft architecture must not fork."""
+    import jax
+
+    from tpu_hpc.serve.spec import attach_spec, default_draft_config
+    from tpu_hpc.serve.weights import load_serving_params
+
+    draft_params = None
+    dcfg = None
+    if spec_cfg.mode == "draft":
+        dcfg = draft_cfg or default_draft_config(cfg)
+        if draft_ckpt:
+            draft_params = load_serving_params(draft_ckpt, dcfg, mesh)
+        else:
+            # Development mode: a random draft proves the wiring (and
+            # the greedy oracle) but accepts ~1/vocab of its guesses.
+            draft_params = llama2.init_llama(
+                jax.random.key(seed + 1), dcfg
+            )
+    return attach_spec(
+        engine, spec_cfg, draft_params=draft_params, draft_cfg=dcfg
+    )
+
+
 def run_replay(
     cfg: llama2.LlamaConfig,
     serve_cfg,
@@ -75,6 +111,11 @@ def run_replay(
     disagg: bool = False,
     disagg_max_inflight_mb: Optional[int] = None,
     paged=None,
+    spec=None,
+    spec_draft_ckpt: Optional[str] = None,
+    spec_draft_cfg: Optional[llama2.LlamaConfig] = None,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
 ) -> dict:
     """Engine bring-up + warmup + replay; returns the summary dict.
     ``disagg=True`` splits the chips into disaggregated prefill/decode
@@ -82,7 +123,10 @@ def run_replay(
     plans (``disagg_max_inflight_mb``). ``paged`` (a
     paging.PagedConfig) swaps the slab KV cache for the block-table
     pool with prefix reuse and chunked prefill -- composable with
-    ``disagg`` (the hop then ships block tables + referenced pages)."""
+    ``disagg`` (the hop then ships block tables + referenced pages).
+    ``spec`` (a spec.SpecConfig, paged only) turns on speculative
+    decoding; ``temperature``/``top_p`` sample the replay mix under
+    per-request seeds instead of greedy."""
     import jax
 
     from tpu_hpc.serve.engine import Engine
@@ -129,6 +173,11 @@ def run_replay(
         engine = PagedEngine(params, cfg, serve_cfg, mesh, paged)
     else:
         engine = Engine(params, cfg, serve_cfg, mesh)
+    if spec is not None:
+        build_spec(
+            engine, cfg, spec, mesh, draft_ckpt=spec_draft_ckpt,
+            draft_cfg=spec_draft_cfg, seed=seed,
+        )
     with obs.span("warmup", sink=metrics_path, hist="serve_warmup_s"):
         n_programs = engine.warmup()
 
@@ -136,7 +185,7 @@ def run_replay(
     batcher = ContinuousBatcher(engine, meter=meter)
     requests = replay_requests(
         n_requests, cfg.vocab_size, prompt_lens, max_new_tokens,
-        seed=seed,
+        seed=seed, temperature=temperature, top_p=top_p,
     )
     heartbeat = Heartbeat.from_env()
     tick = None
@@ -169,7 +218,9 @@ def run_replay(
         prefill_buckets=list(serve_cfg.prefill_buckets),
         cache_bytes=engine.cache_bytes,
         compiled_programs=n_programs,
-        recompiles=engine.compile_count - n_programs,
+        recompiles=getattr(
+            engine, "compile_count_total", engine.compile_count
+        ) - n_programs,
         batcher=dict(batcher.stats),
     )
     # The cache layout is part of every serving record's identity:
@@ -179,6 +230,10 @@ def run_replay(
         summary.update(engine.paged_summary())
     else:
         summary["kv_layout"] = "slab"
+    # So is the speculative mode: acceptance rate + draft cost ride
+    # the summary, and spec_mode/spec_k label the rows.
+    if getattr(engine, "spec", None) is not None:
+        summary.update(engine.spec.spec_summary())
     if disagg:
         # Per-tier attribution: tier meshes, the cross-tier KV load,
         # and THIS run's hop-latency quantiles (the engine's own
@@ -203,6 +258,9 @@ def run_loadgen(
     metrics_path: Optional[str] = None,
     seed: int = 0,
     paged=None,
+    spec=None,
+    spec_draft_ckpt: Optional[str] = None,
+    spec_draft_cfg: Optional[llama2.LlamaConfig] = None,
 ) -> dict:
     """Engine bring-up + a tpu_hpc.loadgen scenario run; returns the
     harness summary (per-tenant quantiles, shed/queued counts,
@@ -210,7 +268,12 @@ def run_loadgen(
     buckets/capacity, so any catalog entry runs against any serve
     shape. ``paged`` (a paging.PagedConfig) runs the scenario against
     the block-table cache -- the shared_prefix scenario's hit rate and
-    the admission block stalls come from exactly this path."""
+    the admission block stalls come from exactly this path. ``spec``
+    (a spec.SpecConfig; needs ``paged``) drives the scenario through
+    speculative decoding -- the virtual clock charges one target
+    forward per verify step plus the modeled draft cost, so the
+    banked ITL rows carry the acceptance-driven win
+    deterministically."""
     import jax
 
     from tpu_hpc.loadgen import LoadHarness, build_scenario
@@ -245,6 +308,11 @@ def run_loadgen(
         engine = PagedEngine(params, cfg, serve_cfg, mesh, paged)
     else:
         engine = Engine(params, cfg, serve_cfg, mesh)
+    if spec is not None:
+        build_spec(
+            engine, cfg, spec, mesh, draft_ckpt=spec_draft_ckpt,
+            draft_cfg=spec_draft_cfg, seed=seed,
+        )
     with obs.span("warmup", sink=metrics_path, hist="serve_warmup_s"):
         n_programs = engine.warmup()
     harness = LoadHarness(
@@ -272,8 +340,11 @@ def run_loadgen(
         slots=serve_cfg.slots,
         prefill_buckets=list(serve_cfg.prefill_buckets),
         compiled_programs=n_programs,
-        # Evaluated AFTER the drive: recompiles must count the run.
-        recompiles=engine.compile_count - n_programs,
+        # Evaluated AFTER the drive: recompiles must count the run
+        # (the total includes the spec draft engine's builds).
+        recompiles=getattr(
+            engine, "compile_count_total", engine.compile_count
+        ) - n_programs,
         batcher=dict(harness.batcher.stats),
     )
     return harness.summarize(
@@ -388,6 +459,43 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "the largest bucket are servable); requires --paged",
     )
     ap.add_argument(
+        "--spec", choices=("off", "draft", "ngram"), default="off",
+        help="speculative decoding (serve/spec.py; requires --paged): "
+        "'draft' drafts k tokens with a small draft model "
+        "(--spec-draft-ckpt, or a dev-mode random init), 'ngram' "
+        "self-speculates via prompt lookup over each request's own "
+        "history -- no extra model; greedy streams stay byte-exact, "
+        "only latency changes",
+    )
+    ap.add_argument(
+        "--spec-k", type=int, default=None, metavar="K",
+        help="drafted tokens per verify step (default 4); requires "
+        "--spec",
+    )
+    ap.add_argument(
+        "--spec-draft-ckpt", type=str, default=None, metavar="DIR",
+        help="restore the draft model from the newest trainer "
+        "checkpoint here (requires --spec draft; without it the "
+        "draft is a random init -- wiring proof, ~zero acceptance)",
+    )
+    ap.add_argument(
+        "--spec-draft-model", type=str, default=None,
+        choices=("half", *sorted(llama2.PRESETS)),
+        help="draft architecture for --spec draft (default 'half': "
+        "the target config at half depth; presets restore real "
+        "draft checkpoints)",
+    )
+    ap.add_argument(
+        "--temperature", type=float, default=None,
+        help="sample the replay mix at this temperature under "
+        "per-request seeds (default: greedy; requires --spec -- "
+        "sampling rides the verify program)",
+    )
+    ap.add_argument(
+        "--top-p", type=float, default=None,
+        help="nucleus filter for --temperature sampling (default 1.0)",
+    )
+    ap.add_argument(
         "--checkpoint-dir", type=str, default=None,
         help="restore params from the newest trainer checkpoint here "
         "(serve/weights.py resharding); default: random init",
@@ -482,6 +590,62 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 ap.error(
                     f"{flag} is only consumed together with --paged"
                 )
+    # Speculative decoding rides the paged engine only; a spec flag
+    # that cannot take effect is a parse error, not a silent greedy
+    # run wearing a speculative label.
+    if args.spec != "off" and not args.paged:
+        ap.error(
+            "--spec rides the paged engine (serve/paging.py); add "
+            "--paged"
+        )
+    if args.spec != "off" and args.disagg:
+        ap.error(
+            "--spec is not consumed by --disagg (the verify program "
+            "is a single-mesh paged program; the decode tier would "
+            "silently run greedy)"
+        )
+    if args.spec == "off":
+        for flag, val in (
+            ("--spec-k", args.spec_k),
+            ("--spec-draft-ckpt", args.spec_draft_ckpt),
+            ("--spec-draft-model", args.spec_draft_model),
+            ("--temperature", args.temperature),
+            ("--top-p", args.top_p),
+        ):
+            if val is not None:
+                ap.error(
+                    f"{flag} is only consumed together with --spec"
+                )
+    if args.spec != "draft":
+        for flag, val in (
+            ("--spec-draft-ckpt", args.spec_draft_ckpt),
+            ("--spec-draft-model", args.spec_draft_model),
+        ):
+            if val is not None:
+                ap.error(
+                    f"{flag} is only consumed together with "
+                    "--spec draft"
+                )
+    if args.temperature is not None and args.loadgen:
+        ap.error(
+            "--temperature is only consumed by the replay workload; "
+            "--loadgen scenarios replay their own greedy mixes"
+        )
+    if args.top_p is not None and args.temperature is None:
+        ap.error(
+            "--top-p is only consumed together with --temperature"
+        )
+    # Range-check at parse like every sibling spec flag: an
+    # out-of-range value must not burn a full bring-up+warmup before
+    # Request.__post_init__ rejects it with a traceback.
+    if args.temperature is not None and args.temperature < 0:
+        ap.error(
+            f"--temperature {args.temperature} must be >= 0"
+        )
+    if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
+        ap.error(f"--top-p {args.top_p} must be in (0, 1]")
+    if args.spec_k is not None and args.spec_k < 1:
+        ap.error(f"--spec-k {args.spec_k} must be >= 1")
 
     if args.sim_devices:
         from tpu_hpc.runtime import sim
@@ -540,6 +704,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     serve_cfg = ServeConfig(
         slots=args.slots, max_seq_len=max_seq, prefill_buckets=buckets
     )
+    spec_cfg = None
+    spec_draft_cfg = None
+    if args.spec != "off":
+        from tpu_hpc.serve.spec import SpecConfig
+
+        try:
+            spec_cfg = SpecConfig(mode=args.spec, k=args.spec_k or 4)
+        except ValueError as e:
+            ap.error(str(e))
+        if args.spec_draft_model and args.spec_draft_model != "half":
+            spec_draft_cfg = llama2.PRESETS[args.spec_draft_model]
     if args.loadgen:
         from tpu_hpc.loadgen import SCENARIOS
 
@@ -564,6 +739,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             checkpoint_dir=args.checkpoint_dir,
             metrics_path=args.metrics, seed=args.seed,
             paged=paged,
+            spec=spec_cfg,
+            spec_draft_ckpt=args.spec_draft_ckpt,
+            spec_draft_cfg=spec_draft_cfg,
         )
     else:
         if args.disagg:
@@ -582,6 +760,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             disagg=args.disagg,
             disagg_max_inflight_mb=args.disagg_max_inflight_mb,
             paged=paged,
+            spec=spec_cfg,
+            spec_draft_ckpt=args.spec_draft_ckpt,
+            spec_draft_cfg=spec_draft_cfg,
+            temperature=args.temperature or 0.0,
+            top_p=args.top_p if args.top_p is not None else 1.0,
         )
     print(json.dumps(summary))
     return 0
