@@ -103,6 +103,57 @@ def bench_model_cfg(seq_len: int = 2048, remat: bool = False):
     )
 
 
+def resolve_comm_auto(
+    model_cfg,
+    comm_table: "str | None" = None,
+    bucket_cap_bytes: "int | None" = None,
+):
+    """Resolve --comm-mode auto for a llama-family workload: the
+    collective planner's grad-sync decision (comm.planner) for the
+    EXACT gradient payload of ``model_cfg`` on the visible topology.
+    Runs before any array exists (eval_shape), because the resolved
+    mode decides which mesh family the bench builds.
+    ``bucket_cap_bytes`` defaults to the config's comm_bucket_mb --
+    the same ladder cap the Trainer's own resolution would apply."""
+    import math
+
+    import jax
+    import numpy as np
+
+    from tpu_hpc.comm import planner as comm_planner
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.models import llama2
+    from tpu_hpc.runtime.mesh import slice_groups, two_tier_spec
+
+    if bucket_cap_bytes is None:
+        bucket_cap_bytes = TrainingConfig().comm_bucket_mb * 2 ** 20
+
+    abstract = jax.eval_shape(
+        lambda k: llama2.init_llama(k, model_cfg),
+        jax.random.key(0),
+    )
+    payload = sum(
+        int(math.prod(l.shape)) * np.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(abstract)
+    )
+    n_dev = jax.device_count()
+    n_slices = len(slice_groups(jax.devices()))
+    try:
+        two_tier_spec(n_dev, n_slices)
+        two_tier_ok = True
+    except ValueError:
+        two_tier_ok = False
+    table = (
+        comm_planner.load_table(comm_table) if comm_table else None
+    )
+    return comm_planner.Planner.for_devices(
+        table=table
+    ).plan_grad_sync(
+        payload, two_tier=two_tier_ok,
+        bucket_cap_bytes=bucket_cap_bytes,
+    )
+
+
 def bench_llama(
     steps: int = 20, remat: bool = False, batch_per_dp: int = 4,
     attn: str = "flash", block_q: int = 512, block_k: int = 1024,
@@ -111,6 +162,7 @@ def bench_llama(
     block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
     comm_mode: str = "flat",
     guard_mode: str = "off",
+    comm_table: "str | None" = None,
 ) -> dict:
     """Best measured single-chip config (v5e) -- what the CLI runs by
     default (the *function* defaults are the unaccumulated round-2
@@ -144,6 +196,26 @@ def bench_llama(
     init_distributed(verbose=False)
     n_dev = jax.device_count()
     model_cfg = bench_model_cfg(seq_len, remat)
+
+    # comm_mode="auto": resolve the gradient-sync strategy through the
+    # collective planner BEFORE the mesh is built -- the resolved mode
+    # decides the mesh family (manual modes are pure-DP; hierarchical
+    # needs the two-tier axes), so the resolution cannot live inside
+    # the Trainer here. Payload is the exact gradient byte count from
+    # an eval_shape (no arrays materialize); the record carries the
+    # "auto" label, the resolved mode, and the full decision so a
+    # sweep can attribute the row to the planner's reasoning.
+    comm_mode_requested = comm_mode
+    comm_decision = None
+    if comm_mode == "auto":
+        comm_decision = resolve_comm_auto(model_cfg, comm_table)
+        comm_mode = comm_decision.mode
+        print(
+            f"llama bench | comm_mode auto -> {comm_mode} "
+            f"[{comm_decision.source}] "
+            f"pred {comm_decision.predicted_cost_s * 1e3:.3f} ms/sync",
+            file=sys.stderr,
+        )
 
     def make_attn_fn(mesh, tp_size):
         if attn == "xla":
@@ -211,7 +283,11 @@ def bench_llama(
         weight_decay=0.1,
         grad_accum_steps=grad_accum_steps,
         adam_moments_dtype=moments_dtype,
-        comm_mode=comm_mode,
+        # The REQUESTED mode: under "auto" the trainer consumes the
+        # pre-resolved decision below (bench had to resolve it first
+        # -- the mode picks the mesh family), so the planner's exact
+        # bucket choice is honored, not re-derived.
+        comm_mode=comm_mode_requested,
         guard_mode=guard_mode,
     )
     ds = datasets.TokenStream(
@@ -223,6 +299,7 @@ def bench_llama(
             model_cfg, constrain, make_attn_fn(mesh, tp_size)
         ),
         params, param_pspecs=specs, batch_pspec=batch_pspec,
+        comm_plan=comm_decision,
     )
     result = trainer.fit(ds)
     summary = result["epochs"][-1]
@@ -249,7 +326,17 @@ def bench_llama(
         "attn": attn,
         # Gradient-sync strategy: BENCH JSONLs must be able to
         # attribute a step-time delta to the comm layer, not guess it.
-        "comm_mode": comm_mode,
+        # Under "auto" the row carries the label AND the resolution:
+        # a sweep must be able to tell "the planner picked flat" from
+        # "the operator picked flat".
+        "comm_mode": comm_mode_requested,
+        **(
+            {
+                "comm_mode_resolved": comm_mode,
+                "comm_plan": comm_decision.summary(),
+            }
+            if comm_decision is not None else {}
+        ),
         # Numeric-health guard: the health vector rides the jitted
         # step, so a guarded row quantifies exactly what the guard
         # costs (the zero-recompile claim's measured counterpart).
@@ -353,6 +440,7 @@ def bench_llama_long(
     block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
     comm_mode: str = "flat",
     guard_mode: str = "off",
+    comm_table: "str | None" = None,
 ) -> dict:
     """Long-context Llama: seq 8192 (4x the headline bench) -- the
     long-sequence regime the SP family exists for. Same harness as
@@ -370,6 +458,7 @@ def bench_llama_long(
         moments_dtype=moments_dtype,
         block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
         comm_mode=comm_mode, guard_mode=guard_mode,
+        comm_table=comm_table,
     )
     rec["metric"] = f"llama2_seq{seq_len}_tokens_per_s_per_chip"
     return rec
@@ -1260,15 +1349,24 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--comm-mode",
-        choices=("flat", "hierarchical", "bucketed_overlap"),
+        choices=("flat", "hierarchical", "bucketed_overlap", "auto"),
         default="flat",
         help="gradient-sync strategy (config.comm_mode): flat = "
         "GSPMD's fused collectives; bucketed_overlap = explicit "
         "DDP-style size-capped bucket reductions inside shard_map; "
-        "hierarchical = bucketed + two-phase ICI/DCN decomposition. "
+        "hierarchical = bucketed + two-phase ICI/DCN decomposition; "
+        "auto = the collective planner (tpu_hpc.comm.planner) picks "
+        "mode and bucket from this topology's cost table (alpha-beta "
+        "fallback without one). "
         "Manual modes run the pure-DP replicated-params recipe; the "
         "record carries comm_mode so BENCH JSONLs can attribute "
         "step-time deltas (llama/llama-long workloads)",
+    )
+    ap.add_argument(
+        "--comm-table", type=str, default=None, metavar="PATH",
+        help="explicit planner cost-table file for --comm-mode auto "
+        "(default: the cache-dir entry for the live topology, "
+        "$TPU_HPC_COMM_TABLES); requires --comm-mode auto",
     )
     ap.add_argument(
         "--guard-mode", choices=("off", "skip"), default="off",
@@ -1409,12 +1507,24 @@ def main(argv=None) -> int:
         # Only the llama/llama-long workloads consume the gradient-sync
         # knob; running any other with it silently flat would emit rows
         # a comm-mode sweep cannot tell apart from the real thing.
+        # (comm_mode="auto" without a gradient-sync-consuming workload
+        # is the same lie one indirection later: there is no sync for
+        # the planner to plan.)
         ap.error(
             f"--comm-mode {args.comm_mode} is only consumed by the "
             "llama/llama-long workloads; "
             + ("--all runs its own fixed comm-mode row"
                if args.all else
                f"--workload {args.workload} would silently run flat")
+        )
+    if args.comm_table is not None and args.comm_mode != "auto":
+        # Planner flags on non-auto modes: the --comm-mode guard
+        # discipline. A table nothing consults must be a CLI error,
+        # not a row that silently ignored the measurements it names.
+        ap.error(
+            f"--comm-table {args.comm_table} is only consumed by "
+            f"--comm-mode auto; --comm-mode {args.comm_mode} never "
+            "consults the planner"
         )
     if args.supervise:
         from tpu_hpc.resilience.supervisor import (
@@ -1458,6 +1568,7 @@ def main(argv=None) -> int:
             block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
             comm_mode=args.comm_mode,
             guard_mode=args.guard_mode,
+            comm_table=args.comm_table,
         )
     elif args.workload == "llama-sp":
         batch, accum = resolve_batch_accum(
@@ -1490,6 +1601,7 @@ def main(argv=None) -> int:
             block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
             comm_mode=args.comm_mode,
             guard_mode=args.guard_mode,
+            comm_table=args.comm_table,
         )
     elif args.workload == "serve":
         rec = bench_serve(

@@ -326,6 +326,28 @@ def main(argv=None) -> int:
             seq_len=seq, moments_dtype=args.moments_dtype,
             slices=args.slices, pp_backward=args.pp_backward,
         ))
+        # Cost-table inventory for the LIVE backend (not the modeled
+        # --chips topology): does comm_mode="auto" here run on
+        # measurements or on the alpha-beta fallback? One line, same
+        # delegation discipline as the rest of the doctor -- the
+        # verdict comes from comm/planner.py, not a second opinion.
+        # Best-effort: the fingerprint needs jax.devices(), and the
+        # doctor historically never touched the runtime -- on a TPU VM
+        # whose chips another job holds, backend acquisition fails, and
+        # that must not take down the (pure-arithmetic) analysis above.
+        try:
+            from tpu_hpc.comm.planner import (
+                format_inventory,
+                table_inventory,
+            )
+
+            print(format_inventory(table_inventory()))
+        except Exception as e:  # noqa: BLE001 -- advisory line only
+            print(
+                "comm cost tables: unavailable (backend not "
+                f"reachable: {e}); run on the target host for the "
+                "inventory"
+            )
     return 0 if plans and plans[0].fits else 1
 
 

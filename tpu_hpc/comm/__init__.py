@@ -22,3 +22,12 @@ from tpu_hpc.comm.overlap import (  # noqa: F401
     ring_all_gather,
 )
 from tpu_hpc.comm.bench import CommBenchmark, run_comm_bench  # noqa: F401
+from tpu_hpc.comm.planner import (  # noqa: F401
+    CommDecision,
+    CostTable,
+    Planner,
+    TopologyFingerprint,
+    fingerprint_devices,
+    fingerprint_mesh,
+    plan_trainer_grad_sync,
+)

@@ -93,27 +93,35 @@ _BUSBW_BASE = {
 }
 
 
-def bus_bandwidth_gb_s(op: str, bytes_per_shard: int, n: int, t: float) -> float:
-    """Ring bus-bandwidth model, matching torch_comm_bench.py:92-116.
-
-    broadcast: size/t. all-reduce: 2(n-1)/n * size/t. all-gather and
-    reduce-scatter move (n-1)/n * size: the standard NCCL-tests busbw
-    factors, applied unchanged to ICI. Hierarchical/overlap ops use
-    their flat op's factor over the TOTAL axis extent (comparability
-    with the flat row; the phase split is reported separately by
-    :func:`two_phase_bytes`).
-    """
-    if t <= 0:
-        return float("inf")
-    factor = {
+def wire_factor(op: str, n: int) -> float:
+    """Per-device wire share of one flat collective over an ``n``-wide
+    axis (the NCCL-tests busbw factor table) -- THE one copy: the CSV
+    rows' busbw accounting and the planner's analytic cost model
+    (comm/planner.py) both read it, so a factor correction can never
+    leave the two computing from different wire models."""
+    return {
         "broadcast": 1.0,
         "all_reduce": 2.0 * (n - 1) / n,
         "all_gather": (n - 1) / n,
         "reduce_scatter": (n - 1) / n,
         "ring_shift": 1.0,
         "all_to_all": (n - 1) / n,
-    }[_BUSBW_BASE[op]]
-    return factor * bytes_per_shard / t / 1e9
+    }[op]
+
+
+def bus_bandwidth_gb_s(op: str, bytes_per_shard: int, n: int, t: float) -> float:
+    """Ring bus-bandwidth model, matching torch_comm_bench.py:92-116.
+
+    broadcast: size/t. all-reduce: 2(n-1)/n * size/t. all-gather and
+    reduce-scatter move (n-1)/n * size: the standard NCCL-tests busbw
+    factors (:func:`wire_factor`), applied unchanged to ICI.
+    Hierarchical/overlap ops use their flat op's factor over the TOTAL
+    axis extent (comparability with the flat row; the phase split is
+    reported separately by :func:`two_phase_bytes`).
+    """
+    if t <= 0:
+        return float("inf")
+    return wire_factor(_BUSBW_BASE[op], n) * bytes_per_shard / t / 1e9
 
 
 def two_phase_bytes(
@@ -232,6 +240,13 @@ class CommBenchmark:
         raise ValueError(op)
 
     def run(self) -> List[Dict]:
+        from tpu_hpc.comm.planner import fingerprint_mesh
+
+        # Topology fingerprint: the planner's cost-table cache key.
+        # Deliberately a function of the DEVICE SET (not this mesh's
+        # axis layout), so the flat and hierarchical rows of one sweep
+        # key the same table (comm/planner.py).
+        fp = fingerprint_mesh(self.mesh).digest
         records = []
         for op in self.ops:
             fn = self._fn_for(op)
@@ -255,6 +270,8 @@ class CommBenchmark:
                     "op": op,
                     "size_elements": size,
                     "bytes_per_shard": nbytes,
+                    "dtype": self.dtype,
+                    "fingerprint": fp,
                     "world_size": n,
                     "mean_s": float(times.mean()),
                     "std_s": float(times.std()),
@@ -312,8 +329,10 @@ def run_reshard_bench(
       ``peak_inflight_bytes``).
     """
     from tpu_hpc import reshard
+    from tpu_hpc.comm.planner import fingerprint_mesh
     from tpu_hpc.obs.schema import stamp
 
+    fp = fingerprint_mesh(mesh).digest
     n = mesh.shape[axis]
     if n < 2:
         print(
@@ -367,6 +386,8 @@ def run_reshard_bench(
                     "op": name,
                     "size_elements": size,
                     "bytes_per_shard": x.nbytes // n,
+                    "dtype": dtype,
+                    "fingerprint": fp,
                     "world_size": n,
                     "max_inflight_bytes": bound,
                 }
@@ -606,6 +627,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     )
     p.add_argument("--axis-size", type=int, default=-1)
     p.add_argument(
+        "--emit-table", type=str, default=None, metavar="PATH",
+        help="also write a planner-consumable cost table built from "
+        "this run's rows (tpu_hpc.comm.planner CostTable JSON). A "
+        "directory path writes <fingerprint>.json inside it -- point "
+        "it at the planner's cache dir ($TPU_HPC_COMM_TABLES) and "
+        "comm_mode='auto' picks the measurements up directly",
+    )
+    p.add_argument(
         "--dcn", type=int, default=None,
         help="DCN (outer-tier) extent for the hierarchical ops' "
         "(dcn x ici) mesh; default: the physical slice count on "
@@ -622,7 +651,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     if any(op not in HIER_OPS for op in ops):
         mesh = build_mesh(MeshSpec(axes={"data": args.axis_size}))
     output = None if args.output == "-" else args.output
-    run_comm_bench(
+    records = run_comm_bench(
         mesh,
         sizes=args.sizes,
         warmup=args.warmup,
@@ -631,6 +660,27 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         output=output,
         dcn=args.dcn,
     )
+    if args.emit_table and jax.process_index() == 0:
+        from tpu_hpc.comm import planner
+
+        try:
+            # The whole-device-set fingerprint: rows measured on a
+            # sub-mesh (--axis-size) key a different topology and are
+            # filtered out rather than poisoning the live table.
+            table = planner.CostTable.from_rows(
+                records, fingerprint=planner.fingerprint_devices()
+            )
+        except planner.CostTableError as e:
+            print(
+                f"comm bench: --emit-table skipped -- {e}",
+                file=sys.stderr,
+            )
+        else:
+            path = table.save(args.emit_table)
+            print(
+                f"comm bench: wrote cost table {path} "
+                f"({len(table)} entries, fingerprint {table.digest})"
+            )
 
 
 if __name__ == "__main__":

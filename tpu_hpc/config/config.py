@@ -131,10 +131,16 @@ class TrainingConfig:
     # sharded P((dcn, data)) with the DCN axis outer). Manual modes
     # require replicated params (DDP-style); FSDP/TP-sharded plans
     # keep "flat" (fsdp.validate_grad_sync_mode enforces this).
+    # "auto" = ask the topology-aware collective planner
+    # (tpu_hpc.comm.planner): the mode AND bucket size come from the
+    # mesh's measured cost table (an alpha-beta latency/bandwidth
+    # model when no table exists), sharded plans resolve to flat, and
+    # the decision is logged as a schema-stamped comm_plan event.
     comm_mode: str = "flat"
     # Bucket size cap for the manual comm modes, in MiB (DDP's 25 MiB
     # default: big enough to amortize collective launch latency, small
-    # enough that buckets pipeline within one backward).
+    # enough that buckets pipeline within one backward). Under
+    # comm_mode="auto" this caps the planner's bucket ladder.
     comm_bucket_mb: int = 25
 
     # Run metrics log: when set, host 0 appends one JSON line per

@@ -140,7 +140,20 @@ EVENTS: Dict[str, EventSpec] = {
         ("steps", "bytes", "wire_bytes", "peak_inflight_bytes"),
         optional=(
             "chunked_steps", "max_inflight_bytes", "bound_met",
-            "kinds", "label", "measured_bytes",
+            "kinds", "label", "measured_bytes", "predicted_cost_ms",
+            "inflight_source",
+        ),
+    ),
+    # -- collective planner (comm/planner.py): one record per resolved
+    #    comm_mode="auto" decision -- the chosen strategy, predicted
+    #    cost, candidate table, and whether the numbers came from a
+    #    measured cost table or the alpha-beta fallback --
+    "comm_plan": EventSpec(
+        ("op", "mode", "source"),
+        optional=(
+            "payload_bytes", "dtype", "bucket_bytes",
+            "predicted_cost_ms", "fingerprint", "table", "candidates",
+            "reason", "resolved_from",
         ),
     ),
     # -- elastic resume (ckpt.restore_latest cross-topology path) --

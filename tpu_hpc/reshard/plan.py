@@ -346,6 +346,14 @@ class ReshardPlan:
     treedef: Any
     max_inflight_bytes: Optional[int] = None
     label: Optional[str] = None
+    # Provenance of the bound: "planner" when max_inflight_bytes="auto"
+    # resolved through the collective planner's cost model
+    # (comm/planner.py), None when the caller fixed it by hand.
+    inflight_source: Optional[str] = None
+    # Planner-predicted wall time of the whole move (auto plans only):
+    # per-step launch + wire cost over the modeled fabric tier, next
+    # to the measured execution time the reshard span records.
+    predicted_cost_s: Optional[float] = None
     _programs: Dict[Any, Any] = dataclasses.field(
         default_factory=dict, repr=False
     )
@@ -387,7 +395,7 @@ class ReshardPlan:
 
     def summary(self) -> dict:
         """JSON-safe plan record (the ``reshard_plan`` obs event)."""
-        return {
+        rec = {
             "steps": len(self.steps),
             "bytes": self.bytes,
             "wire_bytes": self.wire_bytes,
@@ -397,6 +405,13 @@ class ReshardPlan:
             "bound_met": self.bound_met,
             "kinds": self.counts(),
         }
+        if self.inflight_source is not None:
+            rec["inflight_source"] = self.inflight_source
+        if self.predicted_cost_s is not None:
+            rec["predicted_cost_ms"] = round(
+                self.predicted_cost_s * 1e3, 6
+            )
+        return rec
 
     def describe(self) -> str:
         """Human-readable step table."""
@@ -462,11 +477,52 @@ def _leaf_sharding(leaf):
     return s
 
 
+def _planner_for_steps(steps: List[ReshardStep]):
+    """The collective planner over the device set this plan touches
+    (union of source/target meshes -- the disagg KV hop's two disjoint
+    tiers fingerprint as one topology, which is what its cost table
+    measures)."""
+    from tpu_hpc.comm.planner import Planner
+
+    devs, seen = [], set()
+    for s in steps:
+        for sh in (s.src_sharding, s.tgt_sharding):
+            mesh = getattr(sh, "mesh", None)
+            if mesh is None:
+                continue
+            for d in mesh.devices.flat:
+                if id(d) not in seen:
+                    seen.add(id(d))
+                    devs.append(d)
+    return Planner.for_devices(devs or None)
+
+
+def _predict_cost(steps: List[ReshardStep], planner) -> float:
+    """Modeled wall time of the plan: per-step (and per-chunk) launch
+    latency plus wire bytes over the step's fabric tier -- the
+    exchange-vs-transfer decomposition costed with the same alpha-beta
+    terms the planner uses everywhere."""
+    from tpu_hpc.comm.planner import tier_cost
+
+    total = 0.0
+    # A move on a multi-slice device set pays DCN rates: same-mesh
+    # exchanges span the slices too (their collective crosses DCN),
+    # and cross-mesh transfers between tiers of one pod do by
+    # definition. Single-slice (and the CPU sim) is all ICI.
+    tier = "dcn" if planner.fingerprint.n_slices > 1 else "ici"
+    for s in steps:
+        if s.wire_bytes <= 0:
+            continue
+        chunks = s.chunk.count if s.chunk else 1
+        total += chunks * tier_cost(tier, s.wire_bytes / chunks)
+    return total
+
+
 def plan_reshard(
     tree: Any,
     targets: Any,
     *,
-    max_inflight_bytes: Optional[int] = None,
+    max_inflight_bytes: "Optional[int | str]" = None,
     label: Optional[str] = None,
 ) -> ReshardPlan:
     """Plan a source->target redistribution for a whole pytree.
@@ -477,7 +533,12 @@ def plan_reshard(
     every leaf. ``max_inflight_bytes`` bounds the modeled per-device
     transient of every step (the arXiv:2112.01075 knob): leaves whose
     conservative move exceeds it are decomposed into chunked
-    slice->move->write steps.
+    slice->move->write steps. The string ``"auto"`` asks the
+    collective planner (tpu_hpc.comm.planner) for the bound: the
+    chunk size that amortizes the fabric tier's launch latency, from
+    the topology's cost model -- the plan then records
+    ``inflight_source="planner"`` and the planner's predicted wall
+    time next to its wire-byte model.
     """
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     if isinstance(targets, jax.sharding.Sharding):
@@ -495,22 +556,55 @@ def plan_reshard(
         tgt_flat = tgt_leaves
     from tpu_hpc.parallel.plans import _path_str
 
-    steps = []
-    for (path, leaf), tgt in zip(flat, tgt_flat):
-        if not isinstance(tgt, jax.sharding.Sharding):
-            raise TypeError(
-                f"target for {_path_str(path)} is "
-                f"{type(tgt).__name__}, not a Sharding"
+    def build_steps(bound: Optional[int]) -> List[ReshardStep]:
+        steps = []
+        for (path, leaf), tgt in zip(flat, tgt_flat):
+            if not isinstance(tgt, jax.sharding.Sharding):
+                raise TypeError(
+                    f"target for {_path_str(path)} is "
+                    f"{type(tgt).__name__}, not a Sharding"
+                )
+            steps.append(plan_step(
+                _path_str(path),
+                tuple(leaf.shape),
+                leaf.dtype,
+                _leaf_sharding(leaf),
+                tgt,
+                max_inflight_bytes=bound,
+            ))
+        return steps
+
+    inflight_source = None
+    predicted = None
+    if max_inflight_bytes == "auto":
+        # Two passes: classify unbounded first (kinds and wire bytes
+        # do not depend on the bound), ask the planner for the chunk
+        # size that amortizes the relevant tier's launch latency, then
+        # re-plan under it. Planning is host-side arithmetic; the
+        # second pass costs microseconds.
+        steps0 = build_steps(None)
+        planner = _planner_for_steps(steps0)
+        movers = [s for s in steps0 if s.wire_bytes > 0]
+        if movers:
+            max_inflight_bytes = planner.chunk_bytes(
+                max(s.bytes for s in movers)
             )
-        steps.append(plan_step(
-            _path_str(path),
-            tuple(leaf.shape),
-            leaf.dtype,
-            _leaf_sharding(leaf),
-            tgt,
-            max_inflight_bytes=max_inflight_bytes,
-        ))
+        else:
+            max_inflight_bytes = None  # nothing moves: no bound needed
+        inflight_source = "planner"
+        steps = build_steps(max_inflight_bytes)
+        predicted = _predict_cost(steps, planner)
+    else:
+        if max_inflight_bytes is not None and not hasattr(
+            max_inflight_bytes, "__index__"
+        ):
+            raise TypeError(
+                f"max_inflight_bytes must be an int, None, or "
+                f"'auto'; got {max_inflight_bytes!r}"
+            )
+        steps = build_steps(max_inflight_bytes)
     return ReshardPlan(
         steps=steps, treedef=treedef,
         max_inflight_bytes=max_inflight_bytes, label=label,
+        inflight_source=inflight_source, predicted_cost_s=predicted,
     )

@@ -121,7 +121,7 @@ class DisaggEngine:
         serve_cfg: ServeConfig,
         prefill_mesh: Mesh,
         decode_mesh: Mesh,
-        max_inflight_bytes: Optional[int] = None,
+        max_inflight_bytes: "Optional[int | str]" = None,
         paged=None,
     ):
         shared = set(prefill_mesh.devices.flat) & set(
@@ -168,6 +168,28 @@ class DisaggEngine:
         self.mesh = decode_mesh  # the resident (decode) tier
         self.prefill_mesh = prefill_mesh
         self.decode_mesh = decode_mesh
+        # max_inflight_bytes="auto": size the page-group transfers
+        # from the topology's cost tables (comm/planner.py) -- the
+        # chunk that amortizes the cross-tier launch latency, bounded
+        # by the largest bucket's actual KV leaf. The operator knob
+        # (--disagg-max-inflight-mb N) still overrides.
+        self.inflight_source = None
+        if max_inflight_bytes == "auto":
+            import math as _math
+
+            from tpu_hpc.comm.planner import Planner
+
+            rows = self._rows_shape(max(serve_cfg.prefill_buckets))
+            leaf_bytes = int(
+                _math.prod(rows)
+                * jnp.dtype(self.prefill_engine.ks.dtype).itemsize
+            )
+            planner = Planner.for_devices(
+                list(prefill_mesh.devices.flat)
+                + list(decode_mesh.devices.flat)
+            )
+            self.max_inflight_bytes = planner.chunk_bytes(leaf_bytes)
+            self.inflight_source = "planner"
         self.cache_bytes = (
             self.prefill_engine.cache_bytes
             + self.decode_engine.cache_bytes
@@ -565,6 +587,7 @@ class DisaggEngine:
                 k: int(v) for k, v in self.decode_mesh.shape.items()
             },
             "max_inflight_bytes": self.max_inflight_bytes,
+            "inflight_source": self.inflight_source,
             "kv_transfers": self.transfer_stats["kv_transfers"],
             "kv_transfer_bytes": self.transfer_stats[
                 "kv_transfer_bytes"

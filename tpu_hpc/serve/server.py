@@ -109,7 +109,7 @@ def run_replay(
     metrics_path: Optional[str] = None,
     seed: int = 0,
     disagg: bool = False,
-    disagg_max_inflight_mb: Optional[int] = None,
+    disagg_max_inflight_mb: "Optional[int | str]" = None,
     paged=None,
     spec=None,
     spec_draft_ckpt: Optional[str] = None,
@@ -164,7 +164,8 @@ def run_replay(
         engine = DisaggEngine(
             params, cfg, serve_cfg, prefill_mesh, decode_mesh,
             max_inflight_bytes=(
-                disagg_max_inflight_mb * (1 << 20)
+                "auto" if disagg_max_inflight_mb == "auto"
+                else disagg_max_inflight_mb * (1 << 20)
                 if disagg_max_inflight_mb else None
             ),
             paged=paged,
@@ -380,6 +381,22 @@ def _last_json_line(log_dir: str) -> Optional[str]:
     return None
 
 
+def _inflight_mb(v: str):
+    """--disagg-max-inflight-mb value: an int MB count or 'auto' (the
+    collective planner sizes the hop). Range/type errors surface at
+    parse, before any model init -- the misplaced-flag discipline."""
+    if v == "auto":
+        return "auto"
+    try:
+        return int(v)
+    except ValueError:
+        import argparse as _argparse
+
+        raise _argparse.ArgumentTypeError(
+            f"expected an integer MB count or 'auto', got {v!r}"
+        ) from None
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     # allow_abbrev=False: --supervise is stripped by exact name before
     # re-exec (same recursion guard as bench.py).
@@ -427,10 +444,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "replay workload only",
     )
     ap.add_argument(
-        "--disagg-max-inflight-mb", type=int, default=None,
-        metavar="MB",
+        "--disagg-max-inflight-mb", type=_inflight_mb, default=None,
+        metavar="MB|auto",
         help="peak per-device transient allowed to a cross-tier KV "
-        "move (reshard max_inflight_bytes); default: unbounded",
+        "move (reshard max_inflight_bytes); 'auto' asks the "
+        "collective planner (tpu_hpc.comm.planner) for the chunk "
+        "that amortizes the cross-tier launch latency on this "
+        "topology's cost model; default: unbounded",
     )
     ap.add_argument(
         "--paged", action="store_true",
@@ -572,10 +592,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "--disagg"
         )
     if args.disagg_max_inflight_mb is not None \
+            and args.disagg_max_inflight_mb != "auto" \
             and args.disagg_max_inflight_mb < 1:
         ap.error(
             f"--disagg-max-inflight-mb {args.disagg_max_inflight_mb} "
-            "must be >= 1"
+            "must be >= 1 (or 'auto')"
         )
     # Paged sizing flags only mean something with --paged: a sizing
     # flag on a slab run silently doing nothing is exactly the

@@ -459,6 +459,7 @@ class Trainer:
         checkpoint_manager: Any = None,
         opt_param_pspecs: Any = None,
         eval_forward: Optional[EvalForwardFn] = None,
+        comm_plan: Any = None,
     ):
         """``opt_param_pspecs``: optional separate plan for deriving
         optimizer-state shardings (defaults to ``param_pspecs``). This
@@ -469,7 +470,14 @@ class Trainer:
         (models with train/eval behavior differences -- BatchNorm,
         dropout -- must supply one, e.g. resnet.make_eval_forward).
         Defaults to the training forward with state updates discarded,
-        which is exact for stateless models (llama, vit)."""
+        which is exact for stateless models (llama, vit).
+
+        ``comm_plan``: a pre-resolved planner decision
+        (comm.planner.CommDecision) for ``comm_mode="auto"`` --
+        callers that had to resolve the decision BEFORE building the
+        mesh (bench.py: the mode picks the mesh family) pass it here
+        so the trainer runs exactly that decision instead of
+        re-planning. Ignored unless cfg.comm_mode == "auto"."""
         self.cfg = cfg
         self.mesh = mesh
         self.forward = forward
@@ -648,9 +656,36 @@ class Trainer:
         # knob (pinned by the HLO no-creep test). Manual modes swap in
         # an explicit value_and_grad: per-shard grads inside shard_map
         # + bucketed (optionally two-phase ICI/DCN) reduction.
+        # "auto" asks the collective planner (comm/planner.py): the
+        # mode and bucket size come from the topology's measured cost
+        # table (alpha-beta fallback when none), the decision rides
+        # self.comm_plan and is logged as a schema-stamped comm_plan
+        # event below. Numerics are unchanged either way -- every
+        # candidate the planner may pick is step-identical to flat
+        # (the PR-3 parity pins, re-pinned for auto in
+        # tests/test_planner.py).
+        comm_mode_cfg = getattr(cfg, "comm_mode", "flat")
+        self.comm_plan = None
+        bucket_bytes = cfg.comm_bucket_mb * 2 ** 20
+        if comm_mode_cfg == "auto":
+            if comm_plan is not None:
+                self.comm_plan = comm_plan
+            else:
+                from tpu_hpc.comm.planner import (
+                    plan_trainer_grad_sync,
+                )
+
+                self.comm_plan = plan_trainer_grad_sync(
+                    mesh, batch_pspec, self.param_pspecs,
+                    self.state.params, bucket_cap_bytes=bucket_bytes,
+                )
+            comm_mode_cfg = self.comm_plan.mode
+            if self.comm_plan.bucket_bytes:
+                bucket_bytes = self.comm_plan.bucket_bytes
         comm_mode = validate_grad_sync_mode(
-            getattr(cfg, "comm_mode", "flat"), self.param_pspecs
+            comm_mode_cfg, self.param_pspecs
         )
+        self.comm_mode_resolved = comm_mode
         value_and_grad_fn = None
         if comm_mode != "flat":
             from tpu_hpc.comm import overlap
@@ -658,7 +693,7 @@ class Trainer:
             value_and_grad_fn = overlap.make_synced_value_and_grad(
                 forward, mesh, batch_pspec, self.state.params,
                 comm_mode,
-                bucket_bytes=cfg.comm_bucket_mb * 2 ** 20,
+                bucket_bytes=bucket_bytes,
             )
 
         self._step_impl = make_step_fn(
@@ -718,6 +753,16 @@ class Trainer:
         bus = obs.get_bus()
         if bus.flight_dir is None and cfg.checkpoint_dir:
             bus.flight_dir = cfg.checkpoint_dir
+        # The planner's comm_mode="auto" verdict, as evidence: which
+        # sync strategy this run actually trains under, predicted from
+        # which table (or the model) -- next to the epoch records it
+        # explains.
+        if self.comm_plan is not None:
+            self._append_metrics({
+                "event": "comm_plan",
+                "resolved_from": "auto",
+                **self.comm_plan.summary(),
+            })
         # Step-time watermark: flags stragglers/stalls (a ``stall``
         # event) and enriches the heartbeat so the supervisor can tell
         # hung from slow without attaching to the process.
