@@ -312,7 +312,8 @@ class TestGuardSkip:
         )
         metrics = str(tmp_path / "run.jsonl")
         tr = _make_trainer(
-            mesh8, str(tmp_path / "ck"), metrics, guard_mode="skip"
+            mesh8, str(tmp_path / "ck"), metrics, guard_mode="skip",
+            capture_on_anomaly=True,
         )
         res = tr.fit(LinearDS())
         assert int(jax.device_get(tr.state.step)) == 6
@@ -326,6 +327,17 @@ class TestGuardSkip:
         assert [(v["step"], v["verdict"], v["action"])
                 for v in verdicts] == [(3, "poisoned", "skip")]
         assert verdicts[0]["data_index"] == 3
+        # The symptom->evidence join (obs/trace.py): the verdict and
+        # the guard-triggered capture share the poisoned STEP's trace
+        # id, so the evidence bundle greps to the record that caused
+        # it.
+        assert verdicts[0]["trace_id"].endswith(":step:3")
+        caps = [
+            r for r in recs if r["event"] == "capture_triggered"
+        ]
+        assert len(caps) == 1
+        assert caps[0]["reason"] == "guard_poisoned"
+        assert caps[0]["trace_id"] == verdicts[0]["trace_id"]
         assert validate_file(metrics) > 0
 
     def test_skip_without_anomaly_is_bit_identical_and_same_compiles(

@@ -195,6 +195,42 @@ class TestRegistry:
         assert 'tpu_hpc_ttft{quantile="0.95"} 1.0' in text
         assert "tpu_hpc_ttft_count 1" in text
 
+    def test_exposition_format_contract(self, registry):
+        """The exposition-format contract: HELP precedes TYPE for
+        described metrics (escaped per the text format), histogram
+        summaries always carry _sum AND _count next to the
+        quantiles, and undescribed metrics emit TYPE only."""
+        registry.inc("reqs", 2, help="Requests served")
+        registry.set_gauge("depth", 3.0,
+                           help="Queue depth\nwith \\ tricky text")
+        registry.observe("lat_ms", 2.0, help="Latency (ms)")
+        registry.observe("lat_ms", 4.0)
+        registry.inc("plain")  # no description -> no HELP line
+        lines = registry.prometheus_text().splitlines()
+        idx = {ln: i for i, ln in enumerate(lines)}
+        assert "# HELP tpu_hpc_reqs Requests served" in idx
+        assert idx["# HELP tpu_hpc_reqs Requests served"] + 1 == (
+            idx["# TYPE tpu_hpc_reqs counter"]
+        )
+        # Escaping: newline -> \n, backslash -> \\ (one line each).
+        assert (
+            "# HELP tpu_hpc_depth Queue depth\\nwith \\\\ tricky text"
+            in idx
+        )
+        assert "# TYPE tpu_hpc_lat_ms summary" in idx
+        assert "tpu_hpc_lat_ms_sum 6.0" in idx
+        assert "tpu_hpc_lat_ms_count 2" in idx
+        assert 'tpu_hpc_lat_ms{quantile="0.5"} 3.0' in idx
+        assert 'tpu_hpc_lat_ms{quantile="0.99"}' in " ".join(lines)
+        assert not any(ln.startswith("# HELP tpu_hpc_plain")
+                       for ln in lines)
+        assert "# TYPE tpu_hpc_plain counter" in idx
+        # First description wins; re-describing is a no-op.
+        registry.describe("reqs", "changed")
+        assert "# HELP tpu_hpc_reqs Requests served" in (
+            registry.prometheus_text()
+        )
+
     def test_write_prometheus_atomic_and_env_gated(
         self, registry, tmp_path, monkeypatch
     ):

@@ -1,0 +1,872 @@
+"""tpu_hpc.obs.trace -- end-to-end causal tracing.
+
+Five invariant families:
+
+* **trace contexts** -- derived ids are pure in (run_id, kind, key),
+  ambient activation stamps every emit on the thread (explicit ids
+  win), and span durations come from the MONOTONIC clock (a wall-time
+  jump mid-span must not corrupt a phase share).
+* **complete traces** -- a seeded ``decode_heavy`` (speculative) and
+  ``shared_prefix`` (disagg-paged) loadgen run each yield a complete
+  per-request trace: every lifecycle event carries the request's
+  trace_id, the analyzer reconstructs with ZERO orphan spans, and the
+  critical path attributes >= 95% of TTFT to named phases -- with
+  zero engine recompiles from the propagation.
+* **fault attribution** -- an injected ``TPU_HPC_LOADGEN_FAULTS``
+  prefill delay produces a trace whose critical path names the
+  injected phase.
+* **anomaly capture** -- a stall (loadgen colocation theft, or the
+  trainer's injected straggler fault) auto-triggers EXACTLY ONE
+  bounded profiler capture + flight dump correlated by trace_id; a
+  clean run triggers none.
+* **schema discipline** -- the new ``trace_ctx``/``device_memory``/
+  ``capture_triggered`` kinds round-trip the validator, and a tier-1
+  lint walks the tree asserting every ``span(name)``/event kind used
+  in-source is registered in the canonical schema tables.
+"""
+import ast
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_hpc import obs
+from tpu_hpc.loadgen import LoadHarness, build_scenario, parse_faults
+from tpu_hpc.models import llama2
+from tpu_hpc.obs import schema as schema_mod
+from tpu_hpc.obs.regress import lower_is_better, report_metrics
+from tpu_hpc.obs.report import build_report
+from tpu_hpc.obs.schema import load_records, validate_record
+from tpu_hpc.obs.trace import (
+    AnomalyCapture,
+    activate,
+    analyze,
+    build_traces,
+    chrome_trace,
+    main as trace_main,
+    parse_trace_id,
+    request_trace_id,
+    step_trace_id,
+    trace_id_for,
+)
+from tpu_hpc.runtime import MeshSpec, build_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = llama2.LlamaConfig(
+    dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+    multiple_of=16, max_seq_len=256, dtype=jnp.float32,
+)
+MAX_PROMPT, MAX_NEW = 16, 6
+
+LIFECYCLE = (
+    "trace_ctx", "lg_arrival", "lg_admit", "lg_first_token",
+    "lg_finish", "lg_shed", "admission", "request",
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama2.init_llama(jax.random.key(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def slab_engine(tiny_params, devices):
+    from tpu_hpc.serve import Engine, ServeConfig
+
+    mesh = build_mesh(MeshSpec(axes={"data": 4, "model": 2}))
+    engine = Engine(
+        tiny_params, TINY,
+        ServeConfig(slots=4, max_seq_len=48, prefill_buckets=(8, 16)),
+        mesh,
+    )
+    engine.warmup()
+    return engine
+
+
+@pytest.fixture()
+def scoped_obs(tmp_path):
+    """Fresh bus + registry per test (the loadgen fixture
+    discipline); flight dir armed so capture dumps have a home."""
+    bus = obs.EventBus(path=None, run_id="trace-test",
+                       flight_dir=str(tmp_path))
+    reg = obs.MetricsRegistry()
+    prev_bus, prev_reg = obs.set_bus(bus), obs.set_registry(reg)
+    yield bus, reg
+    obs.set_bus(prev_bus)
+    obs.set_registry(prev_reg)
+
+
+def _scenario(name, seed=7, n=16):
+    return build_scenario(
+        name, seed=seed, n_requests=n, vocab_size=TINY.vocab_size,
+        max_prompt=MAX_PROMPT, max_new=MAX_NEW,
+    )
+
+
+def _run(engine, name, path, faults="", capture=None, n=16):
+    harness = LoadHarness(
+        engine, _scenario(name, n=n), metrics_path=str(path),
+        faults=parse_faults(faults), capture=capture,
+    )
+    return harness.run(n_devices=jax.device_count()), harness
+
+
+def _assert_complete_traces(path, expect_requests):
+    """The acceptance bundle: every lifecycle event trace-tagged,
+    zero orphan spans, >= 95% of TTFT attributed to named phases."""
+    records = load_records(str(path))
+    # Every per-request lifecycle event must carry its trace id.
+    # (Batch-level admission "queue" summaries name no request, so
+    # they carry none by design.)
+    life = [
+        r for r in records
+        if r["event"] in LIFECYCLE
+        and (r["event"] != "admission" or "rid" in r)
+    ]
+    assert life, "no lifecycle events in the run log"
+    missing = [r for r in life if "trace_id" not in r]
+    assert not missing, f"lifecycle events without trace_id: {missing[:3]}"
+    rep = analyze(records)
+    assert rep["orphan_spans"] == 0
+    req = rep["requests"]
+    assert req["count"] == expect_requests
+    assert req["complete"] + req["shed"] == expect_requests
+    for q in ("p50", "p95", "p99"):
+        cp = req["ttft_critical_path"][q]
+        assert cp["attributed"] >= 0.95, (q, cp)
+        assert cp["dominant"] in cp["phases_ms"]
+    return rep
+
+
+# ---------------------------------------------------------------------
+# trace ids + ambient activation
+# ---------------------------------------------------------------------
+class TestTraceContexts:
+    def test_ids_are_pure_and_parse(self, scoped_obs):
+        a = request_trace_id("r0001")
+        assert a == request_trace_id("r0001")
+        assert a == "trace-test:req:r0001"
+        assert parse_trace_id(a) == ("trace-test", "req", "r0001")
+        assert step_trace_id(42) == "trace-test:step:42"
+        run, kind, key = parse_trace_id(trace_id_for("tick", 7))
+        assert (kind, key) == ("tick", "7")
+        # Non-canonical ids degrade, not crash.
+        assert parse_trace_id("weird")[0] is None
+
+    def test_activate_stamps_ambient_and_nests(self, scoped_obs):
+        bus, _ = scoped_obs
+        tid = request_trace_id("rX")
+        with activate(tid):
+            rec = bus.emit("fault", kind="test")
+            assert rec["trace_id"] == tid
+            with activate("other:req:rY"):
+                assert bus.emit("fault", kind="t2")["trace_id"] == (
+                    "other:req:rY"
+                )
+            # restored after the nested block
+            assert bus.emit("fault", kind="t3")["trace_id"] == tid
+            # an explicit id always wins over the ambient one
+            assert bus.emit(
+                "fault", kind="t4", trace_id="explicit:req:z"
+            )["trace_id"] == "explicit:req:z"
+        assert "trace_id" not in bus.emit("fault", kind="t5")
+
+    def test_span_duration_survives_wall_clock_jump(
+        self, scoped_obs, monkeypatch
+    ):
+        """The satellite pin: durations come from the monotonic
+        clock. A wall-clock step (NTP slew) mid-span must not turn a
+        phase share negative -- and every span carries t_mono next to
+        the wall stamp."""
+        bus, _ = scoped_obs
+        import time as time_mod
+
+        real_time = time_mod.time
+        with obs.span("warmup", bus=bus, annotate=False):
+            # Wall clock jumps 1000 s BACKWARD mid-span.
+            monkeypatch.setattr(
+                time_mod, "time", lambda: real_time() - 1000.0
+            )
+        rec = list(bus.ring())[-1]
+        assert rec["event"] == "span" and rec["name"] == "warmup"
+        assert 0.0 <= rec["dur_s"] < 10.0
+        assert "t_mono" in rec
+        with obs.span("warmup", bus=bus, annotate=False):
+            pass
+        rec2 = list(bus.ring())[-1]
+        assert rec2["t_mono"] > rec["t_mono"]
+
+
+# ---------------------------------------------------------------------
+# schema: new kinds round-trip + the canonical-name lint
+# ---------------------------------------------------------------------
+class TestSchemaKinds:
+    def _roundtrip(self, tmp_path, rec):
+        rec = schema_mod.stamp(rec, run_id="r", host="h", pid=1)
+        validate_record(rec)
+        p = tmp_path / "k.jsonl"
+        p.write_text(json.dumps(rec) + "\n")
+        loaded = load_records(str(p))
+        assert loaded == [rec]
+
+    def test_trace_ctx_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, {
+            "event": "trace_ctx", "trace_id": "r:req:a", "kind": "req",
+            "key": "a", "tenant": "t", "t_wall": 1.0, "t_mono": 2.0,
+        })
+
+    def test_device_memory_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, {
+            "event": "device_memory", "hbm_peak_bytes": 123,
+            "n_devices": 4, "hbm_in_use_bytes": 7,
+            "hbm_limit_bytes": 999, "per_device": {"d0": {"peak": 1}},
+        })
+
+    def test_capture_triggered_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, {
+            "event": "capture_triggered", "reason": "stall",
+            "trace_id": "r:step:5", "step": 5, "n_steps": 2,
+            "profile_dir": "/p", "flight_path": "/f",
+        })
+
+    def test_new_kinds_stay_closed(self):
+        with pytest.raises(schema_mod.SchemaError, match="unknown"):
+            validate_record(schema_mod.stamp({
+                "event": "trace_ctx", "trace_id": "a", "kind": "req",
+                "key": "k", "bogus": 1,
+            }))
+
+
+def _literal_str(node):
+    return (
+        node.value
+        if isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        else None
+    )
+
+
+class TestSchemaNameLint:
+    """Every span name / event kind used in-tree must be registered
+    in the canonical schema tables -- silent namespace drift is how
+    telemetry cardinality explodes as subsystems grow."""
+
+    def _tree_calls(self):
+        for path in glob.glob(
+            os.path.join(REPO, "tpu_hpc", "**", "*.py"),
+            recursive=True,
+        ):
+            src = open(path).read()
+            tree = ast.parse(src, filename=path)
+            for node in ast.walk(tree):
+                yield path, node
+
+    def test_every_span_name_is_registered(self):
+        bad = []
+        for path, node in self._tree_calls():
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else ""
+            )
+            if name not in ("span", "emit_span", "_emit_span"):
+                continue
+            if not node.args:
+                continue
+            lit = _literal_str(node.args[0])
+            if lit is not None and lit not in schema_mod.SPANS:
+                bad.append((path, node.lineno, lit))
+        assert not bad, (
+            f"span names not in obs.schema.SPANS: {bad} -- register "
+            "them (with a description) or reuse a canonical name"
+        )
+
+    def test_every_emitted_kind_is_registered(self):
+        bad = []
+        for path, node in self._tree_calls():
+            lit = None
+            if isinstance(node, ast.Call):
+                func = node.func
+                name = (
+                    func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else ""
+                )
+                if name == "emit" and node.args:
+                    lit = _literal_str(node.args[0])
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if k is not None and _literal_str(k) == "event":
+                        lit = _literal_str(v)
+            if lit is not None and lit not in schema_mod.EVENTS:
+                bad.append((path, node.lineno, lit))
+        assert not bad, (
+            f"event kinds not in obs.schema.EVENTS: {bad}"
+        )
+
+    def test_span_table_documents_every_name(self):
+        for name, desc in schema_mod.SPANS.items():
+            assert desc and isinstance(desc, str), name
+
+
+# ---------------------------------------------------------------------
+# device-memory satellite
+# ---------------------------------------------------------------------
+class _FakeDevice:
+    def __init__(self, name, peak):
+        self._name, self._peak = name, peak
+
+    def memory_stats(self):
+        return {
+            "bytes_in_use": self._peak // 2,
+            "bytes_limit": 4 * self._peak,
+            "peak_bytes_in_use": self._peak,
+        }
+
+    def __str__(self):
+        return self._name
+
+
+class TestDeviceMemory:
+    def test_summary_emits_event_and_gauge(self, scoped_obs, tmp_path):
+        from tpu_hpc.profiling import device_memory_summary
+
+        bus, reg = scoped_obs
+        sink = str(tmp_path / "mem.jsonl")
+        stats = device_memory_summary(
+            devices=[_FakeDevice("d0", 100), _FakeDevice("d1", 300)],
+            emit=True, sink=sink,
+        )
+        assert set(stats) == {"d0", "d1"}
+        recs = load_records(sink)
+        assert len(recs) == 1 and recs[0]["event"] == "device_memory"
+        assert recs[0]["hbm_peak_bytes"] == 300
+        assert recs[0]["n_devices"] == 2
+        assert reg.gauge("hbm_peak_bytes") == 300.0
+        # The report's memory section and the regress namespace see it.
+        rep = build_report(recs)
+        assert rep["memory"]["hbm_peak_bytes"] == 300
+        flat = report_metrics(rep)
+        assert flat["memory.hbm_peak_bytes"] == 300.0
+        assert lower_is_better("memory.hbm_peak_bytes")
+
+    def test_no_stats_no_emit(self, scoped_obs):
+        from tpu_hpc.profiling import device_memory_summary
+
+        class NoStats:
+            def memory_stats(self):
+                return None
+
+        bus, reg = scoped_obs
+        assert device_memory_summary(devices=[NoStats()]) is None
+        assert reg.gauge("hbm_peak_bytes") is None
+
+
+# ---------------------------------------------------------------------
+# AnomalyCapture unit behavior
+# ---------------------------------------------------------------------
+class TestAnomalyCapture:
+    def test_one_shot_bundle_and_rearm(self, scoped_obs, tmp_path):
+        bus, _ = scoped_obs
+        cap = AnomalyCapture(str(tmp_path / "prof"), n_steps=2)
+        sink = str(tmp_path / "cap.jsonl")
+        rec = cap.trigger(
+            "stall", trace_id="trace-test:step:9", step=9, sink=sink
+        )
+        assert rec is not None and rec["event"] == "capture_triggered"
+        assert rec["trace_id"] == "trace-test:step:9"
+        assert rec["flight_path"] and os.path.exists(rec["flight_path"])
+        # The flight dump filename is keyed by the trace key.
+        assert ".9." in os.path.basename(rec["flight_path"])
+        # One-shot: an anomaly storm gets one bundle.
+        assert cap.trigger("stall", step=10, sink=sink) is None
+        assert cap.captures == 1 and not cap.armed
+        cap.step(11)
+        cap.close()
+        cap.rearm()
+        assert cap.armed
+        recs = load_records(sink)
+        kinds = [r["event"] for r in recs]
+        assert kinds.count("capture_triggered") == 1
+
+    def test_flight_dump_falls_back_to_capture_dir(self, tmp_path):
+        """--capture-dir promises flight evidence even when no
+        TPU_HPC_FLIGHT_DIR is armed: with an unconfigured bus, the
+        dump lands under the capture's own profile dir instead of
+        silently never happening."""
+        bus = obs.EventBus(path=None, run_id="nofd", flight_dir=None)
+        prev = obs.set_bus(bus)
+        try:
+            cap = AnomalyCapture(str(tmp_path / "prof"), n_steps=1)
+            rec = cap.trigger(
+                "stall", trace_id="nofd:tick:3", arm_profiler=False
+            )
+        finally:
+            obs.set_bus(prev)
+        assert rec["flight_path"]
+        assert rec["flight_path"].startswith(str(tmp_path / "prof"))
+        assert os.path.exists(rec["flight_path"])
+        assert ".3." in os.path.basename(rec["flight_path"])
+
+    def test_rearm_never_renumbers_into_old_bundle(
+        self, scoped_obs, tmp_path
+    ):
+        """Evidence must not clobber evidence: after a rearm, the
+        next capture's profiler dir continues the lifetime numbering
+        (capture2), never re-using capture1."""
+        cap = AnomalyCapture(str(tmp_path / "prof"), n_steps=1)
+        r1 = cap.trigger("stall", step=1)
+        cap.close()
+        cap.rearm()
+        r2 = cap.trigger("stall", step=2)
+        cap.close()
+        assert cap.captures == 2
+        dirs = {r["profile_dir"] for r in (r1, r2) if r["profile_dir"]}
+        assert len(dirs) == len(
+            [r for r in (r1, r2) if r["profile_dir"]]
+        ), (r1["profile_dir"], r2["profile_dir"])
+
+    def test_post_run_trigger_never_arms_a_profiler(
+        self, scoped_obs, tmp_path
+    ):
+        """arm_profiler=False (the SLO-breach-at-summary path): the
+        bundle is flight dump + memory snapshot only -- there are no
+        future steps to ever close a profiler window, so none may
+        open (a leaked open trace blocks every later start_trace in
+        the process)."""
+        cap = AnomalyCapture(str(tmp_path / "prof"), n_steps=4)
+        rec = cap.trigger("slo_breach", arm_profiler=False)
+        assert rec is not None
+        assert rec.get("profile_dir") is None
+        assert rec["n_steps"] == 0
+        assert cap._prof is None
+        assert rec["flight_path"]
+
+    def test_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="n_steps"):
+            AnomalyCapture(str(tmp_path), n_steps=0)
+        with pytest.raises(ValueError, match="max_captures"):
+            AnomalyCapture(str(tmp_path), max_captures=0)
+
+
+# ---------------------------------------------------------------------
+# analyzer units + CLI contract
+# ---------------------------------------------------------------------
+def _stamped(rec):
+    return schema_mod.stamp(rec, run_id="r", host="h", pid=1)
+
+
+class TestAnalyzer:
+    def test_orphan_spans_counted(self):
+        anchored = [
+            _stamped({"event": "lg_arrival", "rid": "a", "tenant": "t",
+                      "arrival_ms": 0.0, "trace_id": "r:req:a"}),
+            _stamped({"event": "span", "name": "prefill_chunk",
+                      "dur_s": 0.01, "trace_id": "r:req:a"}),
+            _stamped({"event": "span", "name": "prefill_chunk",
+                      "dur_s": 0.01, "trace_id": "r:req:GHOST"}),
+        ]
+        traces = build_traces(anchored)
+        assert traces["orphan_spans"] == 1
+        # Step spans are self-anchoring -- no lifecycle needed.
+        steps = [_stamped({
+            "event": "span", "name": "compute", "dur_s": 0.5,
+            "trace_id": "r:step:3", "step": 3,
+        })]
+        assert build_traces(steps)["orphan_spans"] == 0
+        rep = analyze(steps)
+        assert rep["steps"]["count"] == 1
+        assert rep["steps"]["critical_path"]["p95"]["dominant"] == (
+            "compute"
+        )
+
+    def test_json_cli_contract(self, tmp_path, capsys):
+        p = tmp_path / "run.jsonl"
+        recs = [
+            _stamped({"event": "trace_ctx", "trace_id": "r:req:a",
+                      "kind": "req", "key": "a"}),
+            _stamped({"event": "lg_arrival", "rid": "a", "tenant": "t",
+                      "arrival_ms": 0.0, "trace_id": "r:req:a"}),
+            _stamped({"event": "lg_admit", "rid": "a", "tenant": "t",
+                      "queue_ms": 1.0, "trace_id": "r:req:a"}),
+            _stamped({"event": "lg_first_token", "rid": "a",
+                      "tenant": "t", "ttft_ms": 5.0,
+                      "trace_id": "r:req:a"}),
+            _stamped({"event": "lg_finish", "rid": "a", "tenant": "t",
+                      "tokens": 3, "total_ms": 9.0,
+                      "trace_id": "r:req:a"}),
+        ]
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        chrome = tmp_path / "chrome.json"
+        rc = trace_main([str(p), "--json", "--chrome", str(chrome)])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        # The --json contract other drivers key on.
+        for key in ("schema_version", "run_id", "n_records",
+                    "orphan_spans", "requests", "steps", "captures"):
+            assert key in out, key
+        assert out["schema_version"] == schema_mod.SCHEMA_VERSION
+        assert out["orphan_spans"] == 0
+        req = out["requests"]
+        assert req["count"] == 1 and req["complete"] == 1
+        for q in ("p50", "p95", "p99"):
+            assert q in req["ttft_ms"]
+            cp = req["ttft_critical_path"][q]
+            for key in ("rid", "ttft_ms", "phases_ms", "shares",
+                        "dominant", "attributed"):
+                assert key in cp, key
+        ct = json.loads(chrome.read_text())
+        assert ct["traceEvents"], "empty chrome trace"
+        phases = [e["name"] for e in ct["traceEvents"]
+                  if e.get("ph") == "X"]
+        assert "queue" in phases and "decode" in phases
+
+    def test_cli_rejects_missing_and_empty(self, tmp_path, capsys):
+        assert trace_main([str(tmp_path / "nope.jsonl")]) == 2
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert trace_main([str(empty)]) == 2
+
+    def test_cli_merges_flight_dumps(self, scoped_obs, tmp_path,
+                                     capsys):
+        bus, _ = scoped_obs
+        with activate("trace-test:step:1"):
+            obs.emit_span("compute", 0.25, bus=bus, step=1)
+        bus.dump_flight("merge_test")
+        run = tmp_path / "run.jsonl"
+        run.write_text(json.dumps(_stamped({
+            "event": "span", "name": "ckpt", "dur_s": 0.01,
+            "trace_id": "trace-test:step:1",
+        })) + "\n")
+        rc = trace_main([
+            str(run), "--flight-dir", str(tmp_path), "--json",
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        # The ring's compute span merged with the sink's ckpt span
+        # into ONE step trace.
+        cp = out["steps"]["critical_path"]["p95"]
+        assert set(cp["phases_ms"]) == {"compute", "ckpt"}
+
+    def test_merge_dedupes_sink_and_flight_copies(
+        self, scoped_obs, tmp_path, capsys
+    ):
+        """The bus writes ONE stamped record to both the sink and the
+        flight ring; merging a run log with its dumps must not count
+        that record twice (doubled span durations would corrupt every
+        phase share). Two dumps of the same ring must not triple it."""
+        bus, _ = scoped_obs
+        run = tmp_path / "run.jsonl"
+        with activate("trace-test:step:5"):
+            obs.emit_span(
+                "compute", 0.5, bus=bus, step=5, sink=str(run)
+            )
+        bus.dump_flight("dedup_a")
+        bus.dump_flight("dedup_b")
+        rc = trace_main([
+            str(run), "--flight-dir", str(tmp_path), "--json",
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        cp = out["steps"]["critical_path"]["p95"]
+        assert cp["phases_ms"] == {"compute": 500.0}
+
+
+# ---------------------------------------------------------------------
+# loadgen end to end: complete traces, fault attribution, capture
+# ---------------------------------------------------------------------
+class TestLoadgenTraces:
+    def test_steady_run_has_complete_traces(
+        self, slab_engine, scoped_obs, tmp_path
+    ):
+        path = tmp_path / "steady.jsonl"
+        _run(slab_engine, "steady", path)
+        rep = _assert_complete_traces(path, 16)
+        assert rep["captures"] == []
+
+    def test_injected_fault_names_the_phase(
+        self, slab_engine, scoped_obs, tmp_path
+    ):
+        """The sim-mesh smoke: a prefill_delay fault must surface as
+        the critical path naming prefill -- the analyzer turns the
+        injected latency into an attributed, named phase."""
+        clean = tmp_path / "clean.jsonl"
+        _run(slab_engine, "steady", clean)
+        clean_rep = analyze(load_records(str(clean)))
+        faulted = tmp_path / "faulted.jsonl"
+        _run(slab_engine, "steady", faulted, faults="prefill_delay=6")
+        rep = _assert_complete_traces(faulted, 16)
+        cp = rep["requests"]["ttft_critical_path"]["p50"]
+        assert cp["dominant"] == "prefill", cp
+        grew = (
+            rep["requests"]["phase_totals_ms"]["prefill"]
+            / clean_rep["requests"]["phase_totals_ms"]["prefill"]
+        )
+        assert grew > 4.0, grew
+
+    def test_stall_triggers_exactly_one_capture(
+        self, slab_engine, scoped_obs, tmp_path
+    ):
+        """Colocation theft trips the stall watermark -> exactly one
+        bounded profiler capture + flight dump, correlated by the
+        triggering tick's trace id."""
+        cap = AnomalyCapture(str(tmp_path / "prof"), n_steps=3)
+        path = tmp_path / "colocate.jsonl"
+        summary, harness = _run(
+            slab_engine, "colocate", path, capture=cap, n=24
+        )
+        assert summary["stall_events"] >= 1
+        assert cap.captures == 1
+        # The summary is the join point banked rows and on-disk
+        # evidence must agree on.
+        assert summary["captures"] == 1
+        recs = load_records(str(path))
+        caps = [
+            r for r in recs if r["event"] == "capture_triggered"
+        ]
+        assert len(caps) == 1
+        cap_rec = caps[0]
+        assert cap_rec["reason"] == "stall"
+        # Correlation: the capture is keyed by a stall event's trace.
+        stall_tids = {
+            r["trace_id"] for r in recs if r["event"] == "stall"
+        }
+        assert cap_rec["trace_id"] in stall_tids
+        assert os.path.exists(cap_rec["flight_path"])
+        if cap_rec.get("profile_dir"):
+            assert os.path.isdir(cap_rec["profile_dir"])
+        # The bounded window closed by itself (no leaked trace).
+        assert cap._prof is None
+        # The analyzer surfaces the capture next to the timelines.
+        rep = analyze(recs)
+        assert [c["reason"] for c in rep["captures"]] == ["stall"]
+
+    def test_clean_run_never_captures(
+        self, slab_engine, scoped_obs, tmp_path
+    ):
+        cap = AnomalyCapture(str(tmp_path / "prof"), n_steps=3)
+        path = tmp_path / "clean.jsonl"
+        summary, _ = _run(slab_engine, "steady", path, capture=cap)
+        assert cap.captures == 0
+        assert summary["captures"] == 0
+        recs = load_records(str(path))
+        assert not [
+            r for r in recs if r["event"] == "capture_triggered"
+        ]
+
+
+# ---------------------------------------------------------------------
+# the two acceptance engines: speculative + disagg-paged
+# ---------------------------------------------------------------------
+class TestSpecAndDisaggTraces:
+    def test_decode_heavy_spec_trace_complete_zero_recompiles(
+        self, tiny_params, scoped_obs, tmp_path, devices
+    ):
+        from tpu_hpc.serve import (
+            PagedConfig, PagedEngine, ServeConfig, SpecConfig,
+            attach_spec,
+        )
+
+        mesh = build_mesh(MeshSpec(axes={"data": 4, "model": 2}))
+        engine = PagedEngine(
+            tiny_params, TINY,
+            ServeConfig(slots=4, max_seq_len=48,
+                        prefill_buckets=(8, 16)),
+            mesh,
+            PagedConfig(block_size=4, num_blocks=48, prefill_chunk=8),
+        )
+        attach_spec(engine, SpecConfig(mode="ngram", k=3))
+        engine.warmup()
+        before = engine.compile_count_total
+        path = tmp_path / "decode_heavy.jsonl"
+        summary, _ = _run(engine, "decode_heavy", path)
+        # Trace propagation must not cost a single recompile.
+        assert engine.compile_count_total == before
+        assert summary["spec_mode"] == "ngram"
+        rep = _assert_complete_traces(path, 16)
+        assert rep["requests"]["complete"] == 16
+
+    def test_shared_prefix_disagg_paged_trace_complete(
+        self, tiny_params, scoped_obs, tmp_path, devices
+    ):
+        from tpu_hpc.serve import (
+            DisaggEngine, PagedConfig, ServeConfig,
+            split_serving_meshes,
+        )
+
+        small = llama2.LlamaConfig(
+            dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            vocab_size=128, multiple_of=16, max_seq_len=64,
+            dtype=jnp.float32,
+        )
+        pm, dm = split_serving_meshes(8, small)
+        engine = DisaggEngine(
+            tiny_params, small,
+            ServeConfig(slots=4, max_seq_len=48,
+                        prefill_buckets=(8, 16)),
+            pm, dm,
+            paged=PagedConfig(block_size=4, num_blocks=48,
+                              prefill_chunk=8),
+        )
+        engine.warmup()
+        before = engine.compile_count
+        path = tmp_path / "shared_prefix.jsonl"
+        summary, _ = _run(engine, "shared_prefix", path)
+        assert engine.compile_count == before
+        assert summary["prefix_hit_rate"] > 0.0
+        _assert_complete_traces(path, 16)
+        # Ring-only detail (engine spans, kv_block page events, the
+        # disagg kv hop) joined the traces ambiently.
+        bus, _ = scoped_obs
+        ring = list(bus.ring())
+        tagged_kv = [
+            e for e in ring
+            if e.get("event") == "kv_block" and "trace_id" in e
+        ]
+        assert tagged_kv, "kv_block ring events lost their trace ids"
+        hop = [
+            e for e in ring
+            if e.get("event") == "span"
+            and e.get("name") == "kv_transfer"
+        ]
+        assert hop and all("trace_id" in e for e in hop), (
+            "the disagg KV hop must join the request trace"
+        )
+
+
+# ---------------------------------------------------------------------
+# server CLI: the misplaced-flag discipline for --capture-dir
+# ---------------------------------------------------------------------
+class TestServerCaptureFlag:
+    def test_capture_dir_requires_loadgen(self, capsys):
+        from tpu_hpc.serve import server
+
+        with pytest.raises(SystemExit):
+            server.main(["--capture-dir", "/tmp/x"])
+        assert "--loadgen" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# trainer: step traces + straggler-fault capture
+# ---------------------------------------------------------------------
+def _forward(params, model_state, batch, step_rng):
+    x, y = batch
+    pred = x @ params["w"]
+    return jnp.mean((pred - y) ** 2), model_state, {}
+
+
+class _LinearDS:
+    def batch_at(self, step, bs):
+        k = jax.random.key(int(step) % 97)
+        x = jax.random.normal(k, (bs, 4), jnp.float32)
+        return x, x @ jnp.arange(4.0)
+
+
+class TestTrainerCapture:
+    def _fit(self, tmp_path, monkeypatch, faults=None,
+             stall_factor=None):
+        from tpu_hpc.config import TrainingConfig
+        from tpu_hpc.train import Trainer
+
+        if faults:
+            monkeypatch.setenv("TPU_HPC_FAULTS", faults)
+        else:
+            monkeypatch.delenv("TPU_HPC_FAULTS", raising=False)
+        metrics = str(tmp_path / "run.jsonl")
+        mesh1 = build_mesh(
+            MeshSpec(axes={"data": 1}), devices=jax.devices()[:1]
+        )
+        cfg = TrainingConfig(
+            epochs=9, steps_per_epoch=1, global_batch_size=8,
+            learning_rate=1e-2, metrics_path=metrics,
+            capture_on_anomaly=True, capture_steps=2,
+            profile_dir=str(tmp_path / "prof"),
+        )
+        tr = Trainer(
+            cfg, mesh1, _forward,
+            {"w": jnp.zeros((4,), jnp.float32)},
+        )
+        if stall_factor is not None:
+            # Deterministic clean run: millisecond chunks on a busy
+            # CI host can legitimately breach the default 3x
+            # watermark on scheduler noise alone; a huge factor pins
+            # "no stall => no capture" without depending on machine
+            # quiet.
+            tr.stall = obs.StallDetector(factor=stall_factor)
+        tr.fit(_LinearDS())
+        return tr, load_records(metrics)
+
+    def test_straggler_fault_triggers_one_capture(
+        self, tmp_path, monkeypatch, scoped_obs
+    ):
+        tr, recs = self._fit(
+            tmp_path, monkeypatch,
+            faults="straggler_ms=400,straggler_at_step=7,on_attempt=-1",
+        )
+        stalls = [r for r in recs if r["event"] == "stall"]
+        assert stalls and all("trace_id" in r for r in stalls)
+        caps = [
+            r for r in recs if r["event"] == "capture_triggered"
+        ]
+        assert len(caps) == 1, (
+            "exactly one capture per run (one-shot latch)"
+        )
+        cap = caps[0]
+        assert cap["trace_id"] == stalls[0]["trace_id"]
+        assert os.path.exists(cap["flight_path"])
+        # Trainer phase spans carry per-step trace ids and the
+        # analyzer reconstructs step timelines from them.
+        rep = analyze(recs)
+        assert rep["orphan_spans"] == 0
+        steps = rep["steps"]
+        assert steps["count"] >= 8
+        # The straggler chunks (step >= 7, the injected 400 ms sleep)
+        # must show up as step traces whose critical path names
+        # compute -- the sleep lands inside the metered compute
+        # window by design (the chaos-matrix contract). Pinned on the
+        # specific chunks, not the p99 pick: first-chunk compile time
+        # can legitimately be the run's slowest step.
+        traces = build_traces(recs)
+        strag = [
+            st for st in traces["steps"].values()
+            if st.step >= 7 and st.wall_ms > 300
+        ]
+        assert strag, "injected straggler chunks missing from traces"
+        for st in strag:
+            assert st.breakdown()["dominant"] == "compute"
+        # The capture window closed with the run (no leaked trace).
+        assert tr.capture is not None and tr.capture._prof is None
+
+    def test_bad_capture_steps_fails_at_construction(self, devices):
+        """The fail-at-construction discipline: a degenerate
+        capture_steps must not survive until a mid-fit traceback
+        after full bring-up."""
+        from tpu_hpc.config import TrainingConfig
+        from tpu_hpc.train import Trainer
+
+        mesh1 = build_mesh(
+            MeshSpec(axes={"data": 1}), devices=jax.devices()[:1]
+        )
+        cfg = TrainingConfig(
+            epochs=1, steps_per_epoch=1, global_batch_size=8,
+            capture_on_anomaly=True, capture_steps=0,
+        )
+        with pytest.raises(ValueError, match="capture_steps"):
+            Trainer(
+                cfg, mesh1, _forward,
+                {"w": jnp.zeros((4,), jnp.float32)},
+            )
+
+    def test_clean_run_no_capture(
+        self, tmp_path, monkeypatch, scoped_obs
+    ):
+        tr, recs = self._fit(tmp_path, monkeypatch, stall_factor=1e6)
+        assert not [
+            r for r in recs if r["event"] == "capture_triggered"
+        ]
+        assert tr.capture is not None and tr.capture.captures == 0

@@ -118,6 +118,16 @@ class TrainingConfig:
     profile_dir: str = "profiles"
     profile_start_step: int = 3
     profile_num_steps: int = 5
+    # Anomaly-triggered capture (obs/trace.py): when the stall
+    # watermark trips or the numeric-health guard classifies a
+    # poisoned step, auto-arm ONE bounded jax.profiler trace covering
+    # the next capture_steps steps plus a correlated flight-ring dump
+    # and device-memory snapshot, all keyed by the triggering step's
+    # trace id. Evidence lands under <checkpoint_dir or profile_dir>/
+    # anomaly. Off by default: it shares the single jax.profiler
+    # slot with `profile`.
+    capture_on_anomaly: bool = False
+    capture_steps: int = 2
 
     # Gradient-sync strategy (the comm-performance layer,
     # tpu_hpc.comm): "flat" = GSPMD's fused collectives (the default,

@@ -32,7 +32,15 @@ from __future__ import annotations
 import os
 from typing import Callable, Dict, List, Optional
 
-from tpu_hpc.obs import StallDetector, emit_span, get_bus, get_registry
+from tpu_hpc.obs import (
+    AnomalyCapture,
+    StallDetector,
+    emit_span,
+    get_bus,
+    get_registry,
+    request_trace_id,
+    trace_id_for,
+)
 from tpu_hpc.obs.quantiles import quantile
 from tpu_hpc.serve.metrics import ServeMeter
 from tpu_hpc.serve.scheduler import AdmissionPolicy, ContinuousBatcher
@@ -220,7 +228,8 @@ class LoadMeter(ServeMeter):
             self.queued_by[tenant] = self.queued_by.get(tenant, 0) + 1
         get_bus().emit(
             "lg_admit", sink=self.metrics_path,
-            rid=rid, tenant=tenant, queue_ms=queue_ms,
+            rid=rid, trace_id=self.trace_ids.get(rid),
+            tenant=tenant, queue_ms=queue_ms,
             prefill_tokens=prefill_tokens, queued=queued,
         )
 
@@ -233,14 +242,20 @@ class LoadMeter(ServeMeter):
             self.ttft_ms.setdefault(tenant, []).append(ttft_ms)
             get_bus().emit(
                 "lg_first_token", sink=self.metrics_path,
-                rid=rid, tenant=tenant, ttft_ms=ttft_ms,
+                rid=rid, trace_id=self.trace_ids.get(rid),
+                tenant=tenant, ttft_ms=ttft_ms,
             )
         else:
             itl = 1e3 * (trace.token_times[-1] - trace.token_times[-2])
             self.itl_ms.setdefault(tenant, []).append(itl)
             # Ring-only (no sink): per-token cadence at decode rate is
-            # flight-recorder forensics, not per-run sink volume.
-            get_bus().emit("lg_token", rid=rid, itl_ms=itl)
+            # flight-recorder forensics, not per-run sink volume --
+            # but it still carries the trace id, so a flight dump's
+            # token cadence joins the request timeline.
+            get_bus().emit(
+                "lg_token", rid=rid,
+                trace_id=self.trace_ids.get(rid), itl_ms=itl,
+            )
 
     def finished(self, rid: str) -> None:
         trace = self.traces[rid]
@@ -249,7 +264,8 @@ class LoadMeter(ServeMeter):
         self.finished_by[tenant] = self.finished_by.get(tenant, 0) + 1
         get_bus().emit(
             "lg_finish", sink=self.metrics_path,
-            rid=rid, tenant=tenant, tokens=len(trace.token_times),
+            rid=rid, trace_id=self.trace_ids.get(rid),
+            tenant=tenant, tokens=len(trace.token_times),
             total_ms=1e3 * (trace.t_done - trace.t_submit),
         )
 
@@ -259,7 +275,9 @@ class LoadMeter(ServeMeter):
         self.shed_by[tenant] = self.shed_by.get(tenant, 0) + 1
         get_bus().emit(
             "lg_shed", sink=self.metrics_path,
-            rid=rid, tenant=tenant, reason=reason,
+            rid=rid,
+            trace_id=self.trace_ids.get(rid, request_trace_id(rid)),
+            tenant=tenant, reason=reason,
         )
 
 
@@ -277,9 +295,14 @@ class LoadHarness:
         policy: Optional[AdmissionPolicy] = None,
         stall_factor: float = 3.0,
         faults: Optional[Dict[str, float]] = None,
+        capture: Optional[AnomalyCapture] = None,
     ):
         self.scenario = scenario
         self.metrics_path = metrics_path
+        # Anomaly-triggered capture (obs/trace.py): a stall-watermark
+        # trip or an SLO breach fires ONE bounded profiler trace +
+        # flight dump keyed by the triggering trace id. None = off.
+        self.capture = capture
         self.clock = VirtualClock()
         self.engine = _CostModelEngine(
             engine, self.clock, decode_step_ms, prefill_ms_per_token,
@@ -322,7 +345,8 @@ class LoadHarness:
         self.meter.tenant_of[lr.rid] = lr.tenant
         get_bus().emit(
             "lg_arrival", sink=self.metrics_path,
-            rid=lr.rid, tenant=lr.tenant,
+            rid=lr.rid, trace_id=request_trace_id(lr.rid),
+            tenant=lr.tenant,
             arrival_ms=lr.arrival_ms,
             prompt_len=len(lr.prompt),
             max_new_tokens=lr.max_new_tokens,
@@ -337,8 +361,6 @@ class LoadHarness:
         bus = get_bus()
         bus.emit("load_scenario", sink=self.metrics_path, **sc.header())
         arrivals = list(sc.requests)  # already arrival-sorted
-        i = 0
-        tick = 0
         if max_ticks is not None:
             budget = max_ticks
         else:
@@ -350,6 +372,19 @@ class LoadHarness:
                 from tpu_hpc.serve.scheduler import paged_drain_bound
 
                 budget += paged_drain_bound(self.engine, arrivals)
+        try:
+            self._drive_loop(arrivals, budget, tick_cb)
+        finally:
+            if self.capture is not None:
+                # A capture window still open when the drive ends (or
+                # aborts on the budget) must not leak its profiler
+                # trace.
+                self.capture.close()
+
+    def _drive_loop(self, arrivals, budget, tick_cb) -> None:
+        sc = self.scenario
+        i = 0
+        tick = 0
         while i < len(arrivals) or not self.batcher.done:
             # A request is "queued" iff it was submitted before this
             # iteration began -- stamp the boundary BEFORE this
@@ -407,10 +442,20 @@ class LoadHarness:
                 - (self.engine.prefill_charged_s - prefill_before)
             )
             if self.batcher.stats["decode_steps"] > decode_before:
+                tick_tid = trace_id_for("tick", tick)
                 info = self.detector.observe(
-                    tick, tick_s, sink=self.metrics_path
+                    tick, tick_s, sink=self.metrics_path,
+                    trace_id=tick_tid,
                 )
                 self._stalled = info is not None
+                if self._stalled and self.capture is not None:
+                    # Symptom -> evidence, keyed by the tick trace
+                    # that breached the watermark. One-shot: a stall
+                    # storm yields one clean bundle.
+                    self.capture.trigger(
+                        "stall", trace_id=tick_tid, step=tick,
+                        sink=self.metrics_path,
+                    )
             else:
                 # A tick with NO decode step (chunked prefill still
                 # filling every active slot, or an admission-only
@@ -422,6 +467,10 @@ class LoadHarness:
                 # tick only; clear it.
                 self._stalled = False
             self._occupancy.append(self.batcher.occupancy)
+            if self.capture is not None:
+                # Advance (and eventually close) the bounded capture
+                # window on the tick axis.
+                self.capture.step(tick)
             if tick_cb is not None:
                 tick_cb(tick)
             tick += 1
@@ -441,6 +490,11 @@ class LoadHarness:
         m = self.meter
         tenants = {}
         slo_violations: List[str] = []
+        # The violating tenant NAMES, kept next to the composite
+        # "<tenant>.<metric>" strings -- the capture trigger below
+        # must not re-parse them (a tenant name containing '.' would
+        # truncate).
+        violated_tenants: List[str] = []
         for t in self.scenario.tenants:
             ttfts = sorted(m.ttft_ms.get(t.name, []))
             itls = sorted(m.itl_ms.get(t.name, []))
@@ -477,6 +531,8 @@ class LoadHarness:
                 entry["slo"] = dict(t.slo)
                 entry["slo_violated"] = violated
                 slo_violations += [f"{t.name}.{k}" for k in violated]
+                if violated:
+                    violated_tenants.append(t.name)
             tenants[t.name] = entry
         occ = sorted(self._occupancy)
         # The cache layout is part of the run's identity (a paged
@@ -517,6 +573,24 @@ class LoadHarness:
         )
         if extra:
             summary.update(extra)
+        if slo_violations and self.capture is not None:
+            # SLO breach is the third capture trigger: the run is
+            # over (drive()'s finally already closed the bounded
+            # window), so no profiler is armed -- there are no future
+            # steps to bound or ever close one. The flight dump +
+            # device-memory snapshot still preserve the evidence
+            # trail, keyed by the first violated tenant's class.
+            self.capture.trigger(
+                "slo_breach",
+                trace_id=trace_id_for("tenant", violated_tenants[0]),
+                sink=self.metrics_path,
+                arm_profiler=False,
+            )
+        if self.capture is not None:
+            # AFTER the SLO trigger above, so an SLO-breach-only
+            # capture is counted -- the summary is the join point the
+            # banked rows and the on-disk evidence must agree on.
+            summary["captures"] = self.capture.captures
         self.meter.write_summary(summary)
         get_registry().emit_snapshot(sink=self.metrics_path)
         return summary

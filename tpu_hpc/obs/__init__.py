@@ -17,6 +17,13 @@ schema-stamped JSONL discipline:
                flagging; feeds the heartbeat file).
   quantiles.py the one quantile estimator (numpy-parity linear
                interpolation) every latency number comes from.
+  trace.py     end-to-end causal tracing: run_id-scoped trace
+               contexts stamped into every span/event, the
+               ``python -m tpu_hpc.obs.trace`` critical-path analyzer
+               (TTFT/step decomposition + Chrome-trace export), and
+               anomaly-triggered capture (stall/guard/SLO trip ->
+               bounded profiler trace + flight dump, keyed by
+               trace_id).
   report.py    ``python -m tpu_hpc.obs.report run.jsonl`` -- goodput /
                MFU / step-time-breakdown report from a run's JSONL.
   regress.py   ``python -m tpu_hpc.obs.regress base.jsonl cand.jsonl``
@@ -52,7 +59,32 @@ from tpu_hpc.obs.schema import (  # noqa: F401
 from tpu_hpc.obs.spans import emit_span, span  # noqa: F401
 from tpu_hpc.obs.stall import StallDetector  # noqa: F401
 
+# trace.py exports are lazy (PEP 562): eagerly importing the module
+# here would make ``python -m tpu_hpc.obs.trace`` re-execute it under
+# runpy with a sys.modules warning. ``from tpu_hpc.obs import
+# activate`` etc. still work -- module __getattr__ covers from-imports.
+_TRACE_EXPORTS = (
+    "AnomalyCapture",
+    "TraceContext",
+    "activate",
+    "request_trace_id",
+    "step_trace_id",
+    "trace_id_for",
+)
+
+
+def __getattr__(name):
+    if name in _TRACE_EXPORTS:
+        from tpu_hpc.obs import trace
+
+        return getattr(trace, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+
 __all__ = [
+    "AnomalyCapture",
     "ENV_EVENTS",
     "ENV_FLIGHT_DIR",
     "ENV_PROM_FILE",
@@ -62,16 +94,21 @@ __all__ = [
     "SCHEMA_VERSION",
     "SchemaError",
     "StallDetector",
+    "TraceContext",
+    "activate",
     "dump_flight",
     "emit_span",
     "get_bus",
     "get_registry",
     "quantile",
+    "request_trace_id",
     "set_bus",
     "set_registry",
     "span",
     "stamp",
+    "step_trace_id",
     "summarize",
+    "trace_id_for",
     "validate_file",
     "validate_record",
 ]

@@ -47,6 +47,21 @@ ENV_FLIGHT_DIR = "TPU_HPC_FLIGHT_DIR"
 
 DEFAULT_RING_SIZE = 512
 
+# Ambient trace context (obs/trace.py activate()): while a trace is
+# active on a thread, every emit on that thread is stamped with its
+# trace_id -- so a scheduler that activates a request's context around
+# an engine call gets the engine's internal spans and kv_block events
+# correlated for free, without threading the id through every layer.
+# Lives HERE (not in trace.py) so the per-emit lookup is one
+# thread-local getattr with no import indirection on the hot path.
+_TRACE = threading.local()
+
+
+def current_trace_id() -> Optional[str]:
+    """The thread's active trace id, or None."""
+    return getattr(_TRACE, "trace_id", None)
+
+
 _hostname: Optional[str] = None
 
 
@@ -119,6 +134,13 @@ class EventBus:
         rec = stamp(
             record, run_id=self.run_id, host=_host(), pid=os.getpid()
         )
+        # Ambient trace stamping: an explicit trace_id always wins; a
+        # record emitted while a trace is active on this thread joins
+        # it. One thread-local read -- ring-only hot paths stay cheap.
+        if "trace_id" not in rec:
+            tid = current_trace_id()
+            if tid is not None:
+                rec["trace_id"] = tid
         with self._lock:
             self._ring.append(rec)
         # File I/O happens OUTSIDE the ring lock: a sink on a hung

@@ -53,23 +53,44 @@ class MetricsRegistry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Deque[float]] = {}
+        self._help: Dict[str, str] = {}
         self._lock = threading.Lock()
 
     # -- writes --------------------------------------------------------
-    def inc(self, name: str, value: float = 1.0) -> None:
+    def describe(self, name: str, help: str) -> None:
+        """Attach HELP text to a metric (the Prometheus exposition
+        emits it as a ``# HELP`` line). First description wins --
+        producers re-describing on a hot path pay one dict lookup."""
+        with self._lock:
+            self._help.setdefault(name, help)
+
+    def inc(
+        self, name: str, value: float = 1.0,
+        help: Optional[str] = None,
+    ) -> None:
         if value < 0:
             raise ValueError(
                 f"counter {name!r} increment {value} must be >= 0 "
                 "(use a gauge for values that go down)"
             )
+        if help is not None:
+            self.describe(name, help)
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + value
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(
+        self, name: str, value: float, help: Optional[str] = None,
+    ) -> None:
+        if help is not None:
+            self.describe(name, help)
         with self._lock:
             self._gauges[name] = float(value)
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(
+        self, name: str, value: float, help: Optional[str] = None,
+    ) -> None:
+        if help is not None:
+            self.describe(name, help)
         with self._lock:
             hist = self._hists.get(name)
             if hist is None:
@@ -83,6 +104,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._help.clear()
 
     # -- reads ---------------------------------------------------------
     def counter(self, name: str) -> float:
@@ -133,19 +155,33 @@ class MetricsRegistry:
     # -- Prometheus text exposition ------------------------------------
     def prometheus_text(self, prefix: str = "tpu_hpc") -> str:
         """Counters/gauges as their native types; histograms as
-        summaries (p50/p95 quantiles + _sum/_count)."""
+        summaries (p50/p95/p99 quantiles + _sum/_count). Described
+        metrics get a ``# HELP`` line ahead of ``# TYPE`` (exposition
+        format: HELP text escapes ``\\`` and newlines) -- a scrape
+        surface an operator can read without the source."""
         snap = self.snapshot()
+        with self._lock:
+            helps = dict(self._help)
+
+        def head(name: str, m: str, kind: str) -> list:
+            out = []
+            text = helps.get(name)
+            if text:
+                text = text.replace("\\", "\\\\").replace("\n", "\\n")
+                out.append(f"# HELP {m} {text}")
+            out.append(f"# TYPE {m} {kind}")
+            return out
+
         lines = []
         for name, val in sorted(snap["counters"].items()):
             m = f"{prefix}_{_sanitize(name)}"
-            lines += [f"# TYPE {m} counter", f"{m} {val}"]
+            lines += head(name, m, "counter") + [f"{m} {val}"]
         for name, val in sorted(snap["gauges"].items()):
             m = f"{prefix}_{_sanitize(name)}"
-            lines += [f"# TYPE {m} gauge", f"{m} {val}"]
+            lines += head(name, m, "gauge") + [f"{m} {val}"]
         for name, s in sorted(snap["histograms"].items()):
             m = f"{prefix}_{_sanitize(name)}"
-            lines += [
-                f"# TYPE {m} summary",
+            lines += head(name, m, "summary") + [
                 f'{m}{{quantile="0.5"}} {s["p50"]}',
                 f'{m}{{quantile="0.95"}} {s["p95"]}',
                 f'{m}{{quantile="0.99"}} {s["p99"]}',

@@ -86,6 +86,10 @@ _LOWER_IS_BETTER = (
     "wire_bytes", "inflight", "rejected",
     "rollback", "fallback", "poisoned", "spike", "skipped",
     "lost_steps", "integrity_fail", "nonfinite",
+    # HBM high-water mark (the device_memory events): a higher peak
+    # at the same workload is a memory regression -- the fit-check's
+    # budget erodes before anything OOMs.
+    "hbm_peak",
 )
 
 
@@ -165,6 +169,12 @@ def report_metrics(rep: dict) -> Dict[str, float]:
         flat["ckpt.integrity_failures"] = float(
             ck["integrity_failures"]
         )
+    mem = rep.get("memory")
+    if mem:
+        # The HBM high-water mark (lower-is-better via "hbm_peak"):
+        # a run whose peak grew against baseline fails the gate even
+        # while latency holds.
+        flat["memory.hbm_peak_bytes"] = float(mem["hbm_peak_bytes"])
     return flat
 
 

@@ -312,6 +312,26 @@ def _guard(records: Sequence[dict]) -> Optional[dict]:
     return out
 
 
+def _memory(records: Sequence[dict]) -> Optional[dict]:
+    """HBM high-water marks from ``device_memory`` events
+    (profiling/profiler.device_memory_summary, also emitted by every
+    anomaly capture): the max across records is the run's peak."""
+    mems = [
+        r for r in records if r.get("event") == "device_memory"
+    ]
+    if not mems:
+        return None
+    return {
+        "snapshots": len(mems),
+        "hbm_peak_bytes": max(r["hbm_peak_bytes"] for r in mems),
+        "hbm_limit_bytes": max(
+            (r["hbm_limit_bytes"] for r in mems
+             if "hbm_limit_bytes" in r),
+            default=None,
+        ),
+    }
+
+
 def _ckpt(records: Sequence[dict]) -> Optional[dict]:
     """Checkpoint-health breakdown: restore fallbacks (each one a
     snapshot that silently failed to come back) and content-integrity
@@ -379,6 +399,7 @@ def build_report(
         "loadgen": _loadgen(records),
         "guard": _guard(records),
         "ckpt": _ckpt(records),
+        "memory": _memory(records),
     }
 
 
@@ -489,6 +510,19 @@ def format_report(rep: dict) -> str:
                 f"- poisoned-window goodput loss: {g['lost_steps']} "
                 "optimizer step(s) re-trained or skipped"
             )
+    mem = rep.get("memory")
+    if mem is not None:
+        lines += [
+            "",
+            "## Device memory",
+            "",
+            f"- HBM peak {mem['hbm_peak_bytes'] / 2**30:.2f} GiB "
+            + (
+                f"of {mem['hbm_limit_bytes'] / 2**30:.2f} GiB limit "
+                if mem.get("hbm_limit_bytes") else ""
+            )
+            + f"({mem['snapshots']} snapshot(s))",
+        ]
     ck = rep.get("ckpt")
     if ck is not None:
         lines += [

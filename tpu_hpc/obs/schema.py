@@ -35,10 +35,47 @@ SCHEMA_VERSION = 1
 
 # Stamped on every record.
 COMMON_REQUIRED: Tuple[str, ...] = ("schema_version", "event", "time")
-# Provenance fields any record may carry.
+# Provenance fields any record may carry. ``trace_id`` is the causal
+# join key (obs/trace.py): "<run_id>:<kind>:<key>" ties a request's
+# admit/prefill/token lifecycle (or a training step's phase spans)
+# into one correlated record across every host's sink and flight
+# ring. ``t_mono`` is a monotonic-clock timestamp (time.perf_counter)
+# next to the wall-clock ``time``: cross-host trace merges order and
+# measure on the monotonic clock (NTP skew cannot reorder a host
+# against itself) and keep wall time for coarse alignment only.
 COMMON_OPTIONAL: Tuple[str, ...] = (
     "run_id", "host", "pid", "attempt", "step", "seq",
+    "trace_id", "t_mono",
 )
+
+# The canonical span-name table: every ``span(name)`` /
+# ``emit_span(name)`` call site in the tree must use a name registered
+# here (pinned by the tier-1 lint test in tests/test_trace.py), so
+# span names cannot silently drift into an unbounded namespace as
+# subsystems grow -- the report's phase table and the critical-path
+# analyzer key on exactly these.
+SPANS: Dict[str, str] = {
+    "admit": "paged admission: page reservation + prefix-trie lookup "
+             "(+ the disagg KV-plan warm)",
+    "ckpt": "checkpoint save (sync or async dispatch)",
+    "colocated_train_step": "loadgen colocation: a training step "
+                            "stealing the chip from serving",
+    "compute": "training forward/backward/update (fused chunk)",
+    "data": "host-side batch generation (host-fed path only)",
+    "decode": "one batched decode step (all slots)",
+    "elastic_reshard": "cross-topology restore reshard",
+    "kv_transfer": "disagg prefill->decode KV hop",
+    "prefill": "one prompt prefill forward (slab whole-prompt or one "
+               "paged chunk)",
+    "prefill_chunk": "scheduler-level per-request prefill advance "
+                     "(meter-clock duration, trace-tagged)",
+    "reshard": "bounded cross-sharding reshard execution",
+    "restore": "checkpoint restore",
+    "spec_draft": "speculative draft-model burst (k steps)",
+    "spec_draft_prefill": "draft-model prompt prefill",
+    "spec_verify": "speculative (k+1)-position verify forward",
+    "warmup": "AOT executable-table warmup",
+}
 
 
 class SchemaError(ValueError):
@@ -73,10 +110,38 @@ EVENTS: Dict[str, EventSpec] = {
     ), optional=("rolled_back",)),
     # -- the telemetry spine itself (obs/) --
     "span": EventSpec(
-        ("name", "dur_s"), optional=("parent", "depth", "n", "tier"),
+        ("name", "dur_s"),
+        optional=("parent", "depth", "n", "tier", "slot"),
     ),
     "metrics": EventSpec(("metrics",)),
     "stall": EventSpec(("step", "step_s", "watermark_s", "ratio")),
+    # -- causal tracing (obs/trace.py) --
+    # One record per trace birth (a request entering the scheduler):
+    # announces the trace_id every later lifecycle event/span will
+    # carry, with both clocks so cross-host merges can anchor the
+    # monotonic timeline against wall time.
+    "trace_ctx": EventSpec(
+        ("trace_id", "kind", "key"),
+        optional=("t_wall", "tenant", "parent"),
+    ),
+    # Anomaly-triggered capture (obs/trace.py AnomalyCapture): the
+    # symptom->evidence record -- what tripped, which trace_id it is
+    # keyed to, and where the bounded profiler trace + flight dump
+    # landed.
+    "capture_triggered": EventSpec(
+        ("reason",),
+        optional=("n_steps", "profile_dir", "flight_path"),
+    ),
+    # Per-device HBM high-water marks (profiling/profiler.py
+    # device_memory_summary) -- was logger-only; the report's memory
+    # section and the regress gate read exactly this.
+    "device_memory": EventSpec(
+        ("hbm_peak_bytes",),
+        optional=(
+            "n_devices", "hbm_in_use_bytes", "hbm_limit_bytes",
+            "per_device",
+        ),
+    ),
     "fault": EventSpec(("kind",)),
     "flight_dump": EventSpec(("reason", "n_events")),
     # -- serving (serve/metrics.py) --

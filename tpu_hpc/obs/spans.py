@@ -8,6 +8,15 @@ are the SAME brackets, not two instrumentation layers that drift.
 Spans nest: each event carries its ``parent`` span name and depth, so
 the report can attribute child time without double counting.
 
+Clock contract (pinned in tests/test_trace.py): **durations come from
+the monotonic clock** (``time.perf_counter``), never wall time -- an
+NTP step mid-span must not corrupt a phase share. Every span event
+also carries ``t_mono`` (the monotonic timestamp at span end, same
+clock as the duration) next to the bus-stamped wall ``time``: a
+cross-host trace merge (obs/trace.py) orders and measures each host
+on its own monotonic axis and uses wall time only for coarse
+alignment between hosts.
+
 For phases whose duration is measured some other way (the Trainer's
 chunk timer already brackets dispatch-to-fetch), :func:`emit_span`
 records a pre-aggregated duration without re-timing it.
@@ -54,6 +63,7 @@ def emit_span(
         sink=sink,
         name=name,
         dur_s=dur_s,
+        t_mono=time.perf_counter(),
         step=step,
         parent=st[-1] if st else None,
         depth=len(st),

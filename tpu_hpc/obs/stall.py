@@ -61,13 +61,16 @@ class StallDetector:
         return statistics.median(self._times)
 
     def observe(
-        self, step: int, step_s: float, sink: Optional[str] = None
+        self, step: int, step_s: float, sink: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> Optional[dict]:
         """Record one step time; returns the stall info dict (and
         emits a ``stall`` event) when this step breached the
-        watermark, else None. The breaching sample still enters the
-        window -- a run that *stays* slow re-baselines instead of
-        alarming forever."""
+        watermark, else None. ``trace_id`` (obs/trace.py) correlates
+        the stall with the step/tick trace it happened on -- the key
+        an anomaly-triggered capture is filed under. The breaching
+        sample still enters the window -- a run that *stays* slow
+        re-baselines instead of alarming forever."""
         watermark = self.watermark_s
         info = None
         # A non-positive watermark carries no cadence to breach (a
@@ -86,7 +89,9 @@ class StallDetector:
             }
             from tpu_hpc.obs.events import get_bus
 
-            (self._bus or get_bus()).emit("stall", sink=sink, **info)
+            (self._bus or get_bus()).emit(
+                "stall", sink=sink, trace_id=trace_id, **info
+            )
         self._times.append(step_s)
         self.last_step = step
         self.last_step_s = step_s

@@ -49,7 +49,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_hpc.models import llama2
-from tpu_hpc.obs import span
+from tpu_hpc.obs import get_registry, span
 from tpu_hpc.serve.engine import Engine, ServeConfig
 
 
@@ -207,6 +207,11 @@ class DisaggEngine:
         # window. Warmup's dummy transfers bypass prefill() and stay
         # out of it.
         self._hop_s: list = []
+        get_registry().describe(
+            "serve_kv_transfer_s",
+            "Prefill->decode tier KV hop, dispatch until the decode "
+            "cache holds the rows (s)",
+        )
 
     # -- executable/plans table ---------------------------------------
     @property

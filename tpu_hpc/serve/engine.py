@@ -41,7 +41,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_hpc.models import llama2
-from tpu_hpc.obs import span
+from tpu_hpc.obs import get_registry, span
 
 
 @dataclasses.dataclass(frozen=True)
@@ -326,6 +326,19 @@ class Engine:
             is_leaf=lambda x: isinstance(x, P),
         )
         self._rep = NamedSharding(mesh, P())
+        # HELP text for the span histograms this engine feeds (the
+        # Prometheus exposition renders it ahead of each # TYPE).
+        reg = get_registry()
+        reg.describe(
+            "serve_prefill_s",
+            "Prompt prefill forward, dispatch to first-token fetch "
+            "(s; one slab prompt or one paged chunk)",
+        )
+        reg.describe(
+            "serve_decode_s",
+            "One batched decode step across all slots, dispatch to "
+            "token fetch (s)",
+        )
 
         self._init_cache()
 

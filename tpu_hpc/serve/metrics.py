@@ -22,7 +22,7 @@ import dataclasses
 import time
 from typing import Callable, Dict, List, Optional
 
-from tpu_hpc.obs import get_bus, get_registry
+from tpu_hpc.obs import get_bus, get_registry, request_trace_id
 from tpu_hpc.obs.quantiles import quantile as _quantile
 from tpu_hpc.train.metrics import mfu
 
@@ -54,13 +54,31 @@ class ServeMeter:
         self.metrics_path = metrics_path
         self.clock = clock or time.perf_counter
         self.traces: Dict[str, _Trace] = {}
+        # rid -> causal trace id (obs/trace.py): derived ONCE at
+        # submission and stamped on every lifecycle record, so a
+        # request's queue wait, prefill chunks and token cadence join
+        # into one correlated timeline across sink and flight rings.
+        self.trace_ids: Dict[str, str] = {}
         self.prefill_tokens = 0  # padded prompt tokens forwarded
         self.shed = 0            # requests dropped by admission control
         self._t0 = self.clock()
+        # HELP text once at construction (the Engine.__init__
+        # discipline) -- the finish path and the per-token ITL loop
+        # must not re-describe under the registry lock per request.
+        reg = get_registry()
+        reg.describe("serve_requests_total",
+                     "Requests finished by the serve engine")
+        reg.describe("serve_tokens_total",
+                     "Tokens generated (decode emissions)")
+        reg.describe("serve_ttft_ms",
+                     "Time to first token, submission to first "
+                     "emission (ms)")
+        reg.describe("serve_itl_ms", "Inter-token latency (ms)")
 
     # -- batcher callbacks --------------------------------------------
     def submitted(self, rid: str) -> None:
         self.traces[rid] = _Trace(t_submit=self.clock())
+        self.trace_ids.setdefault(rid, request_trace_id(rid))
 
     def admitted(self, rid: str, prefill_tokens: int = 0) -> None:
         # TTFT is measured from SUBMISSION: an oversubscribed replay
@@ -94,6 +112,9 @@ class ServeMeter:
             "event": "request",
             "time": time.time(),
             "rid": rid,
+            "trace_id": self.trace_ids.get(
+                rid, request_trace_id(rid)
+            ),
             "ttft_ms": ttft_ms,
             "queue_ms": 1e3 * (
                 (trace.t_admit or trace.t_submit) - trace.t_submit

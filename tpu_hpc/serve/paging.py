@@ -751,6 +751,20 @@ class PagedEngine(Engine):
             "cow_copies": 0, "trie_evictions": 0,
         }
         self._blocks_free_min = self.allocator.free_blocks
+        # HELP once at construction (the ServeMeter.__init__
+        # discipline); the suffix-dependent pool gauges re-describe
+        # only when the suffix actually changes (disagg re-labels the
+        # tiers after construction).
+        self._described_suffix: Optional[str] = None
+        get_registry().describe(
+            "serve_prefix_hit_total",
+            "Admissions whose prompt prefix was served from the trie "
+            "(prefill FLOPs skipped)",
+        )
+        get_registry().describe(
+            "serve_prefix_hit_blocks_total",
+            "KV pages reused from the prefix trie",
+        )
         self._set_block_gauges()
 
     # -- cache layout overrides ----------------------------------------
@@ -860,10 +874,23 @@ class PagedEngine(Engine):
     def _set_block_gauges(self) -> None:
         free = self.allocator.free_blocks
         self._blocks_free_min = min(self._blocks_free_min, free)
-        get_registry().set_gauge(
+        reg = get_registry()
+        if self._described_suffix != self.gauge_suffix:
+            self._described_suffix = self.gauge_suffix
+            reg.describe(
+                f"serve_kv_blocks_free{self.gauge_suffix}",
+                "KV pages on the free list (trie-parked pages are "
+                "reclaimable and not counted free)",
+            )
+            reg.describe(
+                f"serve_kv_blocks_used{self.gauge_suffix}",
+                "KV pages referenced by live requests or the "
+                "prefix trie",
+            )
+        reg.set_gauge(
             f"serve_kv_blocks_free{self.gauge_suffix}", free
         )
-        get_registry().set_gauge(
+        reg.set_gauge(
             f"serve_kv_blocks_used{self.gauge_suffix}",
             self.allocator.used_blocks,
         )

@@ -47,11 +47,18 @@ budget raises a typed error naming both numbers
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from tpu_hpc.obs import get_bus, get_registry
+from tpu_hpc.obs import activate, emit_span, get_bus, get_registry
+from tpu_hpc.obs.trace import (
+    KIND_REQUEST,
+    announce,
+    new_context,
+    request_trace_id,
+)
 from tpu_hpc.serve.engine import Engine
 
 
@@ -226,6 +233,26 @@ class ContinuousBatcher:
         self._seeds: Dict[str, int] = {}
         self._requests: Dict[str, Request] = {}
         self._order: Dict[str, int] = {}  # rid -> submission sequence
+        # Causal tracing (obs/trace.py): trace ids are a pure
+        # function of (run_id, rid), so the batcher derives them on
+        # demand (request_trace_id) instead of caching a second copy
+        # of what the meter already holds. The batcher is the one
+        # layer that knows which request an engine call serves, so
+        # it activates the request's context around
+        # admit/prefill/release -- engine spans and
+        # kv_block/kv_transfer ring events join the trace ambiently
+        # -- and emits meter-clock "prefill_chunk"/"admit" spans the
+        # critical-path analyzer attributes TTFT with.
+        # Durations for the trace spans come from the meter's clock
+        # (virtual on loadgen runs, so seeded replays stay
+        # bit-identical; monotonic wall otherwise).
+        self._clock = (
+            meter.clock if meter is not None else time.perf_counter
+        )
+        get_registry().describe(
+            "serve_active_slots",
+            "Batch slots currently held by live requests",
+        )
         # The occupancy gauge exists (at 0) from bring-up: a scraper
         # must distinguish "serving, idle" from "no batcher yet".
         self._set_occupancy()
@@ -268,6 +295,10 @@ class ContinuousBatcher:
             )
         self._requests[request.rid] = request
         self._order[request.rid] = len(self._order)
+        # Trace birth: announce the id every later lifecycle event,
+        # span and ring record for this request will carry.
+        ctx = new_context(KIND_REQUEST, request.rid)
+        announce(ctx, tenant=request.tenant, sink=self._sink())
         if self._spec:
             from tpu_hpc.serve.spec import derive_request_seed
 
@@ -333,6 +364,7 @@ class ContinuousBatcher:
             sink=self._sink(),
             action="shed",
             rid=req.rid,
+            trace_id=request_trace_id(req.rid),
             tenant=req.tenant,
             occupancy=occupancy,
             pending=len(self.pending),
@@ -406,6 +438,7 @@ class ContinuousBatcher:
     # -- one decode-granularity tick ----------------------------------
     def _admit_slab(self, idx: int, slot: _Slot) -> bool:
         req = self._next_pending()
+        tid = request_trace_id(req.rid)
         if self.meter is not None:
             self.meter.admitted(
                 req.rid,
@@ -413,7 +446,16 @@ class ContinuousBatcher:
                     len(req.prompt)
                 ),
             )
-        first = self.engine.prefill(idx, req.prompt)
+        # The request's context is ambient for the engine call (its
+        # internal prefill span joins the trace); the meter-clock
+        # duration lands as this request's one prefill chunk.
+        t0 = self._clock()
+        with activate(tid):
+            first = self.engine.prefill(idx, req.prompt)
+        emit_span(
+            "prefill_chunk", self._clock() - t0, sink=self._sink(),
+            trace_id=tid, slot=idx,
+        )
         self.stats["admitted"] += 1
         get_registry().inc("serve_admitted_total")
         slot.rid = req.rid
@@ -452,23 +494,29 @@ class ContinuousBatcher:
         from tpu_hpc.serve.paging import BlockBudgetError
 
         req = self._next_pending()
+        tid = request_trace_id(req.rid)
         sampling = None
         if self._spec:
             sampling = (
                 self._seeds[req.rid], req.temperature, req.top_p,
             )
+        t0 = self._clock()
         try:
             # Positional-only when no spec is attached: the disagg
-            # engine's admit has its own (spec-free) signature.
-            if sampling is not None:
-                info = self.engine.admit(
-                    idx, req.prompt, req.max_new_tokens,
-                    sampling=sampling,
-                )
-            else:
-                info = self.engine.admit(
-                    idx, req.prompt, req.max_new_tokens
-                )
+            # engine's admit has its own (spec-free) signature. The
+            # request's trace is ambient, so page allocations,
+            # prefix-hit events and the disagg KV-plan work inside
+            # all correlate to it.
+            with activate(tid):
+                if sampling is not None:
+                    info = self.engine.admit(
+                        idx, req.prompt, req.max_new_tokens,
+                        sampling=sampling,
+                    )
+                else:
+                    info = self.engine.admit(
+                        idx, req.prompt, req.max_new_tokens
+                    )
         except BlockBudgetError:
             self.pending.append(req)  # _order keeps its place
             self.stats["block_stalls"] += 1
@@ -478,12 +526,17 @@ class ContinuousBatcher:
                 sink=self._sink(),
                 action="block_stall",
                 rid=req.rid,
+                trace_id=tid,
                 tenant=req.tenant,
                 occupancy=self.occupancy,
                 pending=len(self.pending),
                 reason="kv_pool_exhausted",
             )
             return False
+        emit_span(
+            "admit", self._clock() - t0, sink=self._sink(),
+            trace_id=tid, slot=idx,
+        )
         slot.rid = req.rid
         slot.prefilling = True
         slot.pos = 0
@@ -506,7 +559,14 @@ class ContinuousBatcher:
         for idx, slot in enumerate(self.slots):
             if slot.free or not slot.prefilling:
                 continue
-            first = self.engine.prefill_step(idx)
+            tid = request_trace_id(slot.rid)
+            t0 = self._clock()
+            with activate(tid):
+                first = self.engine.prefill_step(idx)
+            emit_span(
+                "prefill_chunk", self._clock() - t0,
+                sink=self._sink(), trace_id=tid, slot=idx,
+            )
             if first is None:
                 continue
             req = self._requests[slot.rid]
@@ -654,7 +714,10 @@ class ContinuousBatcher:
             self.meter.finished(slot.rid)
         self._ngram_idx.pop(slot.rid, None)
         if self._paged:
-            self.engine.release(idx)
+            # Page frees join the request's trace (the ambient stamp
+            # covers the engine's ring-only kv_block events).
+            with activate(request_trace_id(slot.rid)):
+                self.engine.release(idx)
         self.stats["evicted"] += 1
         slot.rid = None
         slot.remaining = 0

@@ -262,6 +262,7 @@ def run_loadgen(
     spec=None,
     spec_draft_ckpt: Optional[str] = None,
     spec_draft_cfg: Optional[llama2.LlamaConfig] = None,
+    capture_dir: Optional[str] = None,
 ) -> dict:
     """Engine bring-up + a tpu_hpc.loadgen scenario run; returns the
     harness summary (per-tenant quantiles, shed/queued counts,
@@ -316,8 +317,16 @@ def run_loadgen(
         )
     with obs.span("warmup", sink=metrics_path, hist="serve_warmup_s"):
         n_programs = engine.warmup()
+    capture = None
+    if capture_dir:
+        # Anomaly-triggered capture (obs/trace.py): a stall-watermark
+        # trip or SLO breach files one bounded profiler trace +
+        # flight dump under capture_dir, keyed by the triggering
+        # trace id.
+        capture = obs.AnomalyCapture(capture_dir, n_steps=8)
     harness = LoadHarness(
         engine, scenario, metrics_path=metrics_path,
+        capture=capture,
     )
     heartbeat = Heartbeat.from_env()
     tick_cb = None
@@ -348,6 +357,8 @@ def run_loadgen(
         ) - n_programs,
         batcher=dict(harness.batcher.stats),
     )
+    # (capture count rides in from harness.summarize() itself, AFTER
+    # its SLO-breach trigger -- counting here would miss it.)
     return harness.summarize(
         n_devices=jax.device_count(),
         n_params=llama2.count_params(cfg),
@@ -428,6 +439,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("--max-new", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--capture-dir", type=str, default=None, metavar="DIR",
+        help="arm anomaly-triggered capture for the --loadgen run: a "
+        "stall-watermark trip or SLO breach files one bounded "
+        "profiler trace + flight dump under DIR, keyed by the "
+        "triggering trace id (obs/trace.py)",
+    )
     ap.add_argument(
         "--loadgen", type=str, default=None, metavar="SCENARIO",
         help="run a tpu_hpc.loadgen scenario instead of the plain "
@@ -652,6 +670,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "--temperature is only consumed by the replay workload; "
             "--loadgen scenarios replay their own greedy mixes"
         )
+    if args.capture_dir and not args.loadgen:
+        ap.error(
+            "--capture-dir is only consumed together with --loadgen "
+            "(training runs arm capture via "
+            "TrainingConfig.capture_on_anomaly)"
+        )
     if args.top_p is not None and args.temperature is None:
         ap.error(
             "--top-p is only consumed together with --temperature"
@@ -763,6 +787,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             spec=spec_cfg,
             spec_draft_ckpt=args.spec_draft_ckpt,
             spec_draft_cfg=spec_draft_cfg,
+            capture_dir=args.capture_dir,
         )
     else:
         if args.disagg:

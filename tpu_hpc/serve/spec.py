@@ -624,6 +624,24 @@ class SpecRunner:
             "rejected": 0, "emitted": 0,
         }
         self.draft_time_s = 0.0
+        # HELP once at construction -- the per-verify-step stats path
+        # must not re-describe under the registry lock at decode
+        # cadence (the ServeMeter.__init__ discipline).
+        reg = get_registry()
+        reg.describe(
+            "serve_spec_draft_s",
+            "Draft-side forward (k-step burst or draft prefill), "
+            "dispatch to handoff (s)",
+        )
+        reg.describe(
+            "serve_spec_verify_s",
+            "Batched (k+1)-position target verify forward (s)",
+        )
+        reg.describe("serve_spec_drafted_total",
+                     "Speculative draft tokens proposed")
+        reg.describe("serve_spec_accepted_total",
+                     "Speculative draft tokens accepted by the "
+                     "target verify forward")
 
     # -- program builders (dispatched from the engines' _build) --------
     def _abstracts(self, engine):

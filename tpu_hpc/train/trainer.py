@@ -767,6 +767,30 @@ class Trainer:
         # event) and enriches the heartbeat so the supervisor can tell
         # hung from slow without attaching to the process.
         self.stall = obs.StallDetector()
+        # HELP once at construction (the ServeMeter.__init__
+        # discipline) -- the per-chunk loop must not re-describe
+        # under the registry lock.
+        reg = obs.get_registry()
+        reg.describe("train_steps_total", "Optimizer steps completed")
+        reg.describe("train_items_total",
+                     "Training items consumed (global batch x steps)")
+        reg.describe("train_step", "Current global optimizer step")
+        reg.describe("train_step_s",
+                     "Per-step wall time within the last chunk (s)")
+        # Anomaly-triggered capture (obs/trace.py): a stall-watermark
+        # trip or a guard poisoned verdict auto-arms ONE bounded
+        # jax.profiler trace + flight dump, keyed by the triggering
+        # step's trace id -- symptom to evidence with no operator in
+        # the loop. Built per fit() (cfg.capture_on_anomaly), but the
+        # knob is validated HERE: a bad capture_steps must fail at
+        # construction, not as a mid-fit traceback after bring-up
+        # (the guard_mode/manager discipline).
+        if cfg.capture_on_anomaly and cfg.capture_steps < 1:
+            raise ValueError(
+                f"capture_steps {cfg.capture_steps} must be >= 1 "
+                "when capture_on_anomaly is set"
+            )
+        self.capture: Optional[obs.AnomalyCapture] = None
         # Optional callable(state, step) run when a preemption notice
         # stops the run, BEFORE the emergency snapshot -- the hook for
         # recipe-level cleanup (flush custom logs, export metrics).
@@ -1167,6 +1191,13 @@ class Trainer:
                 cfg.profile_num_steps,
             )
         done = start_step
+        if cfg.capture_on_anomaly:
+            self.capture = obs.AnomalyCapture(
+                profile_dir=os.path.join(
+                    cfg.checkpoint_dir or cfg.profile_dir, "anomaly"
+                ),
+                n_steps=cfg.capture_steps,
+            )
         guard: Optional[PreemptionGuard] = None
         if self.checkpoint_manager is not None:
             guard = PreemptionGuard().install()
@@ -1206,6 +1237,10 @@ class Trainer:
                 self._watchdog = None
             if prof is not None:
                 prof.stop()
+            if self.capture is not None:
+                # A capture window still open at teardown must not
+                # leak its jax.profiler trace.
+                self.capture.close()
         preempted = guard is not None and guard.triggered
         goodput = self.goodput.summary()
         end_step = int(jax.device_get(self.state.step))
@@ -1354,6 +1389,13 @@ class Trainer:
             chunk_s = self.meter.end_batch(chunk * cfg.global_batch_size)
             done += chunk
             s_per_step = chunk_s / max(chunk, 1)
+            # The chunk's trace id (obs/trace.py): every phase span,
+            # stall verdict and checkpoint bracket of this chunk
+            # carries it, so the critical-path analyzer can decompose
+            # per-step time and a capture correlates to the step that
+            # tripped it. Run_id-scoped, so multi-host flight rings
+            # merge on the same ids.
+            tid = obs.step_trace_id(done)
             # Phase spans (the report's step-time breakdown). On the
             # scanned path data generation and the grad collectives
             # are fused into the one compiled chunk, so the whole
@@ -1361,14 +1403,28 @@ class Trainer:
             # rather than silently omitting those phases; the
             # host-fed path meters its host data time separately.
             self._emit_span(
-                "compute", max(chunk_s - data_s, 0.0), done, n=chunk
+                "compute", max(chunk_s - data_s, 0.0), done, n=chunk,
+                trace_id=tid,
             )
             if data_s > 0:
-                self._emit_span("data", data_s, done, n=chunk)
+                self._emit_span(
+                    "data", data_s, done, n=chunk, trace_id=tid
+                )
             # Straggler/stall watermark: a breach emits a ``stall``
             # event (every host -- the straggling host is rarely the
             # one writing the run log).
-            self.stall.observe(done, s_per_step, sink=self._sink())
+            stall_info = self.stall.observe(
+                done, s_per_step, sink=self._sink(), trace_id=tid
+            )
+            if stall_info is not None and self.capture is not None:
+                # Stall -> evidence: one bounded profiler capture +
+                # flight dump keyed by this chunk's trace id.
+                self.capture.trigger(
+                    "stall", trace_id=tid, step=done,
+                    sink=self._sink(),
+                )
+            if self.capture is not None:
+                self.capture.step(done)
             reg = obs.get_registry()
             reg.inc("train_steps_total", chunk)
             reg.inc("train_items_total", chunk * cfg.global_batch_size)
@@ -1447,7 +1503,7 @@ class Trainer:
             ):
                 with self.goodput.measure("ckpt"), obs.span(
                     "ckpt", sink=self._sink(), step=done,
-                    hist="train_ckpt_s",
+                    hist="train_ckpt_s", trace_id=tid,
                 ):
                     self.checkpoint_manager.save(self.state)
                     self._snapshot_config()
@@ -1467,7 +1523,7 @@ class Trainer:
                 obs.dump_flight("preempt")
                 with self.goodput.measure("ckpt"), obs.span(
                     "ckpt", sink=self._sink(), step=done,
-                    hist="train_ckpt_s",
+                    hist="train_ckpt_s", trace_id=tid,
                 ):
                     if done not in (
                         self.checkpoint_manager.all_steps() or []
@@ -1513,6 +1569,11 @@ class Trainer:
             rec = {
                 "event": "guard_verdict",
                 "step": step,
+                # The verdict joins the step's causal trace -- a
+                # guard-triggered capture is keyed by this exact id,
+                # so the symptom record and the evidence bundle grep
+                # to each other.
+                "trace_id": obs.step_trace_id(step),
                 "verdict": verdict.verdict,
                 "action": (
                     "rollback" if wants
@@ -1535,6 +1596,20 @@ class Trainer:
                 step, verdict.verdict, verdict.grad_norm,
                 verdict.nonfinite, rec["action"],
             )
+            if (
+                self.capture is not None
+                and verdict.verdict == "poisoned"
+            ):
+                # Poisoned step -> evidence bundle keyed by the
+                # poisoned step's trace id (the rollback below also
+                # dumps the ring; the capture's bundle additionally
+                # carries the HBM state and, when the run continues
+                # in skip mode, a bounded profiler window).
+                self.capture.trigger(
+                    "guard_poisoned",
+                    trace_id=obs.step_trace_id(step),
+                    step=step, sink=self._sink(),
+                )
             # The rollback window anchors at the first verdict that
             # DEMANDS rollback -- an earlier event-only spike in the
             # same chunk was, by configured policy, fine to train
@@ -1592,6 +1667,10 @@ class Trainer:
         rec = {
             "event": "guard_rollback",
             "step": last_bad + 1,
+            # Keyed like the triggering verdict (the first step that
+            # demanded rollback), so verdict, rollback record and any
+            # guard-triggered capture join on one trace id.
+            "trace_id": obs.step_trace_id(first_bad),
             "to_step": int(to_step),
             "first_bad": int(first_bad),
             "last_bad": int(last_bad),
