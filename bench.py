@@ -906,6 +906,34 @@ def loadgen_record(summary: dict) -> dict:
             f"loadgen_{summary['scenario']}_paged_spec_"
             f"{spec_mode}_ttft_ms_p95"
         )
+    fleet = summary.get("fleet")
+    if fleet:
+        # Fleet rows bank under their own metric family: a
+        # multi-replica quantile at the same traffic is a different
+        # system from a single-engine one (failure handling, routing
+        # and autoscale all in the loop), and the robustness counters
+        # ride along so the --bank gate fails on redispatch/
+        # replica-loss/swap-rollback drift (regress direction
+        # tokens).
+        lg.update(
+            fleet={
+                k: fleet[k]
+                for k in (
+                    "replicas", "live_min", "live_max", "router",
+                    "weights_version", "redispatched",
+                    "replica_down", "restarts", "swapped_replicas",
+                    "swap_rollbacks", "scale_ups", "scale_downs",
+                )
+            },
+            prefix_affinity_hit_rate=round(
+                fleet["prefix_affinity_hit_rate"], 4
+            ),
+            lost_requests=summary.get("lost_requests", 0),
+            block_stalls=summary.get("block_stalls", 0),
+        )
+        metric = (
+            f"loadgen_{summary['scenario']}_fleet_ttft_ms_p95"
+        )
     rec = {
         "metric": metric,
         "value": round(summary["ttft_ms_p95"], 3),
@@ -917,6 +945,22 @@ def loadgen_record(summary: dict) -> dict:
         "itl_ms_p95": round(summary["itl_ms_p95"], 3),
         "loadgen": lg,
     }
+    if fleet:
+        # Top level so the --bank reduction judges the MECHANISMS
+        # (obs/regress._BANKED_SIDE_KEYS -- the reduction reads only
+        # the record's top level, sub-dicts are never walked): the
+        # router's affinity outcome (higher-is-better by token
+        # absence) and the robustness counters (lower via the
+        # redispatch/replica_down/swap/lost_requests direction
+        # tokens) fail the gate on drift even while the latency
+        # headline still rides within tolerance.
+        rec["prefix_affinity_hit_rate"] = round(
+            fleet["prefix_affinity_hit_rate"], 4
+        )
+        rec["redispatched"] = fleet["redispatched"]
+        rec["replica_down"] = fleet["replica_down"]
+        rec["swap_rollbacks"] = fleet["swap_rollbacks"]
+        rec["lost_requests"] = summary.get("lost_requests", 0)
     if spec_mode:
         # Top level so the --bank reduction judges the MECHANISM, not
         # just the latency outcome: acceptance_rate is one of the
@@ -933,6 +977,8 @@ def bench_loadgen(
     paged: bool = False, block_size=None, kv_blocks=None,
     prefill_chunk=None, model: str = "bench",
     spec: str = "off", spec_k=None, draft_ckpt=None,
+    fleet: int = 0, fleet_min: int = 1, fleet_swap_at=None,
+    fleet_router: str = "affinity",
 ) -> dict:
     """Scenario-diverse load row: the SAME ~170M bench architecture as
     the serve row, driven by the tpu_hpc.loadgen harness. ``recompiles``
@@ -955,7 +1001,11 @@ def bench_loadgen(
 
     from tpu_hpc.runtime import init_distributed
     from tpu_hpc.serve.engine import ServeConfig
-    from tpu_hpc.serve.server import run_loadgen, tiny_config
+    from tpu_hpc.serve.server import (
+        run_fleet_loadgen,
+        run_loadgen,
+        tiny_config,
+    )
 
     init_distributed(verbose=False)
     if model == "tiny":
@@ -974,21 +1024,36 @@ def bench_loadgen(
         max_seq_len=max_seq,
         prefill_buckets=buckets,
     )
-    summary = run_loadgen(
-        model_cfg, serve_cfg, scenario, requests, max_new, seed=seed,
-        paged=paged_cfg,
-        spec=spec_cfg, spec_draft_ckpt=draft_ckpt,
-    )
+    if fleet:
+        summary = run_fleet_loadgen(
+            model_cfg, serve_cfg, scenario, requests, max_new,
+            paged_cfg, n_replicas=fleet, min_replicas=fleet_min,
+            router=fleet_router, swap_at=fleet_swap_at, seed=seed,
+        )
+    else:
+        summary = run_loadgen(
+            model_cfg, serve_cfg, scenario, requests, max_new,
+            seed=seed, paged=paged_cfg,
+            spec=spec_cfg, spec_draft_ckpt=draft_ckpt,
+        )
     rec = loadgen_record(summary)
     rec["loadgen"]["model"] = model
     print(
         f"loadgen {scenario}{' paged' if paged else ''}"
+        f"{f' fleet:{fleet}' if fleet else ''}"
         f"{f' spec:{spec}' if spec != 'off' else ''} | "
         f"shed {summary['shed']} "
         f"queued {summary['queued']} | TTFT p95 "
         f"{summary['ttft_ms_p95']:.1f} virtual-ms | ITL p50 "
         f"{summary['itl_ms_p50']:.1f} | occupancy "
         f"{summary['occupancy_mean']:.0%}"
+        + (
+            f" | affinity "
+            f"{summary.get('prefix_affinity_hit_rate', 0):.0%} "
+            f"redisp {summary['fleet']['redispatched']} "
+            f"lost {summary.get('lost_requests', 0)}"
+            if fleet else ""
+        )
         + (
             f" | acceptance {summary.get('acceptance_rate', 0):.0%}"
             if spec != "off" else ""
@@ -1237,6 +1302,34 @@ def main(argv=None) -> int:
         "input)",
     )
     ap.add_argument(
+        "--serve-fleet", type=int, default=None, metavar="N",
+        help="run the loadgen scenario over a fleet of N paged "
+        "replicas on disjoint mesh slices (serve/fleet.py): "
+        "affinity routing, heartbeat failure handling, autoscale; "
+        "the record banks under its own loadgen_<scenario>_fleet_* "
+        "family with the robustness counters riding along "
+        "(--workload loadgen with --serve-paged "
+        "--serve-prefill-chunk only)",
+    )
+    ap.add_argument(
+        "--fleet-swap-at", type=int, default=None, metavar="TICK",
+        help="publish a live weight update mid-run at this fleet "
+        "tick (dev mode: a fresh random init at seed+1) rolled out "
+        "drain-and-swap behind the content-checksum gate; requires "
+        "--serve-fleet",
+    )
+    ap.add_argument(
+        "--fleet-router", choices=("affinity", "round_robin"),
+        default=None,
+        help="fleet request placement (default affinity; round_robin "
+        "is the documented degraded control); requires --serve-fleet",
+    )
+    ap.add_argument(
+        "--fleet-min", type=int, default=None, metavar="N",
+        help="autoscaler's minimum live replicas (default 1; initial "
+        "live set = max(min, ceil(N/2))); requires --serve-fleet",
+    )
+    ap.add_argument(
         "--serve-paged", action="store_true",
         help="paged KV cache (tpu_hpc/serve/paging.py): block-table "
         "pool with prefix reuse + chunked prefill; the record carries "
@@ -1438,6 +1531,46 @@ def main(argv=None) -> int:
                     f"{flag} is only consumed together with "
                     "--serve-paged"
                 )
+    if args.serve_fleet is not None:
+        # The misplaced-flag discipline, fleet edition: a fleet flag
+        # on a workload/layout that cannot consume it must be a CLI
+        # error, not a single-engine row banked under a fleet label.
+        if args.serve_fleet < 1:
+            ap.error(f"--serve-fleet {args.serve_fleet} must be >= 1")
+        if args.workload != "loadgen":
+            ap.error(
+                "--serve-fleet is only consumed by --workload "
+                f"loadgen; --workload {args.workload} would silently "
+                "run a single engine"
+            )
+        if not args.serve_paged or not args.serve_prefill_chunk:
+            ap.error(
+                "--serve-fleet needs --serve-paged "
+                "--serve-prefill-chunk N (replicas are paged "
+                "engines; redispatch replays prompt + committed "
+                "tokens, which can exceed any single bucket)"
+            )
+        if args.serve_spec != "off":
+            ap.error(
+                "--serve-fleet does not consume --serve-spec"
+            )
+        if args.fleet_min is not None and not \
+                1 <= args.fleet_min <= args.serve_fleet:
+            ap.error(
+                f"--fleet-min {args.fleet_min} must be in "
+                f"[1, --serve-fleet {args.serve_fleet}]"
+            )
+    else:
+        for flag, val in (
+            ("--fleet-swap-at", args.fleet_swap_at),
+            ("--fleet-router", args.fleet_router),
+            ("--fleet-min", args.fleet_min),
+        ):
+            if val is not None:
+                ap.error(
+                    f"{flag} is only consumed together with "
+                    "--serve-fleet"
+                )
     if args.serve_spec != "off":
         # The misplaced-flag discipline, speculative edition: a spec
         # flag on a workload (or cache layout) that cannot consume it
@@ -1627,6 +1760,10 @@ def main(argv=None) -> int:
             model=args.serve_model,
             spec=args.serve_spec, spec_k=args.spec_k,
             draft_ckpt=args.serve_draft_ckpt,
+            fleet=args.serve_fleet or 0,
+            fleet_min=args.fleet_min or 1,
+            fleet_swap_at=args.fleet_swap_at,
+            fleet_router=args.fleet_router or "affinity",
         )
     else:
         rec = bench_unet(args.steps)

@@ -99,6 +99,18 @@ SLOW_NODEIDS = frozenset(nodeid for nodeid, _ in [
     ("tests/test_spec.py::TestSeededSampling::test_draft_mode_sampling_deterministic", "16s"),
     ("tests/test_spec.py::TestPageAccounting::test_pools_drain_to_idle_and_invariants_hold", "9s"),
     ("tests/test_spec.py::TestServerCLI::test_loadgen_with_spec_is_deterministic", "14s"),
+    # Serving fleet (tests/test_fleet.py): the tier-1 core keeps one
+    # fast representative per fault class (kill/redispatch, corrupt
+    # swap, slow replica, scale-down drain) plus the diurnal
+    # acceptance; the 8-combination chaos sweep rides the slow tier.
+    ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[kill-affinity]", "3s"),
+    ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[kill-round_robin]", "3s"),
+    ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[slow-affinity]", "3s"),
+    ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[slow-round_robin]", "3s"),
+    ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[kill_slow-affinity]", "3s"),
+    ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[kill_slow-round_robin]", "3s"),
+    ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[corrupt_swap-affinity]", "3s"),
+    ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[corrupt_swap-round_robin]", "3s"),
     ("tests/test_reshard.py::TestLongShapes::test_long_shape_bounded_parity_sweep", "35s"),
     ("tests/test_resnet.py::test_fsdp_training_step", "60s"),
     ("tests/test_run_metrics.py::TestMetricsLog::test_appends_across_runs", "13s"),

@@ -336,7 +336,9 @@ def test_loadgen_mode_routes_flags(bench, monkeypatch):
                            paged=False, block_size=None,
                            kv_blocks=None, prefill_chunk=None,
                            model="bench", spec="off", spec_k=None,
-                           draft_ckpt=None):
+                           draft_ckpt=None, fleet=0, fleet_min=1,
+                           fleet_swap_at=None,
+                           fleet_router="affinity"):
         seen.update(scenario=scenario, requests=requests, slots=slots,
                     max_new=max_new, paged=paged, spec=spec)
         return {"metric": "loadgen_x_ttft_ms_p95", "value": 1.0,

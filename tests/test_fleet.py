@@ -1,0 +1,748 @@
+"""The serving fleet (serve/fleet.py): chaos matrix + satellites.
+
+Failure-mode matrix (each row is detect -> recover -> pinned here):
+
+* replica kill mid-decode  -> heartbeat timeout -> redispatch from
+  prompt + committed tokens; greedy streams BYTE-IDENTICAL to the
+  no-failure run, zero lost requests, zero recompiles;
+* corrupt weight swap      -> content-checksum catch -> rollback; the
+  old weights keep serving byte-identically and the update aborts;
+* slow replica             -> cross-replica tick watermark -> router
+  sheds new load away from it before the SLO classes pay;
+* scale-down               -> drain-before-release: the parked
+  replica finishes every in-flight decode first;
+* diurnal + kill + swap    -> the end-to-end acceptance: zero shed
+  above the SLO-class floor, zero lost, recompiles 0, and the banked
+  fleet rows pass ``regress --bank`` against the committed history.
+
+Satellites pinned here too: typed fleet fault-spec parsing (shared
+parse helper), the meter request_shed protocol (no hasattr
+duck-check), jittered restart backoff reuse, and the regress/report
+fleet namespace.
+
+All engines are tiny fp32 paged engines on 2-device sim-mesh slices
+(8 devices / 4 replicas), chunked prefill on -- the redispatch
+replay's prompt+committed can exceed any single bucket.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_hpc import obs
+from tpu_hpc.loadgen import (
+    FAULT_DEFAULTS,
+    LoadHarness,
+    build_scenario,
+    parse_faults,
+)
+from tpu_hpc.models import llama2
+from tpu_hpc.obs.regress import (
+    lower_is_better,
+    main as regress_main,
+    report_metrics,
+)
+from tpu_hpc.obs.report import build_report
+from tpu_hpc.obs.schema import load_records, validate_record
+from tpu_hpc.serve import (
+    ContinuousBatcher,
+    Engine,
+    PagedConfig,
+    Request,
+    ServeConfig,
+    ServeMeter,
+)
+from tpu_hpc.serve.fleet import (
+    DRAINING,
+    LIVE,
+    STANDBY,
+    FleetConfig,
+    FleetHarness,
+    build_fleet_engines,
+)
+
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = llama2.LlamaConfig(
+    dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+    multiple_of=16, max_seq_len=64, dtype=jnp.float32,
+)
+SERVE = ServeConfig(slots=4, max_seq_len=48, prefill_buckets=(8, 16))
+PAGED = PagedConfig(block_size=4, num_blocks=48, prefill_chunk=8)
+MAX_PROMPT, MAX_NEW = 16, 6
+N_REPLICAS = 4
+
+
+@pytest.fixture(scope="module")
+def fleet_params():
+    return llama2.init_llama(jax.random.key(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def fleet_params_v2():
+    return llama2.init_llama(jax.random.key(1), TINY)
+
+
+@pytest.fixture(scope="module")
+def fleet_engines(fleet_params, devices):
+    """Four warmed paged replicas on disjoint 2-device slices --
+    shared across the module (warmup is the expensive part); each
+    test resets pools + weights via the ``engines`` fixture below."""
+    engines = build_fleet_engines(
+        fleet_params, TINY, SERVE, PAGED, N_REPLICAS
+    )
+    for e in engines:
+        e._params0 = e.params   # the reset target
+    return engines
+
+
+@pytest.fixture()
+def engines(fleet_engines):
+    """Fresh-state view of the shared engines: pools flushed, original
+    weights restored -- so chaos tests cannot leak state into each
+    other through the module-scoped executables."""
+    for e in fleet_engines:
+        e.reset_pool(force=True)
+        if e.params is not e._params0:
+            e.swap_params(e._params0)
+    return fleet_engines
+
+
+@pytest.fixture()
+def scoped_obs(tmp_path):
+    bus = obs.EventBus(path=None, run_id="fleet-test",
+                       flight_dir=str(tmp_path))
+    reg = obs.MetricsRegistry()
+    prev_bus, prev_reg = obs.set_bus(bus), obs.set_registry(reg)
+    yield bus, reg
+    obs.set_bus(prev_bus)
+    obs.set_registry(prev_reg)
+
+
+def _scenario(name, seed=7, n=16, rate=40.0):
+    return build_scenario(
+        name, seed=seed, n_requests=n, vocab_size=TINY.vocab_size,
+        max_prompt=MAX_PROMPT, max_new=MAX_NEW, rate_per_s=rate,
+    )
+
+
+def _cfg(**kw):
+    kw.setdefault("initial_replicas", 2)
+    kw.setdefault("min_replicas", 2)
+    kw.setdefault("max_replicas", 2)
+    return FleetConfig(**kw)
+
+
+def _run(engines, scenario, cfg, faults="", path=None, **kw):
+    harness = FleetHarness(
+        engines[:cfg.max_replicas or len(engines)], scenario,
+        cfg, metrics_path=str(path) if path else None,
+        faults=parse_faults(faults), **kw,
+    )
+    n0 = harness.fleet.compile_count_total()
+    summary = harness.run(n_devices=jax.device_count())
+    summary["_recompiles"] = harness.fleet.compile_count_total() - n0
+    return summary, harness
+
+
+# ---------------------------------------------------------------------
+# satellite: typed fault-spec parsing on the shared helper
+# ---------------------------------------------------------------------
+class TestFleetFaultParsing:
+    def test_defaults_cover_fleet_keys(self):
+        got = parse_faults("")
+        assert got == dict(FAULT_DEFAULTS)
+        assert got["replica_kill_at"] is None
+        assert got["swap_corrupt"] is False
+        assert got["slow_replica"] is None
+
+    def test_fleet_keys_parse(self):
+        got = parse_faults(
+            "replica_kill_at=12, swap_corrupt=1, slow_replica=2:3.5"
+        )
+        assert got["replica_kill_at"] == 12
+        assert got["swap_corrupt"] is True
+        assert got["slow_replica"] == (2, 3.5)
+
+    @pytest.mark.parametrize("spec,frag", [
+        ("replica_kill_at=-1", "non-negative integer"),
+        ("replica_kill_at=soon", "non-negative integer"),
+        ("swap_corrupt=2", "0 or 1"),
+        ("slow_replica=3", "<replica>:<factor>"),
+        ("slow_replica=a:2", "<replica>:<factor>"),
+        ("slow_replica=1:0", "<replica>:<factor>"),
+    ])
+    def test_malformed_values_name_key_spec_and_type(
+        self, spec, frag
+    ):
+        key = spec.split("=")[0]
+        with pytest.raises(ValueError) as e:
+            parse_faults(spec)
+        msg = str(e.value)
+        # The typed-error contract: key + full spec + expected type.
+        assert key in msg and spec in msg and frag in msg
+
+    def test_shared_helper_with_resilience_faults(self):
+        # One parse loop for both fault env vars: TPU_HPC_FAULTS
+        # rides the same helper, same message shape.
+        from tpu_hpc.resilience.faults import fault_plan_from_env
+
+        with pytest.raises(ValueError, match="unknown fault key"):
+            fault_plan_from_env({"TPU_HPC_FAULTS": "kill_at=3"})
+        with pytest.raises(ValueError, match="expected an integer"):
+            fault_plan_from_env(
+                {"TPU_HPC_FAULTS": "kill_at_step=soon"}
+            )
+
+    def test_single_engine_harness_rejects_fleet_faults(self):
+        # A fleet fault on the single-engine harness must fail loudly
+        # -- silently injecting nothing would make its chaos test
+        # pass vacuously.
+        with pytest.raises(ValueError, match="fleet fault"):
+            LoadHarness(
+                object(), _scenario("steady"),
+                faults=parse_faults("replica_kill_at=5"),
+            )
+        # replica_kill_at=0 is a legal ARMED value that compares
+        # equal to False -- the guard must use identity, not
+        # membership (review finding).
+        with pytest.raises(ValueError, match="fleet fault"):
+            LoadHarness(
+                object(), _scenario("steady"),
+                faults=parse_faults("replica_kill_at=0"),
+            )
+
+    def test_harness_rejects_slow_index_out_of_range(
+        self, engines
+    ):
+        with pytest.raises(ValueError, match="nonexistent replica"):
+            FleetHarness(
+                engines[:2], _scenario("steady"), _cfg(),
+                faults=parse_faults("slow_replica=7:3"),
+            )
+
+    def test_harness_rejects_corrupt_fault_without_a_swap(
+        self, engines
+    ):
+        # swap_corrupt with nothing scheduled to corrupt injects
+        # nothing -- same vacuous-chaos class as a typoed key.
+        with pytest.raises(ValueError, match="swap_corrupt"):
+            FleetHarness(
+                engines[:2], _scenario("steady"), _cfg(),
+                faults=parse_faults("swap_corrupt=1"),
+            )
+
+
+# ---------------------------------------------------------------------
+# satellite: the meter request_shed protocol (no hasattr duck-check)
+# ---------------------------------------------------------------------
+class _FakeSlabEngine:
+    is_paged = False
+    spec = None
+    serve_cfg = ServeConfig(
+        slots=1, max_seq_len=32, prefill_buckets=(8,)
+    )
+
+
+class TestMeterShedProtocol:
+    def test_typoed_meter_loses_shed_loudly(self):
+        class BadMeter:
+            clock = staticmethod(time.perf_counter)
+
+            def submitted(self, rid):
+                pass
+
+            # request_shed misspelled: the old hasattr duck-check
+            # silently dropped shed telemetry; now it must raise.
+            def request_sched(self, rid, reason=""):
+                pass
+
+        batcher = ContinuousBatcher(
+            _FakeSlabEngine(), meter=BadMeter()
+        )
+        req = Request(rid="r0", prompt=[1, 2], max_new_tokens=2)
+        batcher.submit(req)
+        with pytest.raises(AttributeError, match="request_shed"):
+            batcher._shed(req, "test", 1.0)
+
+    def test_base_meter_implements_the_protocol(self):
+        meter = ServeMeter()
+        batcher = ContinuousBatcher(_FakeSlabEngine(), meter=meter)
+        req = Request(rid="r0", prompt=[1, 2], max_new_tokens=2)
+        batcher.submit(req)
+        batcher._shed(req, "test", 1.0)
+        assert meter.shed == 1
+
+
+# ---------------------------------------------------------------------
+# engine-side swap primitives
+# ---------------------------------------------------------------------
+class TestEngineSwap:
+    def test_swap_params_zero_recompiles(
+        self, engines, fleet_params_v2
+    ):
+        from tpu_hpc.serve.weights import place_params
+
+        e = engines[0]
+        before = e.compile_count
+        placed = place_params(
+            fleet_params_v2, e.mesh, e.param_pspecs
+        )
+        e.swap_params(placed)
+        assert e.compile_count == before
+        e.swap_params(e._params0)
+        assert e.compile_count == before
+
+    def test_swap_params_rejects_shape_mismatch(self, engines):
+        other_cfg = llama2.LlamaConfig(
+            dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            vocab_size=128, multiple_of=16, max_seq_len=64,
+            dtype=jnp.float32,
+        )
+        other = llama2.init_llama(jax.random.key(2), other_cfg)
+        with pytest.raises(ValueError, match="swap_params"):
+            engines[0].swap_params(other)
+
+    def test_reset_pool_refuses_undrained(self, engines):
+        e = engines[0]
+        e.admit(0, list(range(8)), 4)
+        with pytest.raises(RuntimeError, match="undrained"):
+            e.reset_pool()
+        e.reset_pool(force=True)
+        e.allocator.check_invariant()
+        assert e.allocator.used_blocks == 0
+
+
+# ---------------------------------------------------------------------
+# router: prefix affinity vs the round-robin control
+# ---------------------------------------------------------------------
+class TestRouterAffinity:
+    def test_affinity_holds_single_replica_hit_rate(
+        self, engines, scoped_obs
+    ):
+        """The acceptance bar: fleet-aggregate hit rate with affinity
+        routing >= the single-replica hit rate on the same schedule;
+        round-robin (the degraded control) lands strictly below
+        affinity -- it divides every tenant's prefix across N cold
+        tries."""
+        # Single-replica baseline on the same seeded schedule.
+        single = LoadHarness(engines[0], _scenario("shared_prefix",
+                                                   n=24))
+        single.drive()
+        s = engines[0].paged_summary()
+        single_rate = s["prefix_hit_rate"]
+        assert single_rate > 0
+
+        for e in engines:
+            e.reset_pool(force=True)
+        sa, _ = _run(
+            engines, _scenario("shared_prefix", n=24),
+            _cfg(router="affinity"),
+        )
+        for e in engines:
+            e.reset_pool(force=True)
+        sr, _ = _run(
+            engines, _scenario("shared_prefix", n=24),
+            _cfg(router="round_robin"),
+        )
+        assert sa["prefix_affinity_hit_rate"] >= single_rate - 1e-9
+        assert sr["prefix_affinity_hit_rate"] \
+            < sa["prefix_affinity_hit_rate"]
+        assert sa["lost_requests"] == 0
+        assert sr["lost_requests"] == 0
+
+    def test_router_skips_draining_and_dead(self, engines):
+        harness = FleetHarness(
+            engines[:2], _scenario("steady", n=4), _cfg(),
+            faults=parse_faults(""),
+        )
+        fleet = harness.fleet
+        fleet.replicas[0].status = DRAINING
+        req = Request(rid="x0", prompt=list(range(8)),
+                      max_new_tokens=2)
+        assert fleet.route(req).idx == 1
+        fleet.replicas[1].status = STANDBY
+        assert fleet.route(req) is None
+        fleet.replicas[0].status = LIVE
+        fleet.replicas[1].status = LIVE
+
+
+# ---------------------------------------------------------------------
+# chaos: replica kill -> redispatch (tier-1 representative)
+# ---------------------------------------------------------------------
+class TestKillRedispatch:
+    def test_kill_mid_decode_redispatch_byte_identical(
+        self, engines, scoped_obs, tmp_path
+    ):
+        clean, h0 = _run(engines, _scenario("steady", n=12), _cfg())
+        res_clean = dict(h0.fleet.results)
+        assert clean["lost_requests"] == 0
+
+        for e in engines:
+            e.reset_pool(force=True)
+        path = tmp_path / "kill.jsonl"
+        chaos, h1 = _run(
+            engines, _scenario("steady", n=12), _cfg(),
+            faults="replica_kill_at=8", path=path,
+        )
+        fl = chaos["fleet"]
+        assert fl["replica_down"] == 1
+        assert fl["redispatched"] >= 1
+        assert chaos["lost_requests"] == 0
+        assert chaos["shed"] == 0
+        assert chaos["_recompiles"] == 0
+        # THE redispatch contract: every resumed greedy stream is
+        # byte-identical to the no-failure run.
+        assert dict(h1.fleet.results) == res_clean
+        # The evidence trail is schema-valid and names the failure.
+        records = load_records(str(path), validate=True)
+        kinds = {r["event"] for r in records}
+        assert "replica_down" in kinds and "redispatch" in kinds
+        down = [r for r in records if r["event"] == "replica_down"]
+        assert down[0]["reason"] == "heartbeat_timeout"
+        assert down[0]["redispatched"] == fl["redispatched"]
+
+    def test_dead_replica_restarts_with_backoff_and_serves(
+        self, engines, scoped_obs, tmp_path
+    ):
+        """The jittered-backoff restart path (resilience/retry
+        reused): after the kill, the replica comes back, and traffic
+        spread over a long window lands on it again."""
+        path = tmp_path / "restart.jsonl"
+        chaos, h = _run(
+            engines, _scenario("steady", n=24, rate=15.0), _cfg(),
+            faults="replica_kill_at=6", path=path,
+        )
+        fl = chaos["fleet"]
+        assert fl["replica_down"] == 1
+        assert fl["restarts"] == 1
+        assert chaos["lost_requests"] == 0
+        ups = [
+            r for r in load_records(str(path), validate=True)
+            if r["event"] == "replica_up"
+        ]
+        assert any(r["reason"] == "restart" for r in ups)
+        # The restarted replica rejoined the serving set.
+        assert len(h.fleet.live) == 2
+
+
+# ---------------------------------------------------------------------
+# chaos: weight hot-swap (clean + corrupt -> rollback)
+# ---------------------------------------------------------------------
+class TestWeightSwap:
+    def test_clean_swap_rolls_through_fleet(
+        self, engines, scoped_obs, fleet_params_v2, tmp_path
+    ):
+        path = tmp_path / "swap.jsonl"
+        s, h = _run(
+            engines, _scenario("steady", n=12), _cfg(),
+            path=path, swap_at=6, swap_weights=fleet_params_v2,
+        )
+        fl = s["fleet"]
+        assert fl["weights_version"] == 1
+        assert fl["swapped_replicas"] >= 1
+        assert fl["swap_rollbacks"] == 0
+        assert s["lost_requests"] == 0
+        assert s["_recompiles"] == 0
+        events = [
+            r for r in load_records(str(path), validate=True)
+            if r["event"] == "weight_swap"
+        ]
+        statuses = [r["status"] for r in events]
+        assert "drain_start" in statuses and "swapped" in statuses
+        # Post-run, every live replica runs the new version, and a
+        # fresh request is served by the NEW weights (its stream
+        # differs from the old model's continuation).
+        assert all(
+            r.weights_version == 1 for r in h.fleet.live
+        )
+        assert fl["mixed_weights"] is False
+
+    def test_corrupt_swap_checksum_rollback_old_weights_serve(
+        self, engines, scoped_obs, fleet_params_v2, tmp_path
+    ):
+        clean, h0 = _run(engines, _scenario("steady", n=12), _cfg())
+        res_clean = dict(h0.fleet.results)
+        for e in engines:
+            e.reset_pool(force=True)
+        path = tmp_path / "corrupt.jsonl"
+        s, h1 = _run(
+            engines, _scenario("steady", n=12), _cfg(),
+            faults="swap_corrupt=1", path=path,
+            swap_at=6, swap_weights=fleet_params_v2,
+        )
+        fl = s["fleet"]
+        assert fl["swap_rollbacks"] == 1
+        assert fl["swapped_replicas"] == 0
+        assert fl["weights_version"] == 0   # update aborted
+        # First-replica corruption aborts before anything swapped:
+        # the fleet stays version-uniform (a LATER-replica corruption
+        # would leave it mixed, and this flag is how that surfaces).
+        assert fl["mixed_weights"] is False
+        assert s["lost_requests"] == 0
+        # Old weights kept serving: byte-identical to the clean run.
+        assert dict(h1.fleet.results) == res_clean
+        events = [
+            r for r in load_records(str(path), validate=True)
+            if r["event"] == "weight_swap"
+        ]
+        statuses = [r["status"] for r in events]
+        assert "corrupt" in statuses and "rolled_back" in statuses
+        corrupt = [r for r in events if r["status"] == "corrupt"]
+        assert corrupt[0]["mismatched"] >= 1
+
+
+# ---------------------------------------------------------------------
+# chaos: slow replica -> router sheds load away
+# ---------------------------------------------------------------------
+class TestSlowReplica:
+    def test_router_routes_away_from_slow_replica(
+        self, engines, scoped_obs
+    ):
+        """Detection protects NEW load: requests already decoding on
+        the slow replica pay its inter-token latency (nothing short
+        of migration could save them), but once the cross-replica
+        watermark warms, arrivals route to healthy replicas --
+        ownership must skew healthy, and the virtual makespan must
+        beat the no-detection control (slow_factor set beyond
+        reach): with detection the healthy replica absorbs the mix
+        at 1x decode speed instead of half the requests grinding at
+        the fault's factor."""
+        s, h = _run(
+            engines, _scenario("multi_tenant", n=32, rate=60.0),
+            _cfg(health_window=2),
+            faults="slow_replica=1:8",
+        )
+        assert s["lost_requests"] == 0
+        owners = list(h.fleet.owner.values())
+        assert owners.count(0) > owners.count(1)
+
+        for e in engines:
+            e.reset_pool(force=True)
+        blind, _ = _run(
+            engines, _scenario("multi_tenant", n=32, rate=60.0),
+            _cfg(health_window=2, slow_factor=1e9),
+            faults="slow_replica=1:8",
+        )
+        assert blind["lost_requests"] == 0
+        assert s["wall_s"] < blind["wall_s"]
+
+
+# ---------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------
+class TestAutoscale:
+    def test_scale_down_drains_before_release(
+        self, engines, scoped_obs, tmp_path
+    ):
+        path = tmp_path / "scale.jsonl"
+        s, h = _run(
+            engines, _scenario("steady", n=16, rate=10.0),
+            _cfg(initial_replicas=2, min_replicas=1,
+                 max_replicas=2, scale_window=6, scale_cooldown=8),
+            path=path,
+        )
+        fl = s["fleet"]
+        assert fl["scale_downs"] >= 1
+        # Drain-before-release: nothing was lost or shed to the
+        # shrink, and the shrink event fired on an EMPTY replica
+        # (the batcher is parked only after its last eviction).
+        assert s["lost_requests"] == 0
+        assert s["shed"] == 0
+        parked = [
+            r for r in h.fleet.replicas if r.status == STANDBY
+        ]
+        assert parked and all(r.batcher is None for r in parked)
+
+    def test_scale_up_on_saturation(self, engines, scoped_obs):
+        s, h = _run(
+            engines, _scenario("saturating_burst", n=32),
+            FleetConfig(
+                initial_replicas=1, min_replicas=1, max_replicas=2,
+                scale_window=4, scale_cooldown=4,
+                scale_up_occupancy=0.7,
+            ),
+        )
+        fl = s["fleet"]
+        assert fl["scale_ups"] >= 1
+        assert fl["live_max"] == 2
+        assert s["lost_requests"] == 0
+
+
+# ---------------------------------------------------------------------
+# the end-to-end acceptance: diurnal + mid-run swap + replica kill
+# ---------------------------------------------------------------------
+class TestDiurnalEndToEnd:
+    def test_diurnal_with_swap_and_kill_no_loss_no_shed_above_floor(
+        self, engines, scoped_obs, fleet_params, tmp_path
+    ):
+        """The PR's acceptance run: diurnal traffic, a mid-run model
+        update AND a replica kill. Zero shed above the SLO-class
+        floor, zero lost requests, recompiles 0 -- and the streams
+        are byte-identical to the no-failure replay (the update
+        republishes the same weights, so the swap machinery runs
+        end-to-end -- checksum, drain, place, pool flush -- without
+        changing the greedy oracle)."""
+        sc = _scenario("diurnal", seed=11, n=32, rate=80.0)
+        clean, h0 = _run(
+            engines, sc,
+            _cfg(initial_replicas=2, min_replicas=2,
+                 max_replicas=3),
+        )
+        res_clean = dict(h0.fleet.results)
+        for e in engines:
+            e.reset_pool(force=True)
+        path = tmp_path / "diurnal.jsonl"
+        s, h1 = _run(
+            engines, _scenario("diurnal", seed=11, n=32, rate=80.0),
+            _cfg(initial_replicas=2, min_replicas=2,
+                 max_replicas=3),
+            faults="replica_kill_at=20", path=path,
+            swap_at=30, swap_weights=fleet_params,
+        )
+        fl = s["fleet"]
+        assert fl["replica_down"] == 1
+        assert fl["swapped_replicas"] >= 1
+        assert s["lost_requests"] == 0
+        assert s["_recompiles"] == 0
+        # Zero shed above the SLO-class floor (background is the
+        # floor class -- the only one admission control may drop).
+        for name, t in s["tenants"].items():
+            if name != "background":
+                assert t["shed"] == 0, name
+        assert dict(h1.fleet.results) == res_clean
+        # The run's JSONL is one schema-valid evidence trail, and the
+        # report's fleet section reconstructs the story.
+        records = load_records(str(path), validate=True)
+        rep = build_report(records)
+        assert rep["fleet"] is not None
+        assert rep["fleet"]["replica_down"] == 1
+        assert rep["fleet"]["redispatched"] == fl["redispatched"]
+        flat = report_metrics(rep)
+        assert flat["fleet.replica_down"] == 1.0
+        assert "fleet.prefix_affinity_hit_rate" in flat
+
+
+class TestChaosSweep:
+    """The full chaos sweep (slow tier): every fault class against
+    the diurnal mix at a larger scale, both routers -- the tier-1
+    classes above keep one fast representative each."""
+
+    @pytest.mark.parametrize("router", ["affinity", "round_robin"])
+    @pytest.mark.parametrize("faults", [
+        "replica_kill_at=30",
+        "slow_replica=1:6",
+        "replica_kill_at=25,slow_replica=2:4",
+        "swap_corrupt=1",
+    ], ids=["kill", "slow", "kill_slow", "corrupt_swap"])
+    def test_sweep_no_loss_no_shed_above_floor(
+        self, engines, scoped_obs, fleet_params_v2, faults, router
+    ):
+        swap = "swap_corrupt" in faults
+        s, h = _run(
+            engines, _scenario("diurnal", seed=3, n=48, rate=100.0),
+            FleetConfig(
+                initial_replicas=2, min_replicas=1, max_replicas=4,
+                router=router, scale_window=8, scale_cooldown=12,
+            ),
+            faults=faults,
+            swap_at=40 if swap else None,
+            swap_weights=fleet_params_v2 if swap else None,
+        )
+        assert s["lost_requests"] == 0
+        assert s["_recompiles"] == 0
+        for name, t in s["tenants"].items():
+            if name != "background":
+                assert t["shed"] == 0, (faults, router, name)
+        for e in engines:
+            e.reset_pool(force=True)
+
+
+# ---------------------------------------------------------------------
+# CI wiring: schema, regress directions, the committed banked rows
+# ---------------------------------------------------------------------
+class TestFleetObsWiring:
+    def test_fleet_events_round_trip_schema(self):
+        from tpu_hpc.obs.schema import stamp
+
+        for rec in (
+            {"event": "fleet_route", "rid": "r1", "replica": 0,
+             "tenant": "t", "affinity": True},
+            {"event": "replica_down", "replica": 1,
+             "reason": "heartbeat_timeout", "inflight": 3,
+             "redispatched": 3, "last_beat_age_s": 0.3},
+            {"event": "replica_up", "replica": 1,
+             "reason": "restart", "weights_version": 2},
+            {"event": "redispatch", "rid": "r1", "from_replica": 1,
+             "to_replica": 0, "committed": 4, "tenant": "t"},
+            {"event": "fleet_scale", "action": "grow", "live": 3,
+             "replica": 2, "occupancy": 0.9, "reason": "occupancy"},
+            {"event": "weight_swap", "replica": 0, "version": 2,
+             "status": "rolled_back", "reason": "mismatch",
+             "mismatched": 1},
+        ):
+            validate_record(stamp(rec))
+
+    def test_fleet_events_stay_closed(self):
+        from tpu_hpc.obs.schema import SchemaError, stamp
+
+        with pytest.raises(SchemaError, match="unknown"):
+            validate_record(stamp({
+                "event": "redispatch", "rid": "r", "from_replica": 0,
+                "to_replica": 1, "bogus": 1,
+            }))
+
+    def test_regress_directions_for_fleet_metrics(self):
+        # The robustness counters regress by going UP...
+        assert lower_is_better("fleet.redispatched")
+        assert lower_is_better("fleet.replica_down")
+        assert lower_is_better("fleet.swap_rollbacks")
+        assert lower_is_better(
+            "loadgen_diurnal_fleet_ttft_ms_p95.lost_requests"
+        )
+        assert lower_is_better(
+            "loadgen_diurnal_fleet_ttft_ms_p95.redispatched"
+        )
+        # ...while the router mechanism regresses by going DOWN
+        # (higher-is-better by token absence, the acceptance_rate
+        # pattern).
+        assert not lower_is_better("fleet.prefix_affinity_hit_rate")
+        assert not lower_is_better(
+            "loadgen_diurnal_fleet_ttft_ms_p95.prefix_affinity_hit_rate"
+        )
+
+    def test_banked_side_keys_carry_fleet_mechanisms(self):
+        # The bank reduction reads ONLY the record top level, so the
+        # affinity outcome AND the robustness counters must be side
+        # keys (and bench.loadgen_record lifts them) -- nested-only
+        # counters would make the gate's robustness-drift promise
+        # vacuous (review finding).
+        from tpu_hpc.obs.regress import _BANKED_SIDE_KEYS
+
+        for k in ("prefix_affinity_hit_rate", "redispatched",
+                  "replica_down", "swap_rollbacks", "lost_requests"):
+            assert k in _BANKED_SIDE_KEYS, k
+        import json
+
+        for line in open(os.path.join(REPO, "BENCH_FLEET_r14.jsonl")):
+            rec = json.loads(line)
+            for k in ("prefix_affinity_hit_rate", "redispatched",
+                      "replica_down", "swap_rollbacks",
+                      "lost_requests"):
+                assert k in rec, (rec["metric"], k)
+
+    def test_committed_fleet_rows_pass_the_bank_gate(self, capsys):
+        """The acceptance's CI leg: the banked diurnal/shared_prefix
+        fleet rows are schema-valid and pass ``regress --bank``
+        against the committed BENCH_HISTORY.jsonl high-water marks."""
+        hist = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+        rows = os.path.join(REPO, "BENCH_FLEET_r14.jsonl")
+        recs = load_records(rows, validate=True)
+        metrics = {r["metric"] for r in recs}
+        assert "loadgen_diurnal_fleet_ttft_ms_p95" in metrics
+        rc = regress_main([hist, rows, "--bank"])
+        assert rc == 0, capsys.readouterr().out

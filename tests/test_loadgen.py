@@ -196,15 +196,24 @@ class TestScenarios:
         TenantClass("t", slo={"ttft_ms_p95": 100.0})  # known: fine
 
     def test_fault_spec_parsing(self):
-        assert parse_faults("") == {
-            "prefill_delay": 1.0, "decode_delay": 1.0,
-        }
+        from tpu_hpc.loadgen import FAULT_DEFAULTS
+
+        assert parse_faults("") == dict(FAULT_DEFAULTS)
         got = parse_faults("prefill_delay=1.5, decode_delay=2")
-        assert got == {"prefill_delay": 1.5, "decode_delay": 2.0}
-        with pytest.raises(ValueError, match="unknown loadgen fault"):
+        assert got["prefill_delay"] == 1.5
+        assert got["decode_delay"] == 2.0
+        with pytest.raises(ValueError, match="unknown fault key"):
             parse_faults("ttft=2")
-        with pytest.raises(ValueError, match="must be > 0"):
+        # Malformed values name the key, the full spec, and the
+        # expected type (the resilience/faults.py discipline, shared
+        # via parse_kv_spec -- a bare float() traceback would point
+        # at the parser instead of the operator's typo).
+        with pytest.raises(ValueError, match="positive number"):
             parse_faults("decode_delay=0")
+        with pytest.raises(
+            ValueError, match="'decode_delay'.*expected"
+        ):
+            parse_faults("decode_delay=fast")
 
     def test_shared_prefix_tenants_share_a_system_prompt(self):
         """Every request of a tenant opens with the SAME token

@@ -8,10 +8,14 @@ driven on a virtual clock so latency quantiles replay bit-identically
 """
 from tpu_hpc.loadgen.harness import (  # noqa: F401
     ENV_FAULTS,
+    FAULT_DEFAULTS,
+    FLEET_FAULT_KEYS,
     LoadHarness,
     LoadMeter,
     VirtualClock,
+    fleet_faults_set,
     parse_faults,
+    tenant_summary,
 )
 from tpu_hpc.loadgen.scenarios import (  # noqa: F401
     SCENARIOS,
@@ -24,6 +28,8 @@ from tpu_hpc.loadgen.scenarios import (  # noqa: F401
 
 __all__ = [
     "ENV_FAULTS",
+    "FAULT_DEFAULTS",
+    "FLEET_FAULT_KEYS",
     "LoadHarness",
     "LoadMeter",
     "LoadRequest",
@@ -33,5 +39,7 @@ __all__ = [
     "TenantClass",
     "VirtualClock",
     "build_scenario",
+    "fleet_faults_set",
     "parse_faults",
+    "tenant_summary",
 ]

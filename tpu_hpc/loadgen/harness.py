@@ -48,25 +48,101 @@ from tpu_hpc.loadgen.scenarios import Scenario
 
 ENV_FAULTS = "TPU_HPC_LOADGEN_FAULTS"
 
+# Faults only the multi-replica fleet harness (serve/fleet.py) can
+# inject: a single-engine LoadHarness has no replica to kill, slow
+# down, or hand a corrupt weight swap. LoadHarness hard-rejects them
+# (below) -- a fleet fault silently doing nothing on a single-engine
+# run is exactly the vacuous-chaos-test failure this parser exists to
+# prevent.
+FLEET_FAULT_KEYS = ("replica_kill_at", "swap_corrupt", "slow_replica")
 
-def parse_faults(spec: Optional[str] = None) -> Dict[str, float]:
-    """``"prefill_delay=1.5,decode_delay=2"`` -> multipliers dict.
-    Unknown keys raise: a typoed fault silently injecting nothing
+
+def _cost_multiplier(v: str) -> float:
+    x = float(v)
+    if x <= 0:
+        raise ValueError(v)
+    return x
+
+
+def _fleet_tick(v: str) -> int:
+    x = int(v)
+    if x < 0:
+        raise ValueError(v)
+    return x
+
+
+def _bool01(v: str) -> bool:
+    x = int(v)
+    if x not in (0, 1):
+        raise ValueError(v)
+    return bool(x)
+
+
+def _slow_replica(v: str) -> "tuple[int, float]":
+    idx, sep, factor = v.partition(":")
+    if not sep:
+        raise ValueError(v)
+    i, f = int(idx), float(factor)
+    if i < 0 or f <= 0:
+        raise ValueError(v)
+    return (i, f)
+
+
+# key -> (cast, expected-type text) for the shared typed parser
+# (resilience/faults.parse_kv_spec -- one loop, one error discipline
+# for TPU_HPC_FAULTS and TPU_HPC_LOADGEN_FAULTS alike).
+_FAULT_CASTS = {
+    "prefill_delay": (
+        _cost_multiplier, "a positive number (cost multiplier, > 0)",
+    ),
+    "decode_delay": (
+        _cost_multiplier, "a positive number (cost multiplier, > 0)",
+    ),
+    "replica_kill_at": (
+        _fleet_tick, "a non-negative integer (fleet tick index)",
+    ),
+    "swap_corrupt": (_bool01, "0 or 1"),
+    "slow_replica": (
+        _slow_replica,
+        "'<replica>:<factor>' (non-negative int : factor > 0)",
+    ),
+}
+
+FAULT_DEFAULTS: Dict[str, object] = {
+    "prefill_delay": 1.0,
+    "decode_delay": 1.0,
+    "replica_kill_at": None,
+    "swap_corrupt": False,
+    "slow_replica": None,
+}
+
+
+def parse_faults(spec: Optional[str] = None) -> Dict[str, object]:
+    """``"prefill_delay=1.5,replica_kill_at=40"`` -> fault dict over
+    :data:`FAULT_DEFAULTS`. Unknown keys AND malformed values raise a
+    typed error naming the key, the full spec and the expected type
+    (resilience/faults.py's parse discipline, shared via
+    ``parse_kv_spec``): a typoed fault silently injecting nothing
     would make the gate's failure proof vacuous."""
+    from tpu_hpc.resilience.faults import parse_kv_spec
+
     if spec is None:
         spec = os.environ.get(ENV_FAULTS, "")
-    out = {"prefill_delay": 1.0, "decode_delay": 1.0}
-    for part in filter(None, (p.strip() for p in spec.split(","))):
-        key, _, val = part.partition("=")
-        if key not in out:
-            raise ValueError(
-                f"unknown loadgen fault {key!r} "
-                f"(known: {', '.join(sorted(out))})"
-            )
-        out[key] = float(val)
-        if out[key] <= 0:
-            raise ValueError(f"fault {key}={val}: must be > 0")
+    out: Dict[str, object] = dict(FAULT_DEFAULTS)
+    out.update(parse_kv_spec(spec, ENV_FAULTS, _FAULT_CASTS))
     return out
+
+
+def fleet_faults_set(faults: Dict[str, object]) -> "list[str]":
+    """The fleet-only fault keys armed (non-default) in ``faults``.
+    Identity checks, not ``in (None, False)``: ``replica_kill_at=0``
+    is a legal armed value that compares equal to False, and
+    treating it as unarmed would let a kill-at-tick-0 fault slip
+    silently through the single-engine harness's guard."""
+    return [
+        k for k in FLEET_FAULT_KEYS
+        if not (faults.get(k) is None or faults.get(k) is False)
+    ]
 
 
 class VirtualClock:
@@ -84,6 +160,20 @@ class VirtualClock:
         if dt_s < 0:
             raise ValueError(f"cannot advance clock by {dt_s}")
         self._t += dt_s
+
+    def jump_to(self, t_s: float) -> None:
+        """Set the clock to an absolute time, BACKWARD jumps allowed.
+        Single-timeline consumers never need this; the fleet harness
+        (serve/fleet.py) multiplexes N per-replica timelines through
+        one meter clock -- each replica tick rewinds the shared clock
+        to that replica's local time, so concurrent replicas charge
+        OVERLAPPING virtual intervals instead of serializing (adding
+        a replica must reduce latency, not add its tick costs to the
+        global clock). Per-request timestamps stay monotonic: a
+        request lives on one replica's timeline at a time, and
+        redispatch only ever moves it to a replica whose local time
+        has already passed the detection timeout."""
+        self._t = float(t_s)
 
 
 class _CostModelEngine:
@@ -281,6 +371,66 @@ class LoadMeter(ServeMeter):
         )
 
 
+def tenant_summary(
+    scenario: Scenario,
+    meter: "LoadMeter",
+    spec_by_tenant: Optional[Dict[str, Dict[str, int]]] = None,
+):
+    """Per-tenant quantiles, lifecycle counts and SLO verdicts from a
+    LoadMeter -- ``(tenants, slo_violations, violated_tenants)``. One
+    aggregation for the single-engine LoadHarness and the fleet
+    harness (serve/fleet.py): the SLO verdict logic must not fork.
+
+    ``violated_tenants`` keeps the violating tenant NAMES next to the
+    composite ``"<tenant>.<metric>"`` strings -- consumers (the
+    capture trigger) must not re-parse the composites (a tenant name
+    containing '.' would truncate)."""
+    spec_by_tenant = spec_by_tenant or {}
+    tenants = {}
+    slo_violations: List[str] = []
+    violated_tenants: List[str] = []
+    for t in scenario.tenants:
+        ttfts = sorted(meter.ttft_ms.get(t.name, []))
+        itls = sorted(meter.itl_ms.get(t.name, []))
+        entry = {
+            "priority": t.priority,
+            "finished": meter.finished_by.get(t.name, 0),
+            "shed": meter.shed_by.get(t.name, 0),
+            "queued": meter.queued_by.get(t.name, 0),
+            "ttft_ms_p50": quantile(ttfts, 0.50),
+            "ttft_ms_p95": quantile(ttfts, 0.95),
+            "ttft_ms_p99": quantile(ttfts, 0.99),
+            "itl_ms_p50": quantile(itls, 0.50),
+            "itl_ms_p95": quantile(itls, 0.95),
+        }
+        st = spec_by_tenant.get(t.name)
+        if st is not None:
+            # Per-request-class acceptance evidence: the banked
+            # rows report acceptance per scenario AND per tenant.
+            entry["spec_drafted"] = st["drafted"]
+            entry["spec_accepted"] = st["accepted"]
+            entry["acceptance_rate"] = (
+                st["accepted"] / st["drafted"]
+                if st["drafted"] else 0.0
+            )
+        if t.slo:
+            # entry[k], not .get(): TenantClass validated the SLO
+            # keys against SLO_METRICS, and a drift between that
+            # set and what summarize produces must crash, not
+            # silently never-violate.
+            violated = sorted(
+                k for k, bound in t.slo.items()
+                if entry[k] > bound
+            )
+            entry["slo"] = dict(t.slo)
+            entry["slo_violated"] = violated
+            slo_violations += [f"{t.name}.{k}" for k in violated]
+            if violated:
+                violated_tenants.append(t.name)
+        tenants[t.name] = entry
+    return tenants, slo_violations, violated_tenants
+
+
 class LoadHarness:
     """One scenario end to end: submit arrivals on schedule, tick the
     batcher, watch the stall watermark, aggregate per-tenant SLOs."""
@@ -304,9 +454,21 @@ class LoadHarness:
         # flight dump keyed by the triggering trace id. None = off.
         self.capture = capture
         self.clock = VirtualClock()
+        faults = faults if faults is not None else parse_faults()
+        armed = fleet_faults_set(faults)
+        if armed:
+            # A fleet fault on a single-engine harness has no replica
+            # to kill/slow/corrupt -- silently ignoring it would make
+            # the chaos test it belongs to pass vacuously (the
+            # unknown-key discipline, applied to misplaced keys).
+            raise ValueError(
+                f"fleet fault(s) {armed} need the fleet harness "
+                "(serve/fleet.FleetHarness); LoadHarness drives one "
+                "engine and cannot inject them"
+            )
         self.engine = _CostModelEngine(
             engine, self.clock, decode_step_ms, prefill_ms_per_token,
-            faults if faults is not None else parse_faults(),
+            faults,
         )
         self.meter = LoadMeter(metrics_path=metrics_path,
                                clock=self.clock)
@@ -488,52 +650,9 @@ class LoadHarness:
             peak_flops_per_device=peak_flops_per_device,
         )
         m = self.meter
-        tenants = {}
-        slo_violations: List[str] = []
-        # The violating tenant NAMES, kept next to the composite
-        # "<tenant>.<metric>" strings -- the capture trigger below
-        # must not re-parse them (a tenant name containing '.' would
-        # truncate).
-        violated_tenants: List[str] = []
-        for t in self.scenario.tenants:
-            ttfts = sorted(m.ttft_ms.get(t.name, []))
-            itls = sorted(m.itl_ms.get(t.name, []))
-            entry = {
-                "priority": t.priority,
-                "finished": m.finished_by.get(t.name, 0),
-                "shed": m.shed_by.get(t.name, 0),
-                "queued": m.queued_by.get(t.name, 0),
-                "ttft_ms_p50": quantile(ttfts, 0.50),
-                "ttft_ms_p95": quantile(ttfts, 0.95),
-                "ttft_ms_p99": quantile(ttfts, 0.99),
-                "itl_ms_p50": quantile(itls, 0.50),
-                "itl_ms_p95": quantile(itls, 0.95),
-            }
-            st = self.batcher.spec_by_tenant.get(t.name)
-            if st is not None:
-                # Per-request-class acceptance evidence: the banked
-                # rows report acceptance per scenario AND per tenant.
-                entry["spec_drafted"] = st["drafted"]
-                entry["spec_accepted"] = st["accepted"]
-                entry["acceptance_rate"] = (
-                    st["accepted"] / st["drafted"]
-                    if st["drafted"] else 0.0
-                )
-            if t.slo:
-                # entry[k], not .get(): TenantClass validated the SLO
-                # keys against SLO_METRICS, and a drift between that
-                # set and what summarize produces must crash, not
-                # silently never-violate.
-                violated = sorted(
-                    k for k, bound in t.slo.items()
-                    if entry[k] > bound
-                )
-                entry["slo"] = dict(t.slo)
-                entry["slo_violated"] = violated
-                slo_violations += [f"{t.name}.{k}" for k in violated]
-                if violated:
-                    violated_tenants.append(t.name)
-            tenants[t.name] = entry
+        tenants, slo_violations, violated_tenants = tenant_summary(
+            self.scenario, m, self.batcher.spec_by_tenant
+        )
         occ = sorted(self._occupancy)
         # The cache layout is part of the run's identity (a paged
         # quantile must never be diffed against a slab one unlabeled);

@@ -32,7 +32,16 @@ replay `python -m tpu_hpc.serve` ships:
                         where ITL (not TTFT) is the product metric,
                         and the speculative-decoding acceptance
                         scenario (serve/spec.py): the prefill-bound
-                        mixes above cannot show a decode-side win.
+                        mixes above cannot show a decode-side win;
+* ``diurnal``           day/night traffic: a sinusoidally-modulated
+                        arrival rate (peaks oversubscribe a minimal
+                        replica set, troughs idle it) over three
+                        tenant classes with per-tenant system
+                        prompts -- the serving-fleet acceptance
+                        scenario (serve/fleet.py): autoscale rides
+                        the swings, prefix affinity rides the
+                        prompts, and the chaos harness injects a
+                        mid-run weight swap + replica kill on top.
 """
 from __future__ import annotations
 
@@ -233,6 +242,46 @@ def _assemble(
     )
 
 
+def diurnal_arrivals(
+    rng: np.random.Generator,
+    n: int,
+    rate_per_s: float,
+    cycles: float = 2.0,
+    trough_frac: float = 0.2,
+) -> np.ndarray:
+    """Nonhomogeneous Poisson arrivals whose rate swings
+    sinusoidally between ``trough_frac * rate_per_s`` and
+    ``rate_per_s`` over ``cycles`` full day/night cycles across the
+    ``n`` arrivals -- thinning over a homogeneous process at the
+    peak rate, so the schedule stays a pure function of the rng
+    stream. The period is derived from the EXPECTED span of ``n``
+    arrivals at the mean rate, so the same shape scales with ``n``."""
+    if not 0.0 < trough_frac <= 1.0:
+        raise ValueError(
+            f"trough_frac {trough_frac} must be in (0, 1]"
+        )
+    if cycles <= 0:
+        raise ValueError(f"cycles {cycles} must be > 0")
+    if rate_per_s <= 0:
+        raise ValueError(f"rate_per_s {rate_per_s} must be > 0")
+    mean_rate = rate_per_s * (1.0 + trough_frac) / 2.0
+    period_s = (n / mean_rate) / cycles
+    out: List[float] = []
+    t = 0.0
+    while len(out) < n:
+        t += rng.exponential(1.0 / rate_per_s)
+        phase = 2.0 * np.pi * (t / period_s)
+        # rate(t)/rate_max in [trough_frac, 1]; start at the peak so
+        # the run opens under load (the autoscale-up case) and dips
+        # mid-run (the drain-down case).
+        accept_p = trough_frac + (1.0 - trough_frac) * (
+            0.5 * (1.0 + np.cos(phase))
+        )
+        if rng.random() < accept_p:
+            out.append(t * 1e3)
+    return np.asarray(out)
+
+
 # -- the catalog -------------------------------------------------------
 def build_scenario(
     name: str,
@@ -423,6 +472,51 @@ def build_scenario(
             vocab_size=vocab_size,
         )
 
+    if name == "diurnal":
+        # Day/night swings over three classes WITH per-tenant system
+        # prompts: the fleet acceptance scenario. ``background`` is
+        # the SLO-class floor -- the only class the zero-shed-above-
+        # the-floor contract allows admission control to drop under
+        # pressure. Generous SLO bounds: the chaos runs this gates
+        # (replica kill + weight swap mid-run) must breach them only
+        # when failure handling actually regresses, not on ordinary
+        # peak queueing.
+        tenants = (
+            TenantClass(
+                "interactive", priority=2, share=0.45,
+                slo={"ttft_ms_p95": 4000.0},
+            ),
+            TenantClass(
+                "batch", priority=1, share=0.35,
+                slo={"ttft_ms_p95": 12000.0},
+            ),
+            TenantClass("background", priority=0, share=0.2),
+        )
+        sys_len = min(max(2, max_prompt // 2), max_prompt - 1)
+        prefixes = {
+            t.name: tuple(
+                int(x)
+                for x in rng.integers(0, vocab_size, size=sys_len)
+            )
+            for t in tenants
+        }
+        shares = np.array([t.share for t in tenants])
+        tenant_of = rng.choice(
+            len(tenants), size=n, p=shares / shares.sum()
+        )
+        suffix_hi = max(1, max_prompt - sys_len)
+        return _assemble(
+            name, seed, rng, tenants, tenant_of,
+            diurnal_arrivals(rng, n, rate_per_s),
+            heavy_tail_lengths(
+                rng, n, median=max(2.0, suffix_hi / 3), sigma=0.8,
+                lo=1, hi=suffix_hi,
+            ),
+            rng.integers(2, max_new + 1, size=n),
+            vocab_size,
+            prefixes=prefixes,
+        )
+
     assert name == "colocate"
     # Two classes: when the colocated train step trips the stall
     # watermark, admission control sheds `background` and the
@@ -455,4 +549,5 @@ def build_scenario(
 SCENARIOS: Tuple[str, ...] = (
     "steady", "bursty", "heavy_tail", "multi_tenant",
     "saturating_burst", "colocate", "shared_prefix", "decode_heavy",
+    "diurnal",
 )

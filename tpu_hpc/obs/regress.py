@@ -85,11 +85,21 @@ _LOWER_IS_BETTER = (
     "ttft", "itl", "_ms", "latency", "shed", "stall", "queued",
     "wire_bytes", "inflight", "rejected",
     "rollback", "fallback", "poisoned", "spike", "skipped",
-    "lost_steps", "integrity_fail", "nonfinite",
+    "lost_steps", "lost_requests", "integrity_fail", "nonfinite",
     # HBM high-water mark (the device_memory events): a higher peak
     # at the same workload is a memory regression -- the fit-check's
     # budget erodes before anything OOMs.
     "hbm_peak",
+    # Serving-fleet robustness counters (serve/fleet.py): more
+    # redispatched requests, more replicas lost, or more swap
+    # rollbacks at the same chaos schedule means failure handling
+    # got worse -- the --bank gate fails on fleet-robustness drift
+    # like it does on guard/ckpt drift.
+    # "fleet.prefix_affinity_hit_rate" deliberately matches NO token
+    # here: like prefix_hit_rate and acceptance_rate it judges
+    # higher-is-better by absence -- a router change that cools the
+    # per-replica tries fails the gate.
+    "redispatch", "replica_down", "swap",
 )
 
 
@@ -156,6 +166,22 @@ def report_metrics(rep: dict) -> Dict[str, float]:
                   "shed", "queued"):
             if k in lg:
                 flat[f"loadgen.{k}"] = float(lg[k])
+    fl = rep.get("fleet")
+    if fl:
+        # The robustness counters are the judged signals (all
+        # lower-is-better via the redispatch/replica_down/swap
+        # tokens) plus the router's affinity outcome (higher by
+        # absence). replicas / live range / scale decisions are
+        # CONFIG-cum-behavior identity -- a deliberate re-size or a
+        # different autoscale schedule must not fail the gate by
+        # itself; its latency consequences already do.
+        flat["fleet.replica_down"] = float(fl["replica_down"])
+        flat["fleet.redispatched"] = float(fl["redispatched"])
+        flat["fleet.swap_rollbacks"] = float(fl["swap_rollbacks"])
+        if "prefix_affinity_hit_rate" in fl:
+            flat["fleet.prefix_affinity_hit_rate"] = float(
+                fl["prefix_affinity_hit_rate"]
+            )
     g = rep.get("guard")
     if g:
         flat["guard.poisoned"] = float(g["poisoned"])
@@ -189,6 +215,19 @@ _BANKED_SIDE_KEYS = (
     "ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
     "itl_ms_p50", "itl_ms_p95", "itl_ms_p99", "mfu",
     "acceptance_rate",
+    # Fleet rows (serve/fleet.py): the router's prefix-affinity
+    # outcome is the MECHANISM metric next to the latency headline --
+    # a routing change that destroys per-replica trie warmth must
+    # fail --bank even while the diurnal quantiles still ride within
+    # tolerance (higher-is-better by token absence, like
+    # acceptance_rate) -- and the robustness counters ride as side
+    # keys too (producers lift them to the record top level;
+    # sub-dict fields are deliberately not walked), so a chaos
+    # schedule that starts losing replicas, replaying more requests
+    # or rolling back swaps fails the gate even at equal latency.
+    "prefix_affinity_hit_rate",
+    "redispatched", "replica_down", "swap_rollbacks",
+    "lost_requests",
 )
 
 

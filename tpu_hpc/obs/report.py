@@ -271,6 +271,57 @@ def _loadgen(records: Sequence[dict]) -> Optional[dict]:
     return out
 
 
+def _fleet(records: Sequence[dict]) -> Optional[dict]:
+    """Serving-fleet breakdown (serve/fleet.py): replica losses,
+    redispatched requests, weight-swap outcomes and autoscale
+    decisions from the fleet lifecycle events, plus the router's
+    prefix-affinity outcome from the closing summary -- the
+    robustness counters the regress gate's ``fleet.*`` namespace
+    judges."""
+    downs = [r for r in records if r.get("event") == "replica_down"]
+    ups = [r for r in records if r.get("event") == "replica_up"]
+    redispatches = [
+        r for r in records if r.get("event") == "redispatch"
+    ]
+    swaps = [r for r in records if r.get("event") == "weight_swap"]
+    scales = [r for r in records if r.get("event") == "fleet_scale"]
+    summaries = [
+        r for r in records
+        if r.get("event") == "serve_summary" and "fleet" in r
+    ]
+    if not (downs or ups or redispatches or swaps or scales
+            or summaries):
+        return None
+    out = {
+        "replica_down": len(downs),
+        "redispatched": len(redispatches),
+        "restarts": sum(
+            1 for r in ups if r["reason"] == "restart"
+        ),
+        "swapped_replicas": sum(
+            1 for r in swaps if r["status"] == "swapped"
+        ),
+        "swap_rollbacks": sum(
+            1 for r in swaps if r["status"] == "rolled_back"
+        ),
+        "scale_ups": sum(
+            1 for r in scales if r["action"] == "grow"
+        ),
+        "scale_downs": sum(
+            1 for r in scales if r["action"] == "shrink"
+        ),
+    }
+    if summaries:
+        f = summaries[-1]["fleet"]
+        for k in ("replicas", "live_min", "live_max",
+                  "prefix_affinity_hit_rate", "router",
+                  "affinity_routes", "weights_version",
+                  "mixed_weights"):
+            if k in f:
+                out[k] = f[k]
+    return out
+
+
 def _guard(records: Sequence[dict]) -> Optional[dict]:
     """Numeric-health guard breakdown: verdict counts, skip count,
     and the rollback timeline with its goodput cost (steps re-trained
@@ -397,6 +448,7 @@ def build_report(
         ],
         "serve": _serve(records),
         "loadgen": _loadgen(records),
+        "fleet": _fleet(records),
         "guard": _guard(records),
         "ckpt": _ckpt(records),
         "memory": _memory(records),
@@ -619,6 +671,24 @@ def format_report(rep: dict) -> str:
             lines.append(
                 "- SLO VIOLATED: " + ", ".join(lg["slo_violations"])
             )
+    fl = rep.get("fleet")
+    if fl is not None:
+        lines += [
+            "",
+            "## Serving fleet",
+            "",
+            f"- replicas: {fl.get('replicas', '?')} "
+            f"(live {fl.get('live_min', '?')}..{fl.get('live_max', '?')}); "
+            f"router {fl.get('router', '?')}, prefix-affinity hit "
+            f"rate {fl.get('prefix_affinity_hit_rate', 0.0):.0%}",
+            f"- failures: {fl['replica_down']} replica(s) down, "
+            f"{fl['redispatched']} request(s) redispatched, "
+            f"{fl['restarts']} restart(s)",
+            f"- weight swaps: {fl['swapped_replicas']} swapped, "
+            f"{fl['swap_rollbacks']} rolled back (checksum)",
+            f"- autoscale: {fl['scale_ups']} grow, "
+            f"{fl['scale_downs']} shrink",
+        ]
     return "\n".join(lines) + "\n"
 
 

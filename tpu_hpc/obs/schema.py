@@ -251,6 +251,48 @@ EVENTS: Dict[str, EventSpec] = {
     "ckpt_fallback": EventSpec(
         ("step", "error"), optional=("quarantined",),
     ),
+    # -- multi-replica serving fleet (serve/fleet.py): the failure-
+    #    handling contract's evidence trail. Routing runs at request
+    #    cadence, so producers emit fleet_route ring-only (the
+    #    lg_token discipline); the lifecycle edges below are rare and
+    #    land in the sink. --
+    "fleet_route": EventSpec(
+        ("rid", "replica"),
+        optional=("tenant", "affinity", "reason"),
+    ),
+    # A replica left the serving set: heartbeat timeout (killed /
+    # wedged), with its in-flight count and how many requests were
+    # re-dispatched onto survivors.
+    "replica_down": EventSpec(
+        ("replica", "reason"),
+        optional=("inflight", "redispatched", "last_beat_age_s"),
+    ),
+    # A replica (re)joined: bring-up, jittered-backoff restart after
+    # death, or autoscale activation of a warm standby.
+    "replica_up": EventSpec(
+        ("replica", "reason"), optional=("weights_version",),
+    ),
+    # One in-flight request replayed onto a survivor from prompt +
+    # committed tokens (seeded/greedy determinism makes the resumed
+    # stream byte-identical to the no-failure run).
+    "redispatch": EventSpec(
+        ("rid", "from_replica", "to_replica"),
+        optional=("committed", "tenant"),
+    ),
+    # Autoscaler decisions over the occupancy gauge + block-stall
+    # watermark: grow (standby -> live), drain_start, shrink
+    # (drained -> standby).
+    "fleet_scale": EventSpec(
+        ("action", "live"),
+        optional=("replica", "occupancy", "reason"),
+    ),
+    # Live weight hot-swap lifecycle per replica: drain_start ->
+    # swapped, or corrupt -> rolled_back when the content checksums
+    # (ckpt/integrity.py) catch a bad artifact.
+    "weight_swap": EventSpec(
+        ("replica", "version", "status"),
+        optional=("reason", "mismatched"),
+    ),
     # -- supervisor attempt log (resilience/supervisor.py) --
     "attempt_start": EventSpec(("attempt", "cmd")),
     "attempt_end": EventSpec(

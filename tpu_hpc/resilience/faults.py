@@ -259,21 +259,25 @@ def corrupt_file(path: str) -> None:
         f.write(b"\x00TPU_HPC_FAULT_CORRUPTED\x00")
 
 
-def fault_plan_from_env(env=None) -> Optional[FaultPlan]:
-    """Parse ``TPU_HPC_FAULTS`` ("k=v,k=v"); None when unset (the
-    production default -- every injection site is a no-op).
+def parse_kv_spec(spec: str, env_name: str, casts) -> dict:
+    """Parse a ``"key=value,key=value"`` fault/config spec with the
+    typed-error discipline every injection spec in this repo follows:
 
-    Unknown keys are a hard error: a typo'd fault spec silently
-    injecting nothing would make a resilience test pass vacuously.
-    A malformed VALUE is equally hard an error, and names the key and
-    the full spec (same discipline) -- a bare ``int()`` traceback
-    would point at this module instead of the operator's typo.
-    Duplicate keys are last-wins, like the env vars they ride in on.
+    * unknown keys are a hard error naming the key, the FULL spec and
+      the known-key set -- a typo'd key silently injecting nothing
+      makes a chaos test pass vacuously;
+    * malformed values are a hard error naming the key, the spec and
+      the expected type -- a bare ``int()`` traceback would point at
+      the parser instead of the operator's typo;
+    * duplicate keys are last-wins, like the env vars they ride in on.
+
+    ``casts`` maps each known key to ``(cast_fn, expected_kind)``;
+    ``cast_fn`` raising ``ValueError`` marks the value malformed (so
+    range checks belong inside the cast). Returns ``{key: parsed}``
+    for the keys present. The one parse loop shared by
+    ``TPU_HPC_FAULTS`` (this module) and ``TPU_HPC_LOADGEN_FAULTS``
+    (tpu_hpc/loadgen/harness.py) -- the disciplines must not fork.
     """
-    env = os.environ if env is None else env
-    spec = env.get(ENV_FAULTS, "").strip()
-    if not spec:
-        return None
     fields: dict = {}
     for part in spec.split(","):
         part = part.strip()
@@ -281,20 +285,35 @@ def fault_plan_from_env(env=None) -> Optional[FaultPlan]:
             continue
         key, _, val = part.partition("=")
         key = key.strip()
-        if key in _INT_KEYS:
-            cast, kind = int, "an integer"
-        elif key in _FLOAT_KEYS:
-            cast, kind = float, "a number"
-        else:
+        if key not in casts:
             raise ValueError(
-                f"unknown fault key {key!r} in {ENV_FAULTS}={spec!r} "
-                f"(known: {', '.join(_INT_KEYS + _FLOAT_KEYS)})"
+                f"unknown fault key {key!r} in {env_name}={spec!r} "
+                f"(known: {', '.join(sorted(casts))})"
             )
+        cast, kind = casts[key]
         try:
             fields[key] = cast(val.strip())
         except ValueError:
             raise ValueError(
                 f"invalid value {val.strip()!r} for fault key "
-                f"{key!r} in {ENV_FAULTS}={spec!r}: expected {kind}"
+                f"{key!r} in {env_name}={spec!r}: expected {kind}"
             ) from None
+    return fields
+
+
+def fault_plan_from_env(env=None) -> Optional[FaultPlan]:
+    """Parse ``TPU_HPC_FAULTS`` ("k=v,k=v"); None when unset (the
+    production default -- every injection site is a no-op). The
+    unknown-key / malformed-value discipline lives in
+    :func:`parse_kv_spec`.
+    """
+    env = os.environ if env is None else env
+    spec = env.get(ENV_FAULTS, "").strip()
+    if not spec:
+        return None
+    casts = {
+        **{k: (int, "an integer") for k in _INT_KEYS},
+        **{k: (float, "a number") for k in _FLOAT_KEYS},
+    }
+    fields = parse_kv_spec(spec, ENV_FAULTS, casts)
     return FaultPlan(attempt=current_attempt(env), **fields)

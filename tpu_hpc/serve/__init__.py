@@ -38,6 +38,32 @@ from tpu_hpc.serve.weights import (
     serving_pspecs,
 )
 
+# fleet.py exports are lazy (PEP 562, the obs.trace pattern): fleet
+# imports tpu_hpc.loadgen.harness, which imports serve submodules --
+# an eager re-export here would close that loop through the
+# partially-initialized loadgen package when loadgen is imported
+# first. ``from tpu_hpc.serve import ServingFleet`` still works.
+_FLEET_EXPORTS = (
+    "FleetConfig",
+    "FleetHarness",
+    "FleetMeter",
+    "Replica",
+    "ServingFleet",
+    "build_fleet_engines",
+    "split_fleet_meshes",
+)
+
+
+def __getattr__(name):
+    if name in _FLEET_EXPORTS:
+        from tpu_hpc.serve import fleet
+
+        return getattr(fleet, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+
 __all__ = [
     "AdmissionPolicy",
     "BlockAllocator",
@@ -45,20 +71,27 @@ __all__ = [
     "ContinuousBatcher",
     "DisaggEngine",
     "Engine",
+    "FleetConfig",
+    "FleetHarness",
+    "FleetMeter",
     "PagedConfig",
     "PagedEngine",
     "PrefixTrie",
+    "Replica",
     "Request",
     "ServeConfig",
     "ServeMeter",
+    "ServingFleet",
     "SpecConfig",
     "SpecRunner",
     "UnservableRequestError",
     "attach_spec",
+    "build_fleet_engines",
     "derive_request_seed",
     "load_serving_params",
     "place_params",
     "replay_requests",
     "serving_pspecs",
+    "split_fleet_meshes",
     "split_serving_meshes",
 ]

@@ -436,6 +436,51 @@ class Engine:
         number every recompile guard should read."""
         return self.compile_count
 
+    # -- live weight swap ----------------------------------------------
+    def swap_params(self, params: Any) -> None:
+        """Replace the resident weights IN PLACE (live hot-swap,
+        serve/fleet.py). The executable table keys on abstract
+        (shape, dtype, sharding) only, so a tree matching the
+        resident layout swaps with ZERO recompiles -- the next
+        prefill/decode dispatch simply reads the new tree. Anything
+        structurally different is a hard error naming the first
+        mismatch: a silently re-lowered program would blow the
+        steady-state compile pin mid-serve.
+
+        The caller owns the swap DISCIPLINE: cached K/V was computed
+        under the old weights, so a paged engine must be drained and
+        its pool reset (:meth:`PagedEngine.reset_pool`) before
+        serving resumes -- stale cache rows under new weights would
+        be silently wrong, not masked."""
+        old_leaves = jax.tree_util.tree_leaves_with_path(self.params)
+        new_leaves = jax.tree_util.tree_leaves_with_path(params)
+        if len(old_leaves) != len(new_leaves):
+            raise ValueError(
+                f"swap_params: tree has {len(new_leaves)} leaves, "
+                f"resident has {len(old_leaves)}"
+            )
+        for (op, ol), (np_, nl) in zip(old_leaves, new_leaves):
+            if op != np_ or ol.shape != nl.shape \
+                    or ol.dtype != nl.dtype:
+                raise ValueError(
+                    "swap_params: leaf mismatch at "
+                    f"{jax.tree_util.keystr(np_)}: got "
+                    f"{nl.shape}/{nl.dtype} for "
+                    f"{jax.tree_util.keystr(op)} "
+                    f"{ol.shape}/{ol.dtype}"
+                )
+            old_sh = getattr(ol, "sharding", None)
+            new_sh = getattr(nl, "sharding", None)
+            if old_sh is not None and new_sh is not None \
+                    and old_sh != new_sh:
+                raise ValueError(
+                    "swap_params: sharding mismatch at "
+                    f"{jax.tree_util.keystr(np_)} (place the tree "
+                    "through serve/weights.place_params with this "
+                    "engine's param_pspecs first)"
+                )
+        self.params = params
+
     # -- serving ops ----------------------------------------------------
     def _rep_arr(self, value, dtype=jnp.int32):
         return jax.device_put(jnp.asarray(value, dtype), self._rep)

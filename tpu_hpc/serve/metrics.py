@@ -134,9 +134,13 @@ class ServeMeter:
 
     def request_shed(self, rid: str, reason: str = "") -> None:
         """Admission control dropped ``rid`` before it ever got a
-        slot. The trace is removed so the latency quantiles describe
-        only served requests; the shed count rides the summary (a
-        gate that ignored shed load would reward shedding)."""
+        slot. Part of the required meter protocol -- the batcher
+        calls it unconditionally (no hasattr duck-check), so a
+        subclass that typos the override fails loudly instead of
+        silently losing shed telemetry. The trace is removed so the
+        latency quantiles describe only served requests; the shed
+        count rides the summary (a gate that ignored shed load would
+        reward shedding)."""
         self.traces.pop(rid, None)
         self.shed += 1
 

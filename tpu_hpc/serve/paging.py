@@ -1177,6 +1177,44 @@ class PagedEngine(Engine):
         if self.spec is not None:
             self.spec.on_release(slot)
 
+    def reset_pool(self, force: bool = False) -> None:
+        """Drop ALL cached KV state: allocator, prefix trie, block
+        tables, slot bookkeeping. The weight-swap half of the fleet's
+        drain-and-swap contract (serve/fleet.py): every cached page
+        and trie chain encodes K/V computed under the OLD weights, so
+        a hot-swapped replica must flush before serving resumes --
+        and a restarted replica must flush whatever its crashed
+        predecessor left admitted. The device pool buffers keep their
+        (now garbage) contents; a fresh allocator plus scratch-reset
+        tables make every stale row unreachable, exactly the slot-
+        reuse safety argument, applied pool-wide.
+
+        ``force=False`` (the swap path) refuses while requests are
+        still admitted -- swapping under a live request would corrupt
+        its stream, and the caller's drain logic is what must be
+        fixed. ``force=True`` (the dead-replica restart path)
+        abandons the admitted state deliberately: those requests were
+        already redispatched to surviving replicas."""
+        if self._slot_state and not force:
+            raise RuntimeError(
+                f"reset_pool on an undrained engine ({len(self._slot_state)} "
+                "slot(s) still admitted); drain first, or force=True "
+                "on the dead-replica restart path"
+            )
+        if self.spec is not None:
+            raise NotImplementedError(
+                "reset_pool with an attached SpecRunner: the mirrored "
+                "draft pool would desync (the fleet runs plain paged "
+                "engines)"
+            )
+        self._slot_state = {}
+        self.allocator = BlockAllocator(self.paged.num_blocks)
+        if self.trie is not None:
+            self.trie = PrefixTrie(self.paged.block_size)
+        self._tables[:] = SCRATCH_BLOCK
+        self._tables_dev = None
+        self._set_block_gauges()
+
     def spec_decode(self, *args, **kwargs):
         """One speculative decode step (serve/spec.py): draft k
         candidates per slot, verify all k+1 positions in one batched

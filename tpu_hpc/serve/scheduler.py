@@ -357,7 +357,12 @@ class ContinuousBatcher:
         self.stats["shed"] += 1
         reg = get_registry()
         reg.inc("serve_shed_total")
-        if self.meter is not None and hasattr(self.meter, "request_shed"):
+        if self.meter is not None:
+            # request_shed is part of the meter PROTOCOL (base
+            # ServeMeter implements it): a meter missing it fails
+            # loudly here instead of silently losing shed telemetry
+            # -- the old hasattr duck-check let a typo'd override
+            # ride through and the shed counts vanish.
             self.meter.request_shed(req.rid, reason=reason)
         get_bus().emit(
             "admission",

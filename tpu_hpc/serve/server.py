@@ -367,6 +367,103 @@ def run_loadgen(
     )
 
 
+def run_fleet_loadgen(
+    cfg: llama2.LlamaConfig,
+    serve_cfg,
+    scenario_name: str,
+    n_requests: int,
+    max_new_tokens: int,
+    paged,
+    n_replicas: int,
+    min_replicas: int = 1,
+    initial_replicas: Optional[int] = None,
+    router: str = "affinity",
+    swap_at: Optional[int] = None,
+    checkpoint_dir: Optional[str] = None,
+    metrics_path: Optional[str] = None,
+    seed: int = 0,
+) -> dict:
+    """Fleet bring-up + a tpu_hpc.loadgen scenario over N paged
+    replicas on disjoint mesh slices (serve/fleet.py): router by
+    tenant class + prefix affinity, heartbeat-driven failure
+    handling, autoscale between ``min_replicas`` and ``n_replicas``,
+    and -- with ``swap_at`` -- a mid-run live weight update
+    (dev mode publishes a fresh random init at seed+1: a genuinely
+    different model version; production publishes a trained
+    checkpoint through the same content-checksum gate).
+    ``TPU_HPC_LOADGEN_FAULTS`` fleet keys (replica_kill_at,
+    swap_corrupt, slow_replica) inject the chaos matrix."""
+    import jax
+
+    from tpu_hpc.loadgen import build_scenario, parse_faults
+    from tpu_hpc.serve.fleet import (
+        FleetConfig,
+        FleetHarness,
+        build_fleet_engines,
+    )
+    from tpu_hpc.serve.weights import load_serving_params
+
+    from tpu_hpc import obs
+
+    max_prompt = max(serve_cfg.prefill_buckets)
+    max_new = min(
+        max_new_tokens, serve_cfg.max_seq_len - max_prompt
+    )
+    scenario = build_scenario(
+        scenario_name, seed=seed, n_requests=n_requests,
+        vocab_size=cfg.vocab_size, max_prompt=max_prompt,
+        max_new=max_new,
+    )
+    with obs.span("restore", sink=metrics_path,
+                  hist="serve_restore_s"):
+        if checkpoint_dir:
+            # One host-side restore; each engine reshards it onto its
+            # own slice (the train->serve path, N times).
+            mesh = build_serving_mesh(jax.device_count(), cfg)
+            params = load_serving_params(checkpoint_dir, cfg, mesh)
+            params = jax.device_get(params)
+        else:
+            params = llama2.init_llama(jax.random.key(seed), cfg)
+    swap_weights = None
+    if swap_at is not None:
+        swap_weights = llama2.init_llama(jax.random.key(seed + 1), cfg)
+    with obs.span("warmup", sink=metrics_path, hist="serve_warmup_s"):
+        engines = build_fleet_engines(
+            params, cfg, serve_cfg, paged, n_replicas
+        )
+    harness = FleetHarness(
+        engines, scenario,
+        FleetConfig(
+            initial_replicas=(
+                initial_replicas
+                if initial_replicas is not None
+                else max(min_replicas, (n_replicas + 1) // 2)
+            ),
+            min_replicas=min_replicas,
+            max_replicas=n_replicas,
+            router=router,
+        ),
+        metrics_path=metrics_path,
+        faults=parse_faults(),
+        swap_at=swap_at,
+        swap_weights=swap_weights,
+    )
+    n_programs = harness.fleet.compile_count_total()
+    harness.drive()
+    return harness.summarize(
+        n_devices=jax.device_count(),
+        extra=dict(
+            mesh={"replicas": n_replicas},
+            slots=serve_cfg.slots,
+            prefill_buckets=list(serve_cfg.prefill_buckets),
+            compiled_programs=n_programs,
+            recompiles=(
+                harness.fleet.compile_count_total() - n_programs
+            ),
+        ),
+    )
+
+
 def _last_json_line(log_dir: str) -> Optional[str]:
     """The newest attempt log's final JSON line (the child's summary
     record), or None when no attempt log holds one."""
@@ -450,7 +547,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "--loadgen", type=str, default=None, metavar="SCENARIO",
         help="run a tpu_hpc.loadgen scenario instead of the plain "
         "replay mix (catalog: steady, bursty, heavy_tail, "
-        "multi_tenant, saturating_burst, colocate); --requests/"
+        "multi_tenant, saturating_burst, colocate, shared_prefix, "
+        "decode_heavy, diurnal); --requests/"
         "--max-new/--seed size it, latencies run on the virtual "
         "clock (deterministic -- the regress gate's input)",
     )
@@ -532,6 +630,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument(
         "--top-p", type=float, default=None,
         help="nucleus filter for --temperature sampling (default 1.0)",
+    )
+    ap.add_argument(
+        "--fleet", type=int, default=None, metavar="N",
+        help="serve the --loadgen scenario from a fleet of N paged "
+        "replicas on disjoint mesh slices (serve/fleet.py): tenant-"
+        "class + prefix-affinity routing, heartbeat failure handling "
+        "with request redispatch, autoscale, live weight swap; "
+        "requires --loadgen and --paged with --prefill-chunk",
+    )
+    ap.add_argument(
+        "--fleet-min", type=int, default=None, metavar="N",
+        help="autoscaler's minimum live replicas (default 1); "
+        "requires --fleet",
+    )
+    ap.add_argument(
+        "--fleet-router", choices=("affinity", "round_robin"),
+        default=None,
+        help="request placement policy (default affinity; "
+        "round_robin is the documented degraded control -- it "
+        "divides every shared prefix across N cold tries); requires "
+        "--fleet",
+    )
+    ap.add_argument(
+        "--fleet-swap-at", type=int, default=None, metavar="TICK",
+        help="publish a live weight update at this fleet tick "
+        "(dev mode: a fresh random init at seed+1), rolled out "
+        "drain-and-swap one replica at a time behind the content-"
+        "checksum gate; requires --fleet",
     )
     ap.add_argument(
         "--checkpoint-dir", type=str, default=None,
@@ -676,6 +802,55 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "(training runs arm capture via "
             "TrainingConfig.capture_on_anomaly)"
         )
+    # Fleet flag discipline: the fleet serves loadgen scenarios over
+    # paged replicas with chunked prefill (redispatch replays prompt
+    # + committed tokens, which can exceed any bucket); every other
+    # combination would silently not be a fleet run.
+    if args.fleet is not None:
+        if args.fleet < 1:
+            ap.error(f"--fleet {args.fleet} must be >= 1")
+        if not args.loadgen:
+            ap.error("--fleet is only consumed together with "
+                     "--loadgen (the fleet serves scenarios)")
+        if not args.paged or not args.prefill_chunk:
+            ap.error(
+                "--fleet needs --paged --prefill-chunk N: replicas "
+                "are paged engines (prefix affinity is trie state) "
+                "and redispatch replays prompt + committed tokens, "
+                "which can exceed any single prefill bucket"
+            )
+        if args.disagg:
+            ap.error("--fleet and --disagg are mutually exclusive")
+        if args.spec != "off":
+            ap.error(
+                "--fleet does not consume --spec (reset_pool cannot "
+                "flush a mirrored draft pool)"
+            )
+        if args.capture_dir:
+            ap.error(
+                "--capture-dir is only consumed by the single-engine "
+                "--loadgen harness"
+            )
+        if args.fleet_min is not None and not \
+                1 <= args.fleet_min <= args.fleet:
+            ap.error(
+                f"--fleet-min {args.fleet_min} must be in "
+                f"[1, --fleet {args.fleet}]"
+            )
+        if args.fleet_swap_at is not None and args.fleet_swap_at < 0:
+            ap.error(
+                f"--fleet-swap-at {args.fleet_swap_at} must be >= 0"
+            )
+    else:
+        for flag, val in (
+            ("--fleet-min", args.fleet_min),
+            ("--fleet-router", args.fleet_router),
+            ("--fleet-swap-at", args.fleet_swap_at),
+        ):
+            if val is not None:
+                ap.error(
+                    f"{flag} is only consumed together with --fleet"
+                )
     if args.top_p is not None and args.temperature is None:
         ap.error(
             "--top-p is only consumed together with --temperature"
@@ -779,16 +954,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"{max_seq - max(buckets)} generate tokens (< 2); "
                 "raise --max-seq-len or --max-new"
             )
-        summary = run_loadgen(
-            cfg, serve_cfg, args.loadgen, args.requests, args.max_new,
-            checkpoint_dir=args.checkpoint_dir,
-            metrics_path=args.metrics, seed=args.seed,
-            paged=paged,
-            spec=spec_cfg,
-            spec_draft_ckpt=args.spec_draft_ckpt,
-            spec_draft_cfg=spec_draft_cfg,
-            capture_dir=args.capture_dir,
-        )
+        if args.fleet is not None:
+            import jax
+
+            if jax.device_count() < args.fleet:
+                ap.error(
+                    f"--fleet {args.fleet} needs >= {args.fleet} "
+                    f"devices (one slice each); only "
+                    f"{jax.device_count()} visible -- use "
+                    "--sim-devices N for development"
+                )
+            summary = run_fleet_loadgen(
+                cfg, serve_cfg, args.loadgen, args.requests,
+                args.max_new, paged,
+                n_replicas=args.fleet,
+                min_replicas=args.fleet_min or 1,
+                router=args.fleet_router or "affinity",
+                swap_at=args.fleet_swap_at,
+                checkpoint_dir=args.checkpoint_dir,
+                metrics_path=args.metrics, seed=args.seed,
+            )
+        else:
+            summary = run_loadgen(
+                cfg, serve_cfg, args.loadgen, args.requests,
+                args.max_new,
+                checkpoint_dir=args.checkpoint_dir,
+                metrics_path=args.metrics, seed=args.seed,
+                paged=paged,
+                spec=spec_cfg,
+                spec_draft_ckpt=args.spec_draft_ckpt,
+                spec_draft_cfg=spec_draft_cfg,
+                capture_dir=args.capture_dir,
+            )
     else:
         if args.disagg:
             import jax
