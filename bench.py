@@ -667,6 +667,149 @@ def bench_llama_pp(
     }
 
 
+def bench_llama_pp_mpmd(
+    steps: int, microbatches: int, microbatch_size: int = 4,
+    attn: str = "flash",
+    block_q: int = 512, block_k: int = 1024,
+    block_q_bwd: "int | None" = None, block_k_bwd: "int | None" = None,
+    model: str = "stack",
+) -> dict:
+    """The MPMD pipeline runtime row (``--pp-runtime mpmd``):
+    per-stage AOT programs dispatched per stage worker
+    (tpu_hpc.parallel.mpmd) instead of one SPMD shard_map tick loop.
+    One stage per visible device (disjoint fault domains); reports
+    tokens/s plus the runtime's MEASURED bubble fraction and -- when
+    ``TPU_HPC_FAULTS`` arms a stage fault -- the recovery MTTR and
+    per-stage restart/rollback counts, so the banked ``pp_mpmd_*``
+    family carries the robustness evidence next to the throughput
+    headline. Zero steady-state recompiles is part of the record
+    (``recompiles``), pinned like every serving row's."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_hpc.kernels.attention import blockwise_attention
+    from tpu_hpc.models import datasets
+    from tpu_hpc.models import pipeline_transformer as ptx
+    from tpu_hpc.parallel import mpmd
+    from tpu_hpc.runtime import init_distributed
+
+    if model not in ("stack", "llama"):
+        raise ValueError(f"unknown pp model {model!r} (stack|llama)")
+    init_distributed(verbose=False)
+    n_dev = jax.device_count()
+    n_stages = n_dev
+    attn_fn = None
+    if attn == "flash":
+        def attn_fn(q, k, v_):
+            out, _ = blockwise_attention(
+                q, k, v_, causal=True,
+                block_q=block_q, block_k=block_k,
+                block_q_bwd=block_q_bwd, block_k_bwd=block_k_bwd,
+            )
+            return out
+    if model == "llama":
+        from tpu_hpc.models import llama2, llama_pp
+
+        lcfg = bench_model_cfg()
+        if lcfg.n_layers % n_stages:
+            raise ValueError(
+                f"llama mpmd needs n_layers {lcfg.n_layers} "
+                f"divisible by {n_stages} stages"
+            )
+        full = llama2.init_llama(jax.random.key(0), lcfg)
+        split = llama_pp.split_params(full, lcfg, n_stages)
+        bundle = llama_pp.mpmd_bundle(split, lcfg, attn_fn=attn_fn)
+        model_cfg = lcfg
+    else:
+        model_cfg = ptx.PipeConfig(
+            vocab_size=32000, dim=1024, n_heads=8,
+            n_stages=n_stages,
+            layers_per_stage=max(8 // n_stages, 1),
+            max_seq_len=2048, dtype=jnp.bfloat16,
+        )
+        params = ptx.init_pipeline_transformer(
+            jax.random.key(0), model_cfg
+        )
+        bundle = ptx.mpmd_bundle(params, model_cfg, attn_fn=attn_fn)
+    cfg = mpmd.MpmdConfig(
+        n_microbatches=microbatches, learning_rate=3e-4,
+    )
+    ds = datasets.TokenStream(
+        vocab_size=model_cfg.vocab_size, seq_len=model_cfg.max_seq_len
+    )
+    batch = microbatches * microbatch_size
+    batches = [
+        tuple(np.asarray(a) for a in ds.batch_at(i, batch))
+        for i in range(steps + 1)
+    ]
+    pipe = mpmd.MpmdPipeline(bundle, cfg).build(batches[0][0])
+    warm_counts = list(pipe.compile_counts)
+    pipe.run_step(0, *batches[0])  # warm dispatch outside the timing
+    t0 = _time.perf_counter()
+    for step, (tokens, targets) in enumerate(batches[1:], start=1):
+        pipe.run_step(step, tokens, targets)
+    wall = _time.perf_counter() - t0
+    res = {
+        "bubble_fraction": (
+            float(np.mean(pipe.bubble_fractions))
+            if pipe.bubble_fractions else 0.0
+        ),
+        "recovery_mttr_s": (
+            float(np.mean([r["mttr_s"] for r in pipe.recoveries]))
+            if pipe.recoveries else 0.0
+        ),
+    }
+    recompiles = sum(pipe.compile_counts) - sum(warm_counts)
+    tokens_per_s = steps * batch * model_cfg.max_seq_len / wall
+    flops_per_token = model_cfg.flops_per_token()
+    peak = peak_flops_per_chip(jax.devices()[0])
+    mfu = tokens_per_s * flops_per_token / (peak * n_dev)
+    tag = "-llama" if model == "llama" else ""
+    # A chaos-armed run banks under its OWN pp_mpmd*-chaos family:
+    # its recovery MTTR / redispatch counts are that family's judged
+    # baseline (robustness drift at the same chaos schedule fails
+    # --bank), and they must never pollute the clean family's
+    # mttr==0 high-water mark.
+    armed = (
+        pipe.fault_plan.stage_fault_keys()
+        if pipe.fault_plan is not None else []
+    )
+    if armed:
+        tag += "-chaos"
+    print(
+        f"llama-pp[mpmd{tag}] | stages={n_stages} "
+        f"mb={microbatches}x{microbatch_size} "
+        f"bubble {res['bubble_fraction']:.1%} | "
+        f"{tokens_per_s:.0f} tokens/s | MFU {mfu:.1%} | "
+        f"restarts {dict(pipe.supervisor.restarts)} "
+        f"rollbacks {dict(pipe.supervisor.rollbacks)} "
+        f"mttr {res['recovery_mttr_s']:.2f}s",
+        file=sys.stderr,
+    )
+    return {
+        "metric": f"pp_mpmd{tag}_tokens_per_s_per_chip",
+        "value": round(tokens_per_s / n_dev, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 3),
+        "pp_runtime": "mpmd",
+        **({"faults": ",".join(armed)} if armed else {}),
+        "bubble_fraction": round(res["bubble_fraction"], 4),
+        "recovery_mttr_s": round(res["recovery_mttr_s"], 3),
+        "stage_restarts": sum(pipe.supervisor.restarts.values()),
+        "stage_rollbacks": sum(pipe.supervisor.rollbacks.values()),
+        "redispatched": pipe.redispatched,
+        "recompiles": recompiles,
+        "wire_mb": round(pipe.wire_bytes / 2**20, 2),
+        "attn": attn,
+        **flash_blocks_record(
+            attn, block_q, block_k, block_q_bwd, block_k_bwd
+        ),
+    }
+
+
 def serve_record(summary: dict, disagg: bool = False) -> dict:
     """Serving summary -> the training-bench record schema
     (metric/value/unit/vs_baseline), with the serving-native latency
@@ -1273,10 +1416,13 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--workload",
         choices=(
-            "llama", "llama-sp", "llama-pp", "llama-long", "unet",
-            "serve", "loadgen",
+            "llama", "llama-sp", "llama-pp", "pp", "llama-long",
+            "unet", "serve", "loadgen",
         ),
         default=None,  # resolved after --serve alias handling
+        help="'pp' is an alias for 'llama-pp' (the pipeline workload "
+        "family; --pp-runtime selects the SPMD tick loop or the MPMD "
+        "per-stage runtime)",
     )
     ap.add_argument(
         "--serve", action="store_true",
@@ -1423,6 +1569,17 @@ def main(argv=None) -> int:
         "comparable; all four schedules)",
     )
     ap.add_argument(
+        "--pp-runtime", choices=("spmd", "mpmd"), default="spmd",
+        help="pipeline runtime: spmd = the single shard_map tick "
+        "loop (parallel/pp.py, all four schedules); mpmd = per-stage "
+        "AOT programs on disjoint devices with per-stage fault "
+        "domains (parallel/mpmd.py) -- the record carries the "
+        "measured bubble fraction + recovery MTTR and banks under "
+        "the pp_mpmd_* family; stage faults (TPU_HPC_FAULTS "
+        "stage_kill_at/stage_nan_at/stage_straggler) are consumed "
+        "ONLY here",
+    )
+    ap.add_argument(
         "--pp-backward", choices=("remat", "stash"), default="remat",
         help="1f1b backward: remat saves only stage inputs and "
         "recomputes the forward (5/3 of ideal FLOPs); stash saves the "
@@ -1494,6 +1651,31 @@ def main(argv=None) -> int:
         args.workload = "serve"
     elif args.workload is None:
         args.workload = "llama"
+    if args.workload == "pp":
+        args.workload = "llama-pp"  # documented alias
+    if args.pp_runtime == "mpmd":
+        # The misplaced-flag discipline: the MPMD runtime only exists
+        # on the pipeline workload, runs its own gpipe-ordered
+        # dispatch (the schedule flags parameterize the SPMD tick
+        # programs), and has its own backward (per-stage vjp).
+        if args.workload != "llama-pp":
+            ap.error(
+                "--pp-runtime mpmd is only consumed by --workload "
+                f"llama-pp/pp; --workload {args.workload} would "
+                "silently run without it"
+            )
+        if args.pp_schedule != "gpipe":
+            ap.error(
+                f"--pp-runtime mpmd dispatches its own gpipe-ordered "
+                "schedule; pass --pp-schedule gpipe explicitly "
+                f"(got {args.pp_schedule!r} -- a 1f1b/interleaved "
+                "row label would misdescribe what ran)"
+            )
+        if args.pp_backward != "remat":
+            ap.error(
+                "--pp-runtime mpmd does not consume --pp-backward "
+                "(its per-stage backward is an explicit vjp program)"
+            )
     if args.loadgen_scenario is not None and args.workload != "loadgen":
         # Same discipline as the --comm-mode guard below: a scenario
         # flag the selected workload never consumes must be a CLI
@@ -1710,6 +1892,14 @@ def main(argv=None) -> int:
         rec = bench_llama_sp(
             args.steps, batch, args.sp_mode,
             grad_accum_steps=accum, moments_dtype=args.moments_dtype,
+        )
+    elif args.workload == "llama-pp" and args.pp_runtime == "mpmd":
+        rec = bench_llama_pp_mpmd(
+            args.steps, args.pp_microbatches,
+            microbatch_size=args.pp_microbatch_size, attn=args.attn,
+            block_q=args.block_q, block_k=args.block_k,
+            block_q_bwd=args.block_q_bwd, block_k_bwd=args.block_k_bwd,
+            model=args.pp_model,
         )
     elif args.workload == "llama-pp":
         rec = bench_llama_pp(

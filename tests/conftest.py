@@ -111,6 +111,15 @@ SLOW_NODEIDS = frozenset(nodeid for nodeid, _ in [
     ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[kill_slow-round_robin]", "3s"),
     ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[corrupt_swap-affinity]", "3s"),
     ("tests/test_fleet.py::TestChaosSweep::test_sweep_no_loss_no_shed_above_floor[corrupt_swap-round_robin]", "3s"),
+    # MPMD pipeline (tests/test_mpmd.py): the tier-1 core keeps the
+    # chaos acceptance's two fault classes (stage kill + stage nan,
+    # both bit-identity pinned), the parity/compile pins and the
+    # budget units; the heartbeat-timeout / straggler variants and
+    # the flapping-stage integration (each builds its own pipeline =
+    # a full per-stage AOT warmup) ride the slow tier.
+    ("tests/test_mpmd.py::TestHeartbeat::test_wedged_stage_detected_by_heartbeat_timeout", "8s"),
+    ("tests/test_mpmd.py::TestStraggler::test_straggler_detected_and_bubble_grows", "7s"),
+    ("tests/test_mpmd.py::TestBudgets::test_flapping_stage_exhausts_own_budget", "8s"),
     ("tests/test_reshard.py::TestLongShapes::test_long_shape_bounded_parity_sweep", "35s"),
     ("tests/test_resnet.py::test_fsdp_training_step", "60s"),
     ("tests/test_run_metrics.py::TestMetricsLog::test_appends_across_runs", "13s"),

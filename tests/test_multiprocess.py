@@ -33,6 +33,12 @@ WORKER = textwrap.dedent(
     sys.path.insert(0, {repo!r})
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # The CPU backend has no cross-process collective implementation
+    # by default ("Multiprocess computations aren't implemented on
+    # the CPU backend"); jaxlib ships Gloo for exactly this -- opt in
+    # BEFORE jax.distributed.initialize or the cross-process psum
+    # below cannot run.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from tpu_hpc.runtime import init_distributed
 
     info = init_distributed(verbose=False)
@@ -144,6 +150,9 @@ HYBRID_WORKER = textwrap.dedent(
     sys.path.insert(0, {repo!r})
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # Gloo CPU collectives: see WORKER above -- the FSDP gathers in
+    # this test cross the process boundary.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from tpu_hpc.runtime import init_distributed
 
     info = init_distributed(verbose=False)

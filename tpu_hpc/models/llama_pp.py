@@ -222,3 +222,44 @@ def make_forward(
         return loss, model_state, {}
 
     return forward
+
+
+def mpmd_bundle(
+    split: Dict,
+    cfg: LlamaConfig,
+    attn_fn: AttnFn = None,
+    positions: Optional[jax.Array] = None,
+):
+    """Cut the flagship Llama for the MPMD pipeline runtime
+    (``tpu_hpc.parallel.mpmd``): ``split_params``' stacked stage tree
+    becomes per-stage trees, and the edges stop being replicated --
+    tok_embeddings lives in stage 0's fault domain, norm+output (and
+    the loss) in stage S-1's. Pair with the same sequential-stack
+    layout ``split_params`` produces (the interleaved layouts are an
+    SPMD bubble optimization; MPMD dispatch order is the runtime's
+    own concern)."""
+    from tpu_hpc.models.losses import cross_entropy
+    from tpu_hpc.parallel.mpmd import StageBundle
+
+    stages = split["stages"]
+    S = jax.tree.leaves(stages)[0].shape[0]
+    stage_params = tuple(
+        jax.tree.map(lambda a: a[s], stages) for s in range(S)
+    )
+    edges = split["edges"]
+
+    def embed_fn(ep, tokens):
+        return embed(ep, tokens, cfg)
+
+    def loss_fn(hp, y, targets):
+        return cross_entropy(head(hp, y, cfg), targets)
+
+    return StageBundle(
+        n_stages=S,
+        stage_fn=make_stage_fn(cfg, S, attn_fn, positions),
+        embed_fn=embed_fn,
+        loss_fn=loss_fn,
+        stage_params=stage_params,
+        embed_params={"tok_embeddings": edges["tok_embeddings"]},
+        head_params={"norm": edges["norm"], "output": edges["output"]},
+    )

@@ -180,3 +180,39 @@ def apply_sequential(params: Dict, tokens: jax.Array, cfg: PipeConfig) -> jax.Ar
     for s in range(cfg.n_stages):
         x = stage_fn(jax.tree.map(lambda a: a[s], params["stages"]), x)
     return head(params, x, cfg)
+
+
+def mpmd_bundle(params: Dict, cfg: PipeConfig,
+                attn_fn: Optional[AttnFn] = None):
+    """Cut this model for the MPMD pipeline runtime
+    (``tpu_hpc.parallel.mpmd``): per-stage param trees off the
+    stacked axis, the shape-preserving stage function, and the edge
+    functions placed on the edge stages' workers (embed on stage 0,
+    head+loss on stage S-1 -- the edge ownership the SPMD engine
+    replicates instead). The loss is the per-microbatch mean
+    cross-entropy; the runtime's total is the mean over microbatches,
+    matching the SPMD engine's per-microbatch loss vector
+    bit-for-bit (pinned in tests/test_mpmd.py)."""
+    from tpu_hpc.models import losses
+    from tpu_hpc.parallel.mpmd import StageBundle
+
+    stage_params = tuple(
+        jax.tree.map(lambda a: a[s], params["stages"])
+        for s in range(cfg.n_stages)
+    )
+
+    def embed_fn(ep, tokens):
+        return embed({"embed": ep}, tokens, cfg)
+
+    def loss_fn(hp, y, targets):
+        return losses.cross_entropy(head({"head": hp}, y, cfg), targets)
+
+    return StageBundle(
+        n_stages=cfg.n_stages,
+        stage_fn=make_stage_fn(cfg, attn_fn),
+        embed_fn=embed_fn,
+        loss_fn=loss_fn,
+        stage_params=stage_params,
+        embed_params=params["embed"],
+        head_params=params["head"],
+    )

@@ -100,6 +100,14 @@ _LOWER_IS_BETTER = (
     # higher-is-better by absence -- a router change that cools the
     # per-replica tries fails the gate.
     "redispatch", "replica_down", "swap",
+    # MPMD pipeline robustness (parallel/mpmd.py): more stages lost,
+    # a fatter bubble, or a slower stage recovery at the same chaos
+    # schedule is the regression -- the --bank gate fails on
+    # pipeline-robustness drift like it does on fleet/guard drift.
+    # ("redispatch" above already covers the replayed-microbatch
+    # counter; "bubble" covers bubble_fraction, "mttr" covers
+    # recovery_mttr_s.)
+    "stage_down", "bubble", "mttr",
 )
 
 
@@ -182,6 +190,23 @@ def report_metrics(rep: dict) -> Dict[str, float]:
             flat["fleet.prefix_affinity_hit_rate"] = float(
                 fl["prefix_affinity_hit_rate"]
             )
+    pl = rep.get("pipeline")
+    if pl:
+        # The judged pipeline signals: stage losses, replays, bubble
+        # and recovery MTTR (all lower-is-better via the
+        # stage_down/redispatch/bubble/mttr tokens). The per-stage
+        # timeline and straggler list are identity/behavior detail
+        # the latency consequences already cover.
+        flat["pipeline.stage_down"] = float(pl["stage_down"])
+        flat["pipeline.redispatched"] = float(pl["redispatched"])
+        if pl.get("bubble_fraction") is not None:
+            flat["pipeline.bubble_fraction"] = float(
+                pl["bubble_fraction"]
+            )
+        if pl.get("recovery_mttr_s") is not None:
+            flat["pipeline.recovery_mttr_s"] = float(
+                pl["recovery_mttr_s"]
+            )
     g = rep.get("guard")
     if g:
         flat["guard.poisoned"] = float(g["poisoned"])
@@ -228,6 +253,15 @@ _BANKED_SIDE_KEYS = (
     "prefix_affinity_hit_rate",
     "redispatched", "replica_down", "swap_rollbacks",
     "lost_requests",
+    # MPMD pipeline rows (bench.py --pp-runtime mpmd): the measured
+    # bubble and the stage-recovery MTTR ride next to the
+    # tokens-per-second headline (both lower-is-better via the
+    # "bubble"/"mttr" tokens) -- a runtime change that fattens the
+    # bubble or slows recovery fails --bank even while throughput
+    # still rides within tolerance. (The SPMD pp_* rows carry an
+    # ANALYTIC bubble_fraction; it is schedule-determined and
+    # constant at equal config, so judging it is a no-op there.)
+    "bubble_fraction", "recovery_mttr_s",
 )
 
 

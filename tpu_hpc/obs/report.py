@@ -322,6 +322,57 @@ def _fleet(records: Sequence[dict]) -> Optional[dict]:
     return out
 
 
+def _pipeline(records: Sequence[dict]) -> Optional[dict]:
+    """MPMD pipeline breakdown (parallel/mpmd.py): per-stage
+    up/down timeline, in-flight replays, bubble fraction and
+    recovery MTTR -- the robustness counters the regress gate's
+    ``pipeline.*`` namespace judges."""
+    downs = [r for r in records if r.get("event") == "stage_down"]
+    ups = [r for r in records if r.get("event") == "stage_up"]
+    redispatches = [
+        r for r in records if r.get("event") == "stage_redispatch"
+    ]
+    bubbles = [
+        r for r in records if r.get("event") == "pipeline_bubble"
+    ]
+    if not (downs or ups or redispatches or bubbles):
+        return None
+    timeline: Dict[str, list] = {}
+    for r in (*downs, *ups):
+        entry = {
+            "t": r.get("time"),
+            "event": "down" if r["event"] == "stage_down" else "up",
+            "reason": r["reason"],
+        }
+        if r["event"] == "stage_down" and "step" in r:
+            entry["step"] = r["step"]
+        timeline.setdefault(str(r["stage"]), []).append(entry)
+    for entries in timeline.values():
+        entries.sort(key=lambda e: (e["t"] is None, e["t"]))
+    mttrs = [r["mttr_s"] for r in ups if "mttr_s" in r]
+    stragglers = sorted({
+        r["straggler_stage"] for r in bubbles
+        if r.get("straggler_stage") is not None
+    })
+    return {
+        "stage_down": len(downs),
+        "redispatched": len(redispatches),
+        "restarts": sum(1 for r in ups if r["reason"] == "restart"),
+        "rollbacks": sum(
+            1 for r in ups if r["reason"] == "rollback"
+        ),
+        "bubble_fraction": (
+            sum(r["bubble_fraction"] for r in bubbles) / len(bubbles)
+            if bubbles else None
+        ),
+        "recovery_mttr_s": (
+            sum(mttrs) / len(mttrs) if mttrs else None
+        ),
+        "straggler_stages": stragglers,
+        "stages": timeline,
+    }
+
+
 def _guard(records: Sequence[dict]) -> Optional[dict]:
     """Numeric-health guard breakdown: verdict counts, skip count,
     and the rollback timeline with its goodput cost (steps re-trained
@@ -449,6 +500,7 @@ def build_report(
         "serve": _serve(records),
         "loadgen": _loadgen(records),
         "fleet": _fleet(records),
+        "pipeline": _pipeline(records),
         "guard": _guard(records),
         "ckpt": _ckpt(records),
         "memory": _memory(records),
@@ -671,6 +723,35 @@ def format_report(rep: dict) -> str:
             lines.append(
                 "- SLO VIOLATED: " + ", ".join(lg["slo_violations"])
             )
+    pl = rep.get("pipeline")
+    if pl is not None:
+        bub = pl.get("bubble_fraction")
+        mttr = pl.get("recovery_mttr_s")
+        lines += [
+            "",
+            "## MPMD pipeline",
+            "",
+            f"- stage failures: {pl['stage_down']} down "
+            f"({pl['restarts']} restart(s), {pl['rollbacks']} "
+            f"rollback(s)); {pl['redispatched']} in-flight "
+            "microbatch(es) replayed",
+            "- bubble fraction "
+            + (f"{bub:.1%}" if bub is not None else "(not measured)")
+            + "; recovery MTTR "
+            + (f"{mttr:.2f}s" if mttr is not None else "n/a"),
+        ]
+        if pl["straggler_stages"]:
+            lines.append(
+                "- straggler stage(s) flagged: "
+                + ", ".join(str(s) for s in pl["straggler_stages"])
+            )
+        for sid in sorted(pl["stages"], key=int):
+            steps = " -> ".join(
+                f"{e['event']}[{e['reason']}]"
+                + (f"@step{e['step']}" if "step" in e else "")
+                for e in pl["stages"][sid]
+            )
+            lines.append(f"- stage {sid} timeline: {steps}")
     fl = rep.get("fleet")
     if fl is not None:
         lines += [

@@ -235,11 +235,15 @@ EVENTS: Dict[str, EventSpec] = {
         optional=(
             "grad_norm", "update_norm", "loss_finite", "nonfinite",
             "watermark", "ratio", "data_index",
+            # Stage-scoped verdicts (the MPMD runtime's per-stage
+            # guard path): which stage's fault domain the anomaly
+            # was contained to.
+            "stage",
         ),
     ),
     "guard_rollback": EventSpec(
         ("to_step", "first_bad", "last_bad", "data_from", "data_to"),
-        optional=("quarantined", "n_rollbacks", "reason"),
+        optional=("quarantined", "n_rollbacks", "reason", "stage"),
     ),
     # -- checkpoint integrity + restore fallback (ckpt/checkpoint.py):
     #    every restore-side checksum verdict, and every fall-back-to-
@@ -292,6 +296,37 @@ EVENTS: Dict[str, EventSpec] = {
     "weight_swap": EventSpec(
         ("replica", "version", "status"),
         optional=("reason", "mismatched"),
+    ),
+    # -- MPMD pipeline runtime (parallel/mpmd.py): the per-stage
+    #    fault-domain evidence trail -- a stage leaving/rejoining the
+    #    pipeline, the in-flight microbatches replayed through a
+    #    recovered stage, and the per-step bubble telemetry the
+    #    report's pipeline section and the regress gate's pipeline.*
+    #    namespace read. --
+    # A stage left the pipeline: crash (killed worker),
+    # heartbeat-timeout (wedged worker), or guard-poisoned
+    # (non-finite output caught before any update committed it).
+    "stage_down": EventSpec(
+        ("stage", "reason"),
+        optional=("microbatch", "inflight", "beat_age_s"),
+    ),
+    # A stage rejoined after stage-local recovery: fresh worker,
+    # last-good snapshot restored (checksum-verified), healthy
+    # stages untouched. ``reason`` is the budget class charged:
+    # restart (crash/heartbeat) or rollback (guard-poisoned).
+    "stage_up": EventSpec(
+        ("stage", "reason"),
+        optional=("restore_step", "mttr_s", "compile_count"),
+    ),
+    # One in-flight microbatch the dead stage held, replayed through
+    # the recovered stage (the step re-executes from its start;
+    # determinism makes the replayed stream bit-identical).
+    "stage_redispatch": EventSpec(("stage", "microbatch")),
+    # Per-step pipeline idle fraction on the runtime's virtual
+    # clock, with cross-stage slow detection's verdict riding along.
+    "pipeline_bubble": EventSpec(
+        ("step", "bubble_fraction"),
+        optional=("makespan_s", "straggler_stage"),
     ),
     # -- supervisor attempt log (resilience/supervisor.py) --
     "attempt_start": EventSpec(("attempt", "cmd")),

@@ -7,6 +7,7 @@ from tpu_hpc.parallel import (  # noqa: F401
     dp,
     fsdp,
     hybrid,
+    mpmd,
     pp,
     ring_attention,
     sp_ulysses,

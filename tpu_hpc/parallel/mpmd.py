@@ -1,0 +1,1253 @@
+"""MPMD pipeline runtime with per-stage fault domains.
+
+The SPMD pipeline engine (``parallel/pp.py``) is one shard_map tick
+loop: every device steps in lockstep inside a single compiled program,
+so one hung host, one poisoned stage, or one preempted slice takes the
+entire cross-DCN pipeline down with it, and recovery means a full
+process restart plus a full-state restore round trip. "Scaling Deep
+Learning Training with MPMD Pipeline Parallelism" (arXiv 2412.14374)
+shows the right runtime for cross-slice pipelines is *multiple
+programs*: one AOT-compiled program set per stage, dispatched
+asynchronously, with stage-to-stage activation/gradient hand-offs as
+explicit bounded device-to-device moves over the DCN tier. And
+"Collective Communication for 100k+ GPUs" (arXiv 2510.20171) makes the
+operational case: at scale, failure *containment* -- not mere failure
+detection -- is what preserves goodput.
+
+This module is that runtime, with the repo's robustness contract
+applied at stage granularity:
+
+* **Per-stage programs.** Each :class:`StageWorker` owns a disjoint
+  device (a pod-slice stand-in on the sim mesh), its stage's resident
+  weights, and an executable table of AOT-compiled programs (forward,
+  backward, optimizer update, plus the embed/head edge programs on
+  the first/last stage) -- the serve engine's executable-table +
+  compile-counter discipline (``serve/engine.py``), applied to
+  training. After :meth:`StageWorker.warmup`, ``compile_count`` must
+  never move: steady-state MPMD ticks are zero-recompile (pinned).
+  Fault injection is *data*, not program: the forward takes a poison
+  scalar operand, so a chaos run and a production run dispatch
+  byte-identical executables.
+* **Bounded DCN moves.** Activations and cotangents cross stage
+  boundaries one microbatch at a time via ``jax.device_put`` -- the
+  transfer is bounded by the microbatch size by construction, and
+  every wire byte is accounted (``result["wire_bytes"]``).
+* **Per-stage fault domains.** The pipeline driver runs per-stage
+  heartbeats on a discrete-event virtual clock (the fleet harness
+  idiom, ``serve/fleet.py``): detection at stage granularity --
+  heartbeat-timeout (a wedged worker), crash-exit (a killed worker),
+  or guard-poisoned (a non-finite activation/gradient caught by the
+  fused health flag *before* any optimizer update commits it).
+  Recovery is stage-local: restart or roll back *that stage* from its
+  last-good stage-sharded snapshot (crc32 content checksums via
+  ``ckpt/integrity.py``, verified on restore -- the PR-7 contract at
+  stage scope), replay the in-flight microbatches the dead stage
+  held, and resume. Healthy stages keep their compiled executables
+  and resident weights untouched, and the post-recovery loss stream
+  and final params are bit-identical to the no-fault run (pinned in
+  tests/test_mpmd.py).
+* **Budgets.** :class:`StageSupervisor` gives every stage its own
+  restart budget (``max_stage_restarts``, crash/heartbeat class --
+  the stage-scoped analogue of EXIT_RESUMABLE accounting) and its own
+  rollback budget (``max_stage_rollbacks``, guard-poisoned class --
+  the stage-scoped EXIT_ROLLBACK analogue), distinct from the process
+  supervisor's ``--max-restarts``/``--max-rollbacks``: a flapping
+  stage exhausts its *own* budget and surfaces as a typed
+  :class:`StageBudgetExhausted` carrying the exit code the process
+  should die with -- it cannot silently burn the whole-run failure
+  budget. The process supervisor exports the budget to children as
+  ``TPU_HPC_MAX_STAGE_RESTARTS`` (``--max-stage-restarts``).
+
+Why a step is the recovery unit: optimizer updates are deferred until
+every microbatch's forward+backward has passed the health check, so a
+failure anywhere in a step leaves every healthy stage's resident
+params exactly at the step-start values -- the dead stage restores its
+step-start snapshot, the step replays, and the streams realign with
+zero cross-stage coordination. Snapshots are taken at every step
+boundary (host-side copies of the stage's params + optimizer
+velocity); on real hardware this is the stage-sharded checkpoint
+cadence, here it is what makes "only the dead stage restores" true.
+
+Determinism contract: the loss stream, gradients and updates are pure
+functions of (params, data, schedule); the injected faults are
+one-shot (a transient SDC / a kill), so a recovered run re-executes
+the same math through the same executables -- bit-identical to the
+no-fault run, the pinned acceptance.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpu_hpc.resilience.faults import FaultPlan, fault_plan_from_env
+from tpu_hpc.resilience.guard import GuardPolicy
+from tpu_hpc.resilience.heartbeat import Heartbeat
+from tpu_hpc.resilience.signals import EXIT_ROLLBACK
+
+ENV_MAX_STAGE_RESTARTS = "TPU_HPC_MAX_STAGE_RESTARTS"
+
+# Virtual-time cost model (the fleet harness's discrete-event idiom):
+# deterministic stand-ins for one stage op / one DCN hop / one stage
+# restart, in virtual seconds. Bubble fractions, heartbeat ages and
+# recovery MTTR are all measured on this clock, so chaos runs replay
+# bit-identically and the telemetry never depends on CI host speed.
+OP_COST_S = 1.0
+TRANSFER_COST_S = 0.1
+RESTART_COST_S = 5.0
+
+
+class StageError(RuntimeError):
+    """Base for stage-scoped failures; carries the stage id."""
+
+    def __init__(self, stage: int, msg: str):
+        super().__init__(msg)
+        self.stage = stage
+
+
+class StageDied(StageError):
+    """The stage worker crash-exited (the kill fault / a real crash)."""
+
+
+class StagePoisoned(StageError):
+    """The stage produced non-finite values (SDC / poisoned compute)."""
+
+
+class StageBudgetExhausted(StageError):
+    """A stage blew through its per-stage budget. ``exit_code`` is
+    what the hosting process should exit with: ``EXIT_ROLLBACK`` when
+    the guard-poisoned (rollback-class) budget ran out -- the process
+    supervisor charges its rollback budget, exactly like a whole-run
+    guard rollback -- and plain 1 (ordinary failure) when the
+    crash/heartbeat (restart-class) budget ran out: a stage that
+    keeps dying is an infrastructure problem a relaunch won't fix."""
+
+    def __init__(self, stage: int, kind: str, budget: int):
+        super().__init__(
+            stage,
+            f"stage {stage} exhausted its {kind} budget ({budget}): "
+            + (
+                "the stage keeps hitting numeric anomalies -- "
+                "rollback-class, exit EXIT_ROLLBACK"
+                if kind == "rollback"
+                else "the stage keeps dying -- restart-class, "
+                "ordinary failure exit"
+            ),
+        )
+        self.kind = kind
+        self.budget = budget
+        self.exit_code = EXIT_ROLLBACK if kind == "rollback" else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class StageBundle:
+    """The model, cut for MPMD: per-stage params plus the three pure
+    functions the stage programs are compiled from. Build one with
+    ``models/pipeline_transformer.mpmd_bundle`` or
+    ``models/llama_pp.mpmd_bundle``.
+
+    ``stage_fn(stage_params, x) -> y`` must be shape-preserving (the
+    pp.py contract). ``embed_fn(embed_params, tokens) -> x`` runs on
+    the FIRST stage's worker, ``loss_fn(head_params, y, targets) ->
+    scalar`` (a per-microbatch mean) on the LAST stage's worker --
+    the same edge placement the SPMD engine replicates, owned here by
+    the edge stages' fault domains."""
+
+    n_stages: int
+    stage_fn: Callable[[Any, Any], Any]
+    embed_fn: Callable[[Any, Any], Any]
+    loss_fn: Callable[[Any, Any, Any], Any]
+    stage_params: Tuple[Any, ...]
+    embed_params: Any
+    head_params: Any
+
+    def __post_init__(self):
+        if self.n_stages < 1:
+            raise ValueError(f"n_stages {self.n_stages} must be >= 1")
+        if len(self.stage_params) != self.n_stages:
+            raise ValueError(
+                f"{len(self.stage_params)} stage param trees for "
+                f"{self.n_stages} stages"
+            )
+
+
+def _default_stage_restarts() -> int:
+    """Per-stage restart budget: the supervisor's exported
+    ``TPU_HPC_MAX_STAGE_RESTARTS`` (``--max-stage-restarts``) wins;
+    3 otherwise (the --max-restarts default, scoped down)."""
+    try:
+        return int(os.environ.get(ENV_MAX_STAGE_RESTARTS, "") or 3)
+    except ValueError:
+        return 3
+
+
+@dataclasses.dataclass(frozen=True)
+class MpmdConfig:
+    """Static runtime shape + the per-stage budgets.
+
+    ``n_microbatches``: the pipeline schedule's M (the batch splits
+    [B] -> [M, B/M]). ``learning_rate``/``momentum``: the per-stage
+    SGD(+momentum) optimizer every worker applies locally (the
+    reference's per-stage optimizers, 03_pipeline_training.py).
+    ``heartbeat_timeout_s``: virtual-clock staleness after which a
+    silent stage is declared dead (must exceed one stage op at the
+    worst legal straggle). ``straggler_factor``: a stage whose mean
+    op cost exceeds this multiple of its PEERS' median (self
+    excluded -- the fleet lesson: a 2-stage straggler must not drag
+    the baseline toward itself) is flagged in the bubble telemetry.
+    ``max_stage_restarts`` default: ``TPU_HPC_MAX_STAGE_RESTARTS``
+    (the supervisor's ``--max-stage-restarts`` export), else 3.
+    """
+
+    n_microbatches: int
+    learning_rate: float = 1e-2
+    momentum: float = 0.9
+    heartbeat_timeout_s: float = 4.0
+    straggler_factor: float = 3.0
+    guard_spike_factor: float = 10.0
+    max_stage_restarts: int = dataclasses.field(
+        default_factory=_default_stage_restarts
+    )
+    max_stage_rollbacks: int = 3
+
+    def __post_init__(self):
+        if self.n_microbatches < 1:
+            raise ValueError(
+                f"n_microbatches {self.n_microbatches} must be >= 1"
+            )
+        if self.heartbeat_timeout_s <= OP_COST_S:
+            raise ValueError(
+                f"heartbeat_timeout_s {self.heartbeat_timeout_s} must "
+                f"exceed one stage op ({OP_COST_S}s on the virtual "
+                "clock) or every slow tick reads as death"
+            )
+        if self.max_stage_restarts < 0:
+            raise ValueError(
+                f"max_stage_restarts {self.max_stage_restarts} must "
+                "be >= 0"
+            )
+        if self.max_stage_rollbacks < 0:
+            raise ValueError(
+                f"max_stage_rollbacks {self.max_stage_rollbacks} "
+                "must be >= 0"
+            )
+
+
+class StageSupervisor:
+    """Per-stage failure accounting: the stage-scoped analogue of the
+    process supervisor's EXIT_RESUMABLE / EXIT_ROLLBACK split.
+
+    ``charge(stage, "restart")`` for crash/heartbeat recoveries,
+    ``charge(stage, "rollback")`` for guard-poisoned ones; each stage
+    draws on its OWN budgets, so stage 2 flapping five times cannot
+    consume stage 0's headroom -- nor the process supervisor's
+    ``--max-restarts`` (a stage-local recovery never exits the
+    process at all). Exhaustion raises :class:`StageBudgetExhausted`
+    whose ``exit_code`` tells the hosting process how to die so the
+    process supervisor charges the RIGHT whole-run budget."""
+
+    def __init__(self, max_restarts: int, max_rollbacks: int):
+        self.max_restarts = max_restarts
+        self.max_rollbacks = max_rollbacks
+        self.restarts: Dict[int, int] = {}
+        self.rollbacks: Dict[int, int] = {}
+
+    def charge(self, stage: int, kind: str) -> int:
+        if kind not in ("restart", "rollback"):
+            raise ValueError(f"unknown charge kind {kind!r}")
+        book = self.restarts if kind == "restart" else self.rollbacks
+        budget = (
+            self.max_restarts if kind == "restart"
+            else self.max_rollbacks
+        )
+        used = book.get(stage, 0)
+        if used >= budget:
+            raise StageBudgetExhausted(stage, kind, budget)
+        book[stage] = used + 1
+        return book[stage]
+
+
+class _StageFailure(Exception):
+    """Internal control flow: one detected stage failure, carried from
+    the dispatch loop to the recovery path."""
+
+    def __init__(
+        self, stage: int, reason: str, step: int,
+        microbatch: Optional[int] = None,
+        beat_age_s: Optional[float] = None,
+    ):
+        super().__init__(f"stage {stage}: {reason} at step {step}")
+        self.stage = stage
+        self.reason = reason  # crash | heartbeat-timeout | guard-poisoned
+        self.step = step
+        self.microbatch = microbatch
+        self.beat_age_s = beat_age_s
+
+
+class StageWorker:
+    """One stage's fault domain: a device, the stage's resident
+    weights + optimizer velocity + gradient accumulator, and the AOT
+    executable table its programs dispatch from.
+
+    All programs are compiled at :meth:`warmup` against fixed shapes;
+    ``compile_count`` increments on every build and must stay put in
+    steady state (the serve-engine discipline). The forward carries a
+    fused health flag (all-finite over the stage output) and a poison
+    operand -- faults are data, so chaos and production runs dispatch
+    the same executables. State round-trips through
+    :meth:`snapshot` / :meth:`load_state` with crc32 content
+    checksums (``ckpt/integrity.py``) computed at snapshot time and
+    verified on restore: whatever happened to the bytes in between, a
+    mismatch means the stage must not resume from them.
+    """
+
+    def __init__(
+        self,
+        sid: int,
+        bundle: StageBundle,
+        cfg: MpmdConfig,
+        device: Any,
+        mb_shape: Tuple[int, ...],
+        act_shape: Tuple[int, ...],
+        act_dtype: Any,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.sid = sid
+        self.bundle = bundle
+        self.cfg = cfg
+        self.device = device
+        self.is_first = sid == 0
+        self.is_last = sid == bundle.n_stages - 1
+        self.mb_shape = tuple(mb_shape)      # [mb, L] int tokens
+        self.act_shape = tuple(act_shape)    # [mb, L, D]
+        self.act_dtype = jnp.dtype(act_dtype)
+        self._sharding = jax.sharding.SingleDeviceSharding(device)
+        def place_fresh(tree):
+            """Fresh COMMITTED buffers on this stage's device. A
+            plain device_put of an array already resident there
+            ALIASES it (the reshard lesson) -- and this worker's
+            update program donates its param buffers, which would
+            delete the caller's tree out from under it."""
+            return jax.device_put(
+                jax.tree.map(
+                    lambda a: np.array(a, copy=True), tree
+                ),
+                device,
+            )
+
+        self.params = place_fresh(bundle.stage_params[sid])
+
+        self.velocity = self._host_zeros(self.params)
+        self.embed_params = self.embed_vel = None
+        self.head_params = self.head_vel = None
+        if self.is_first:
+            self.embed_params = place_fresh(bundle.embed_params)
+            self.embed_vel = self._host_zeros(self.embed_params)
+        if self.is_last:
+            self.head_params = place_fresh(bundle.head_params)
+            self.head_vel = self._host_zeros(self.head_params)
+        self._execs: Dict[str, Any] = {}
+        self.compile_count = 0
+        # The poison operand's two legal values, resident once: the
+        # AOT executables take committed device scalars, and a fresh
+        # device_put per dispatch would be per-op host traffic.
+        self._poison = {
+            0: jax.device_put(np.int32(0), device),
+            1: jax.device_put(np.int32(1), device),
+        }
+        # Liveness (virtual clock): ``beat`` is the virtual time of
+        # the last completed op; ``dead``/``wedged`` model crash-exit
+        # and a silent hang (the heartbeat-timeout detection target).
+        self.beat = 0.0
+        self.avail = 0.0
+        self.busy_s = 0.0
+        self.op_count = 0
+        self.dead = False
+        self.wedged = False
+        self.cost_factor = 1.0
+        self._saved_x: Dict[int, Any] = {}
+        self.grads = None
+        self.embed_grads = None
+        self.head_grads = None
+        self.reset_grads()
+
+    # -- program builders ---------------------------------------------
+    def _abstract(self, tree) -> Any:
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                np.shape(a), jnp.result_type(a),
+                sharding=self._sharding,
+            ),
+            tree,
+        )
+
+    def _aval(self, shape, dtype) -> Any:
+        import jax
+
+        return jax.ShapeDtypeStruct(
+            tuple(shape), dtype, sharding=self._sharding
+        )
+
+    def _build(self, key: str):
+        """Lower-and-compile one program (counted). Donation frees
+        the accumulator/state operands the program replaces."""
+        import jax
+        import jax.numpy as jnp
+
+        self.compile_count += 1
+        stage_fn = self.bundle.stage_fn
+        M = self.cfg.n_microbatches
+        p_abs = self._abstract(self.params)
+        x_abs = self._aval(self.act_shape, self.act_dtype)
+        tok_abs = self._aval(self.mb_shape, jnp.int32)
+        flag = self._aval((), jnp.int32)
+
+        def finite(*trees):
+            ok = jnp.asarray(True)
+            for t in trees:
+                for leaf in jax.tree.leaves(t):
+                    if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                        ok = ok & jnp.all(jnp.isfinite(leaf))
+            return ok.astype(jnp.int32)
+
+        if key == "fwd":
+            # Poison is DATA: the armed chaos run and the clean run
+            # compile and dispatch the identical executable.
+            def fwd(p, x, poison):
+                y = stage_fn(p, x)
+                bad = jnp.asarray(jnp.nan, y.dtype)
+                y = jnp.where(poison > 0, bad, y)
+                return y, finite(y)
+
+            return jax.jit(fwd).lower(p_abs, x_abs, flag).compile()
+        if key == "bwd":
+            def bwd(p, x, gy, gacc):
+                _, vjp = jax.vjp(stage_fn, p, x)
+                gp, gx = vjp(gy)
+                gacc = jax.tree.map(jnp.add, gacc, gp)
+                return gacc, gx, finite(gx, gacc)
+
+            return jax.jit(bwd, donate_argnums=(3,)).lower(
+                p_abs, x_abs, x_abs, p_abs
+            ).compile()
+        if key in ("update", "update_embed", "update_head"):
+            lr, mu = self.cfg.learning_rate, self.cfg.momentum
+
+            def update(p, vel, g):
+                vel = jax.tree.map(
+                    lambda v, gg: mu * v.astype(gg.dtype) + gg, vel, g
+                )
+                p = jax.tree.map(
+                    lambda pp_, v: (pp_ - lr * v).astype(pp_.dtype),
+                    p, vel,
+                )
+                gz = jax.tree.map(jnp.zeros_like, g)
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(gg.astype(jnp.float32)))
+                    for gg in jax.tree.leaves(g)
+                ))
+                return p, vel, gz, gnorm
+
+            t_abs = {
+                "update": p_abs,
+                "update_embed": self._abstract(self.embed_params),
+                "update_head": self._abstract(self.head_params),
+            }[key]
+            return jax.jit(update, donate_argnums=(0, 1, 2)).lower(
+                t_abs, t_abs, t_abs
+            ).compile()
+        if key == "embed":
+            embed_fn = self.bundle.embed_fn
+            e_abs = self._abstract(self.embed_params)
+            return jax.jit(embed_fn).lower(e_abs, tok_abs).compile()
+        if key == "embed_bwd":
+            embed_fn = self.bundle.embed_fn
+            e_abs = self._abstract(self.embed_params)
+
+            def embed_bwd(ep, toks, gx, geacc):
+                _, vjp = jax.vjp(embed_fn, ep, toks)
+                ge = vjp(gx)[0]
+                return jax.tree.map(jnp.add, geacc, ge)
+
+            return jax.jit(embed_bwd, donate_argnums=(3,)).lower(
+                e_abs, tok_abs, x_abs, e_abs
+            ).compile()
+        if key == "head":
+            loss_fn = self.bundle.loss_fn
+            h_abs = self._abstract(self.head_params)
+
+            def head(hp, y, t, ghacc):
+                # Cotangent 1/M bakes "total loss = mean over the M
+                # microbatch means" into the seed, matching the SPMD
+                # engine's mean-of-per-microbatch-losses gradient.
+                loss, vjp = jax.vjp(
+                    lambda hp_, y_: loss_fn(hp_, y_, t), hp, y
+                )
+                gh, gy = vjp(jnp.asarray(1.0 / M, jnp.float32))
+                ghacc = jax.tree.map(jnp.add, ghacc, gh)
+                ok = finite(loss, gy, ghacc)
+                return loss, ghacc, gy, ok
+
+            return jax.jit(head, donate_argnums=(3,)).lower(
+                h_abs, x_abs, tok_abs, h_abs
+            ).compile()
+        raise KeyError(f"unknown program {key!r}")
+
+    def _get_exec(self, key: str):
+        if key not in self._execs:
+            self._execs[key] = self._build(key)
+        return self._execs[key]
+
+    def warmup(self) -> int:
+        """Compile every steady-state program up front; after this,
+        ``compile_count`` must never move (the zero-recompile pin)."""
+        keys = ["fwd", "bwd", "update"]
+        if self.is_first:
+            keys += ["embed", "embed_bwd", "update_embed"]
+        if self.is_last:
+            keys += ["head", "update_head"]
+        for k in keys:
+            self._get_exec(k)
+        return self.compile_count
+
+    # -- state --------------------------------------------------------
+    def _host_zeros(self, tree) -> Any:
+        """A zeros tree matching ``tree``, freshly device_put on this
+        stage's device (no compile, never aliased)."""
+        import jax
+
+        return jax.device_put(
+            jax.tree.map(
+                lambda a: np.zeros(np.shape(a), _np_dtype(a)), tree
+            ),
+            self.device,
+        )
+
+    def reset_grads(self) -> None:
+        """Zero the gradient accumulators (host zeros, device_put --
+        no compile). Called at construction and whenever a failed
+        step attempt leaves partial accumulation behind."""
+        self.grads = self._host_zeros(self.params)
+        if self.is_first:
+            self.embed_grads = self._host_zeros(self.embed_params)
+        if self.is_last:
+            self.head_grads = self._host_zeros(self.head_params)
+        self._saved_x.clear()
+
+    def snapshot(self, step: int) -> dict:
+        """Host-side last-good copy of this stage's state, content-
+        checksummed at snapshot time (``ckpt/integrity``): params +
+        optimizer velocity + the edge params this stage owns."""
+        import jax
+
+        from tpu_hpc.ckpt.integrity import leaf_checksums
+
+        state = {"params": self.params, "velocity": self.velocity}
+        if self.is_first:
+            state["embed_params"] = self.embed_params
+            state["embed_vel"] = self.embed_vel
+        if self.is_last:
+            state["head_params"] = self.head_params
+            state["head_vel"] = self.head_vel
+        # COPY, never view: np.asarray over a CPU jax array can be a
+        # zero-copy alias, and the update program donates the very
+        # buffers this snapshot must outlive -- an aliased snapshot
+        # would rot the moment the next step reuses them.
+        host = jax.tree.map(lambda a: np.array(a, copy=True), state)
+        return {
+            "step": step,
+            "stage": self.sid,
+            "state": host,
+            "checksums": leaf_checksums(host),
+        }
+
+    def load_state(self, snap: dict) -> None:
+        """Restore from a snapshot, verifying the crc32 checksums
+        first -- a corrupted last-good must fail loudly
+        (:class:`~tpu_hpc.ckpt.integrity.CkptIntegrityError`), never
+        resume silently wrong."""
+        import jax
+
+        from tpu_hpc.ckpt.integrity import (
+            CkptIntegrityError, verify_tree,
+        )
+
+        bad = verify_tree(snap["state"], snap["checksums"])
+        if bad:
+            raise CkptIntegrityError(
+                f"stage {self.sid} snapshot (step {snap['step']}) "
+                f"failed content verification at {bad}"
+            )
+        state = jax.device_put(snap["state"], self.device)
+        self.params = state["params"]
+        self.velocity = state["velocity"]
+        if self.is_first:
+            self.embed_params = state["embed_params"]
+            self.embed_vel = state["embed_vel"]
+        if self.is_last:
+            self.head_params = state["head_params"]
+            self.head_vel = state["head_vel"]
+        self.reset_grads()
+
+    # -- virtual-clock bookkeeping ------------------------------------
+    def charge(self, ready_s: float, cost_s: float) -> float:
+        """One op on this stage's timeline: starts when both the
+        dependency and the stage are free, runs for ``cost_s`` x the
+        stage's straggle factor; beats the heartbeat on completion.
+        Returns the completion time."""
+        start = max(self.avail, ready_s)
+        dur = cost_s * self.cost_factor
+        self.avail = start + dur
+        self.busy_s += dur
+        self.op_count += 1
+        self.beat = self.avail
+        return self.avail
+
+    # -- dispatch -----------------------------------------------------
+    def forward(self, x: Any, poison: int) -> Tuple[Any, Any]:
+        """Dispatch the stage forward; returns (y, health_flag) as
+        device values (async -- the flag is only fetched at the
+        step's health check)."""
+        if self.dead:
+            raise StageDied(self.sid, f"stage {self.sid} is dead")
+        return self._get_exec("fwd")(
+            self.params, x, self._poison[int(bool(poison))]
+        )
+
+    def backward(self, x: Any, gy: Any) -> Tuple[Any, Any]:
+        if self.dead:
+            raise StageDied(self.sid, f"stage {self.sid} is dead")
+        self.grads, gx, ok = self._get_exec("bwd")(
+            self.params, x, gy, self.grads
+        )
+        return gx, ok
+
+    def embed(self, tokens: Any) -> Any:
+        return self._get_exec("embed")(self.embed_params, tokens)
+
+    def embed_backward(self, tokens: Any, gx: Any) -> None:
+        self.embed_grads = self._get_exec("embed_bwd")(
+            self.embed_params, tokens, gx, self.embed_grads
+        )
+
+    def head_loss(self, y: Any, targets: Any):
+        loss, self.head_grads, gy, ok = self._get_exec("head")(
+            self.head_params, y, targets, self.head_grads
+        )
+        return loss, gy, ok
+
+    def apply_update(self) -> float:
+        """Per-stage optimizer update (SGD + momentum; the reference's
+        per-stage optimizers), gradient accumulators zeroed in the
+        same program; the edge trees this stage owns update through
+        their own warmed programs. Returns the stage's global grad
+        norm (the per-stage guard's spike signal)."""
+        upd = self._get_exec("update")
+        self.params, self.velocity, self.grads, gnorm = upd(
+            self.params, self.velocity, self.grads
+        )
+        if self.is_first:
+            (self.embed_params, self.embed_vel,
+             self.embed_grads, _) = self._get_exec("update_embed")(
+                self.embed_params, self.embed_vel, self.embed_grads
+            )
+        if self.is_last:
+            (self.head_params, self.head_vel,
+             self.head_grads, _) = self._get_exec("update_head")(
+                self.head_params, self.head_vel, self.head_grads
+            )
+        self._saved_x.clear()
+        return float(gnorm)
+
+
+def _np_dtype(a) -> Any:
+    import jax.numpy as jnp
+
+    return np.dtype(jnp.result_type(a))
+
+
+class MpmdPipeline:
+    """The MPMD pipeline driver: per-stage workers, asynchronous
+    per-stage dispatch, per-stage fault domains.
+
+    ``devices``: one disjoint device per stage (defaults to the first
+    ``n_stages`` visible devices) -- the sim stand-in for one pod
+    slice per stage. ``fault_plan``: the ``TPU_HPC_FAULTS`` plan
+    (parsed from the environment when omitted); only the ``stage_*``
+    keys are consumed here -- this runtime is the consumer the
+    vacuous-pass guard in the SPMD Trainer points at.
+
+    Telemetry rides the obs spine: ``stage_down`` / ``stage_up`` /
+    ``stage_redispatch`` / ``pipeline_bubble`` events (plus
+    ``guard_verdict`` with a ``stage`` field on the poisoned path), a
+    flight-recorder dump at every stage death, and the supervisor
+    heartbeat file (``TPU_HPC_HEARTBEAT``) ticked at step boundaries
+    like the SPMD Trainer does.
+    """
+
+    def __init__(
+        self,
+        bundle: StageBundle,
+        cfg: MpmdConfig,
+        devices: Optional[Sequence[Any]] = None,
+        fault_plan: Optional[FaultPlan] = None,
+        events_path: Optional[str] = None,
+    ):
+        import jax
+
+        self.bundle = bundle
+        self.cfg = cfg
+        S = bundle.n_stages
+        if devices is None:
+            devices = jax.devices()[:S]
+        if len(devices) < S:
+            raise ValueError(
+                f"{S} stages need {S} devices for disjoint fault "
+                f"domains; {len(devices)} visible"
+            )
+        self.devices = list(devices[:S])
+        self.fault_plan = (
+            fault_plan if fault_plan is not None
+            else fault_plan_from_env()
+        )
+        self.events_path = events_path
+        self.supervisor = StageSupervisor(
+            cfg.max_stage_restarts, cfg.max_stage_rollbacks
+        )
+        self.heartbeat = Heartbeat.from_env()
+        self._mb_shape: Optional[Tuple[int, ...]] = None
+        self._act_shape: Optional[Tuple[int, ...]] = None
+        self._act_dtype = None
+        self.workers: List[StageWorker] = []
+        self.snapshots: Dict[int, dict] = {}
+        self._guards: Dict[int, GuardPolicy] = {
+            s: GuardPolicy(
+                mode="skip", spike_factor=cfg.guard_spike_factor,
+                spike_action="event",
+            )
+            for s in range(S)
+        }
+        self.clock_s = 0.0
+        self.wire_bytes = 0
+        self.redispatched = 0
+        self.recoveries: List[dict] = []
+        self.poisoned_windows: List[dict] = []
+        self.bubble_fractions: List[float] = []
+        self.straggler_flags: Dict[int, int] = {}
+        self.losses: List[List[float]] = []
+        self._step_busy: Dict[int, float] = {}
+
+    # -- bring-up ------------------------------------------------------
+    def _bus(self):
+        from tpu_hpc.obs import get_bus
+
+        return get_bus()
+
+    def _emit(self, event: str, **fields) -> None:
+        self._bus().emit(event, sink=self.events_path, **fields)
+
+    def build(self, sample_tokens: Any) -> "MpmdPipeline":
+        """Construct + warm every stage worker against the microbatch
+        shapes derived from one sample batch ([B, L] int tokens).
+        After this, every worker's ``compile_count`` is pinned."""
+        import jax.numpy as jnp
+
+        self._validate_stage_faults()
+        B = np.shape(sample_tokens)[0]
+        M = self.cfg.n_microbatches
+        if B % M:
+            raise ValueError(
+                f"batch {B} not divisible by {M} microbatches"
+            )
+        mb = B // M
+        L = np.shape(sample_tokens)[1]
+        self._mb_shape = (mb, L)
+        # Trace the embed once abstractly to learn the activation
+        # shape/dtype the stage programs carry.
+        import jax
+
+        x_shape = jax.eval_shape(
+            self.bundle.embed_fn, self.bundle.embed_params,
+            jax.ShapeDtypeStruct((mb, L), jnp.int32),
+        )
+        self._act_shape = tuple(x_shape.shape)
+        self._act_dtype = x_shape.dtype
+        for s in range(self.bundle.n_stages):
+            w = self._new_worker(s)
+            w.warmup()
+            self.workers.append(w)
+        self._arm_straggler()
+        for s, w in enumerate(self.workers):
+            self.snapshots[s] = w.snapshot(step=0)
+        return self
+
+    def _new_worker(self, sid: int) -> StageWorker:
+        return StageWorker(
+            sid, self.bundle, self.cfg, self.devices[sid],
+            self._mb_shape, self._act_shape, self._act_dtype,
+        )
+
+    def _validate_stage_faults(self) -> None:
+        """Fail FAST (before any worker compiles) on a stage fault
+        naming a stage that does not exist: it would never fire and
+        the chaos test would pass vacuously (the loadgen fleet-fault
+        discipline)."""
+        plan = self.fault_plan
+        if plan is None or not plan.active:
+            return
+        S = self.bundle.n_stages
+        for key in ("stage_kill_at", "stage_nan_at",
+                    "stage_straggler"):
+            armed = getattr(plan, key)
+            if armed is not None and not 0 <= armed[0] < S:
+                raise ValueError(
+                    f"{key}={armed[0]}:{armed[1]}: the pipeline has "
+                    f"{S} stages -- a stage fault naming a stage "
+                    "that does not exist would pass vacuously"
+                )
+
+    def _arm_straggler(self) -> None:
+        plan = self.fault_plan
+        if plan is None or not plan.active:
+            return
+        sf = plan.stage_straggler
+        if sf is None:
+            return
+        sid, factor = sf
+        self.workers[sid].cost_factor = factor
+        plan._announce("stage_straggler", 0, dump=False)
+
+    @property
+    def compile_counts(self) -> List[int]:
+        return [w.compile_count for w in self.workers]
+
+    # -- fault hooks ---------------------------------------------------
+    def _kill_fires(self, sid: int, step: int, m: int) -> bool:
+        """The kill fault fires MID-STEP, at the stage's last forward
+        dispatch of the armed step: the worker dies holding every one
+        of the step's microbatches in flight -- the worst-case
+        in-flight replay the recovery path must prove."""
+        plan = self.fault_plan
+        if plan is None or not plan.active:
+            return False
+        armed = plan.stage_kill_at
+        if armed is None or "stage_kill" in plan._announced:
+            return False
+        if armed[0] != sid or step < armed[1]:
+            return False
+        if m != self.cfg.n_microbatches - 1:
+            return False
+        plan._announce("stage_kill", step, dump=True)
+        return True
+
+    def _poison_fires(self, sid: int, step: int) -> bool:
+        plan = self.fault_plan
+        if plan is None or not plan.active:
+            return False
+        armed = plan.stage_nan_at
+        if armed is None or "stage_nan" in plan._announced:
+            return False
+        if armed[0] != sid or step < armed[1]:
+            return False
+        plan._announce("stage_nan", step, dump=False)
+        return True
+
+    # -- one training step --------------------------------------------
+    def run_step(
+        self, step: int, tokens: Any, targets: Any,
+        apply_update: bool = True,
+    ) -> List[float]:
+        """One pipeline step over M microbatches: forward chain,
+        head loss, backward chain, health check, per-stage updates,
+        step-boundary snapshots. Recovers stage-locally on any stage
+        failure and replays until the step completes clean; returns
+        the per-microbatch loss values."""
+        while True:
+            try:
+                out = self._attempt_step(
+                    step, tokens, targets, apply_update
+                )
+                break
+            except _StageFailure as f:
+                self._recover(f)
+        if self.heartbeat is not None:
+            self.heartbeat.tick(step)
+        return out
+
+    def _microbatches(self, tokens: Any, targets: Any):
+        M = self.cfg.n_microbatches
+        tok = np.asarray(tokens)
+        tgt = np.asarray(targets)
+        mb = tok.shape[0] // M
+        if tok.shape[0] % M:
+            raise ValueError(
+                f"batch {tok.shape[0]} not divisible by {M}"
+            )
+        return (
+            tok.reshape(M, mb, *tok.shape[1:]).astype(np.int32),
+            tgt.reshape(M, mb, *tgt.shape[1:]).astype(np.int32),
+        )
+
+    def _check_alive(
+        self, sid: int, step: int, m: Optional[int]
+    ) -> None:
+        """Heartbeat sweep before dispatching to a stage: a silently
+        dead/wedged worker never completes its next op -- the runner
+        waits out the virtual heartbeat timeout and declares the
+        stage down, naming it."""
+        w = self.workers[sid]
+        if w.wedged or w.dead:
+            # A wedged worker and a silently-dead one look identical
+            # from outside: the heartbeat stops. Only stopped beats
+            # cross the timeout -- the detection names the stage.
+            timeout = self.cfg.heartbeat_timeout_s
+            self.clock_s = max(w.beat, self.clock_s) + timeout
+            raise _StageFailure(
+                sid, "heartbeat-timeout", step,
+                microbatch=m, beat_age_s=timeout,
+            )
+
+    def _transfer(self, arr: Any, dst_sid: int) -> Any:
+        """The bounded DCN-tier hop: one microbatch activation (or
+        cotangent) moved with ``device_put``; wire bytes accounted."""
+        import jax
+
+        self.wire_bytes += int(arr.nbytes)
+        return jax.device_put(arr, self.devices[dst_sid])
+
+    def _attempt_step(
+        self, step: int, tokens: Any, targets: Any,
+        apply_update: bool,
+    ) -> List[float]:
+        import jax
+
+        S = self.bundle.n_stages
+        M = self.cfg.n_microbatches
+        xs, ts = self._microbatches(tokens, targets)
+        step_t0 = self.clock_s
+        for w in self.workers:
+            w.avail = max(w.avail, step_t0)
+            w.busy_s = 0.0
+            w.op_count = 0
+        # Track what each stage has been handed this attempt: the
+        # in-flight set a failure must replay.
+        inflight: Dict[int, List[int]] = {s: [] for s in range(S)}
+        self._inflight = inflight
+        fwd_ok: Dict[Tuple[int, int], Any] = {}
+        bwd_ok: Dict[Tuple[int, int], Any] = {}
+        head_ok: Dict[int, Any] = {}
+        losses: Dict[int, Any] = {}
+        gy_last: Dict[int, Any] = {}
+        acts_out: Dict[int, Any] = {}
+        tok_dev: Dict[int, Any] = {}
+        tgt_dev: Dict[int, Any] = {}
+
+        # ---- forward: microbatch m through stages 0..S-1 ----
+        ready: Dict[int, float] = {}
+        for m in range(M):
+            tok_m = jax.device_put(xs[m], self.devices[0])
+            tok_dev[m] = tok_m
+            w0 = self.workers[0]
+            self._check_alive(0, step, m)
+            x = w0.embed(tok_m)
+            r = w0.charge(step_t0, OP_COST_S * 0.25)
+            for s in range(S):
+                w = self.workers[s]
+                self._check_alive(s, step, m)
+                inflight[s].append(m)
+                w._saved_x[m] = x
+                if self._kill_fires(s, step, m):
+                    w.dead = True
+                    raise _StageFailure(
+                        s, "crash", step, microbatch=m
+                    )
+                poison = 1 if self._poison_fires(s, step) else 0
+                try:
+                    y, ok = w.forward(x, poison)
+                except StageDied:
+                    raise _StageFailure(s, "crash", step, microbatch=m)
+                fwd_ok[(s, m)] = ok
+                r = w.charge(r, OP_COST_S)
+                if s + 1 < S:
+                    y = self._transfer(y, s + 1)
+                    r += TRANSFER_COST_S
+                x = y
+            acts_out[m] = x
+            ready[m] = r
+            tgt_dev[m] = jax.device_put(ts[m], self.devices[S - 1])
+
+        # ---- head loss + backward: reverse microbatch order (the
+        # scan-transpose accumulation order of the SPMD engine) ----
+        for m in reversed(range(M)):
+            wl = self.workers[S - 1]
+            self._check_alive(S - 1, step, m)
+            loss_m, gy, okh = wl.head_loss(acts_out[m], tgt_dev[m])
+            losses[m] = loss_m
+            head_ok[m] = okh
+            r = wl.charge(ready[m], OP_COST_S * 0.25)
+            g = gy
+            for s in reversed(range(S)):
+                w = self.workers[s]
+                self._check_alive(s, step, m)
+                try:
+                    gx, okb = w.backward(w._saved_x[m], g)
+                except StageDied:
+                    raise _StageFailure(s, "crash", step, microbatch=m)
+                bwd_ok[(s, m)] = okb
+                r = w.charge(r, OP_COST_S)
+                if s > 0:
+                    g = self._transfer(gx, s - 1)
+                    r += TRANSFER_COST_S
+                else:
+                    self.workers[0].embed_backward(tok_dev[m], gx)
+                    r = self.workers[0].charge(r, OP_COST_S * 0.25)
+
+        # ---- health check: fetch the fused flags BEFORE any update
+        # commits a poisoned step (the guard contract) ----
+        # Origin attribution: NaN propagates downstream, so walk each
+        # microbatch's chain in compute order -- the FIRST failing
+        # flag names the stage that poisoned it.
+        for m in range(M):
+            for s in range(S):
+                if not int(fwd_ok[(s, m)]):
+                    raise self._poisoned(s, step, m, "forward")
+            if not int(head_ok[m]):
+                raise self._poisoned(S - 1, step, m, "loss")
+            for s in reversed(range(S)):
+                if not int(bwd_ok[(s, m)]):
+                    raise self._poisoned(s, step, m, "backward")
+
+        loss_vals = [float(losses[m]) for m in range(M)]
+
+        # ---- per-stage optimizer updates + step-boundary snapshots
+        if apply_update:
+            for s, w in enumerate(self.workers):
+                gnorm = w.apply_update()
+                w.charge(w.avail, OP_COST_S * 0.1)
+                verdict = self._guards[s].classify(step, {
+                    "health_loss_finite": 1.0,
+                    "health_grad_norm": gnorm,
+                    "health_update_norm": gnorm,
+                    "health_nonfinite": 0.0,
+                })
+                if verdict.verdict == "spike":
+                    self._emit(
+                        "guard_verdict", step=step,
+                        verdict="spike", action="event",
+                        grad_norm=verdict.grad_norm,
+                        watermark=verdict.watermark,
+                        ratio=verdict.ratio, stage=s,
+                    )
+            # Step-boundary snapshots: the state every stage would
+            # restore to if step+1 fails -- what makes stage-local
+            # recovery consistent without cross-stage coordination.
+            for s, w in enumerate(self.workers):
+                self.snapshots[s] = w.snapshot(step=step + 1)
+
+        # ---- timeline close: bubble accounting ----
+        makespan = max(w.avail for w in self.workers) - step_t0
+        busy = sum(w.busy_s for w in self.workers)
+        bubble = (
+            0.0 if makespan <= 0
+            else max(0.0, 1.0 - busy / (S * makespan))
+        )
+        self.bubble_fractions.append(bubble)
+        self.clock_s = step_t0 + makespan
+        straggler = self._straggler_verdict()
+        self._emit(
+            "pipeline_bubble", step=step,
+            bubble_fraction=round(bubble, 4),
+            makespan_s=round(makespan, 3),
+            straggler_stage=straggler,
+        )
+        self._inflight = {}
+        return loss_vals
+
+    def _poisoned(
+        self, sid: int, step: int, m: int, phase: str
+    ) -> _StageFailure:
+        self._emit(
+            "guard_verdict", step=step, verdict="poisoned",
+            action="rollback", stage=sid, data_index=m,
+            loss_finite=phase != "loss",
+        )
+        self.poisoned_windows.append(
+            {"stage": sid, "step": step, "microbatch": m,
+             "phase": phase}
+        )
+        return _StageFailure(
+            sid, "guard-poisoned", step, microbatch=m
+        )
+
+    def _straggler_verdict(self) -> Optional[int]:
+        """Cross-stage slow detection: a stage whose mean op cost
+        exceeds ``straggler_factor`` x the median of its PEERS' means
+        (self excluded -- the fleet lesson) is named."""
+        import statistics
+
+        means = [
+            w.busy_s / w.op_count if w.op_count else 0.0
+            for w in self.workers
+        ]
+        if len(means) < 3:
+            return None
+        for s, mine in enumerate(means):
+            peers = [v for i, v in enumerate(means) if i != s]
+            med = statistics.median(peers)
+            if med > 0 and mine > self.cfg.straggler_factor * med:
+                self.straggler_flags[s] = (
+                    self.straggler_flags.get(s, 0) + 1
+                )
+                return s
+        return None
+
+    # -- recovery ------------------------------------------------------
+    def _recover(self, f: _StageFailure) -> None:
+        from tpu_hpc.obs import dump_flight
+
+        sid = f.stage
+        kind = (
+            "rollback" if f.reason == "guard-poisoned" else "restart"
+        )
+        self.supervisor.charge(sid, kind)
+        inflight = list(getattr(self, "_inflight", {}).get(sid, []))
+        t_down = self.clock_s = max(
+            self.clock_s, self.workers[sid].beat
+        )
+        self._emit(
+            "stage_down", stage=sid, reason=f.reason, step=f.step,
+            microbatch=f.microbatch, inflight=len(inflight),
+            beat_age_s=f.beat_age_s,
+        )
+        try:  # flight evidence of WHY, while the ring still has it
+            dump_flight(f"stage{sid}_{kind}")
+        except Exception:  # pragma: no cover - diagnostics only
+            pass
+        # Healthy stages: resident params are still the step-start
+        # values (updates are deferred past the health check), their
+        # executables stay put. Only the failed stage rebuilds.
+        new = self._new_worker(sid)
+        new.warmup()
+        new.load_state(self.snapshots[sid])
+        if self.fault_plan is not None:
+            armed = self.fault_plan.stage_straggler
+            if armed is not None and armed[0] == sid:
+                new.cost_factor = armed[1]
+        t_up = t_down + RESTART_COST_S
+        new.avail = new.beat = t_up
+        self.clock_s = t_up
+        self.workers[sid] = new
+        mttr = t_up - t_down
+        self.recoveries.append({
+            "stage": sid, "reason": f.reason, "step": f.step,
+            "mttr_s": mttr, "kind": kind,
+        })
+        self._emit(
+            "stage_up", stage=sid, reason=kind,
+            restore_step=self.snapshots[sid]["step"],
+            mttr_s=round(mttr, 3), compile_count=new.compile_count,
+        )
+        # Every stage that had work in flight on the dead stage gets
+        # it replayed: the step re-executes from its start.
+        for m in inflight:
+            self.redispatched += 1
+            self._emit(
+                "stage_redispatch", stage=sid, microbatch=m,
+                step=f.step,
+            )
+        if f.reason == "guard-poisoned":
+            self._emit(
+                "guard_rollback",
+                to_step=self.snapshots[sid]["step"],
+                first_bad=f.step, last_bad=f.step,
+                data_from=f.microbatch or 0,
+                data_to=f.microbatch or 0,
+                reason=f"stage {sid} poisoned", stage=sid,
+            )
+        # Grads on EVERY worker are partial garbage from the aborted
+        # attempt: zero them before the replay.
+        for w in self.workers:
+            w.reset_grads()
+
+    # -- training loop -------------------------------------------------
+    def train(
+        self, batches: Sequence[Tuple[Any, Any]],
+    ) -> dict:
+        """Run one step per (tokens, targets) batch; returns the run
+        summary (loss stream, bubble fraction, recoveries/MTTR,
+        per-stage budgets used, wire bytes, compile counts)."""
+        for step, (tokens, targets) in enumerate(batches):
+            self.losses.append(self.run_step(step, tokens, targets))
+        mttrs = [r["mttr_s"] for r in self.recoveries]
+        return {
+            "steps": len(self.losses),
+            "losses": self.losses,
+            "bubble_fraction": (
+                float(np.mean(self.bubble_fractions))
+                if self.bubble_fractions else 0.0
+            ),
+            "recoveries": list(self.recoveries),
+            "recovery_mttr_s": (
+                float(np.mean(mttrs)) if mttrs else 0.0
+            ),
+            "stage_restarts": dict(self.supervisor.restarts),
+            "stage_rollbacks": dict(self.supervisor.rollbacks),
+            "redispatched": self.redispatched,
+            "poisoned_windows": list(self.poisoned_windows),
+            "stragglers": dict(self.straggler_flags),
+            "wire_bytes": self.wire_bytes,
+            "compile_counts": self.compile_counts,
+        }
+
+    def stage_state(self, sid: int) -> dict:
+        """Host COPIES of one stage's resident state (tests compare
+        final params bit-for-bit across fault/no-fault runs).
+        np.array(copy=True), not np.asarray: an asarray view can
+        zero-copy alias the very buffers the next update's donation
+        reuses (the snapshot() lesson)."""
+        import jax
+
+        def copy_tree(tree):
+            return jax.tree.map(
+                lambda a: np.array(a, copy=True), tree
+            )
+
+        w = self.workers[sid]
+        out = {
+            "params": copy_tree(w.params),
+            "velocity": copy_tree(w.velocity),
+        }
+        if w.is_first:
+            out["embed_params"] = copy_tree(w.embed_params)
+        if w.is_last:
+            out["head_params"] = copy_tree(w.head_params)
+        return out
+
+    def loss_and_grads(self, tokens: Any, targets: Any):
+        """One forward+backward WITHOUT the optimizer update: the
+        parity hook (tests pin per-microbatch losses bit-identical
+        to the SPMD engine and grads to float32-ulp agreement)."""
+        import jax
+
+        losses = self._attempt_step(0, tokens, targets, False)
+
+        def copy_tree(tree):
+            return jax.tree.map(
+                lambda a: np.array(a, copy=True), tree
+            )
+
+        grads = [copy_tree(w.grads) for w in self.workers]
+        edge = {
+            "embed": copy_tree(self.workers[0].embed_grads),
+            "head": copy_tree(self.workers[-1].head_grads),
+        }
+        for w in self.workers:
+            w.reset_grads()
+        return losses, grads, edge

@@ -22,8 +22,10 @@ argues is mandatory at scale:
                 paths, failure dumps are never overwritten
   faults.py     deterministic fault injection (kill-at-step,
                 preempt-at-step, stall, corrupt/bitflip-ckpt-write,
-                nan-loss, grad-spike, straggler delay) so all of
-                the above is testable on CPU
+                nan-loss, grad-spike, straggler delay, plus the
+                stage-scoped kill/nan/straggler kinds the MPMD
+                pipeline runtime consumes) so all of the above is
+                testable on CPU
   guard.py      numeric-health guard: per-step health vector
                 classification (healthy/spike/poisoned) with
                 skip-batch and rollback-to-last-good actions, plus

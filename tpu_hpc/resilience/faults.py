@@ -43,6 +43,28 @@ boundaries, i.e. at the first progress point where ``step >= N``):
                          ``straggler_at_step``, default 0) -- a
                          degraded host; exercises the stall watermark.
 
+Stage-scoped faults (consumed ONLY by the MPMD pipeline runtime,
+``tpu_hpc.parallel.mpmd`` -- the SPMD Trainer hard-rejects them at
+construction so a stage fault on a non-MPMD run fails loudly instead
+of passing a chaos test vacuously):
+
+* ``stage_kill_at=<stage>:<step>``  kill that stage's worker
+                         MID-STEP (at its last forward dispatch of
+                         that step, every microbatch in flight) -- a
+                         preempted slice / crashed host; exercises
+                         per-stage crash detection, stage-local
+                         restart, and in-flight microbatch replay.
+* ``stage_nan_at=<stage>:<step>``   poison that stage's forward
+                         output at that step (one-shot -- a transient
+                         SDC on the stage's chips); exercises the
+                         per-stage guard path: poisoned verdict,
+                         stage-local rollback, recorded window.
+* ``stage_straggler=<stage>:<factor>``  multiply that stage's op cost
+                         by ``factor`` on the runtime's virtual
+                         clock -- a thermally-degraded slice;
+                         exercises cross-stage slow detection and the
+                         bubble telemetry.
+
 ``on_attempt`` (default 0) scopes injection to one restart ordinal so
 a supervised run fails once and then completes -- the
 restart-with-resume round trip, deterministic end to end.
@@ -80,6 +102,34 @@ _FLOAT_KEYS = (
     "straggler_ms",
 )
 
+# Stage-scoped fault keys (MPMD pipeline runtime only): composite
+# "<stage>:<value>" specs, parsed with their own typed casts.
+STAGE_FAULT_KEYS = (
+    "stage_kill_at",
+    "stage_nan_at",
+    "stage_straggler",
+)
+
+
+def _stage_step(v: str) -> "tuple[int, int]":
+    sid, sep, at = v.partition(":")
+    if not sep:
+        raise ValueError(v)
+    i, n = int(sid), int(at)
+    if i < 0 or n < 0:
+        raise ValueError(v)
+    return (i, n)
+
+
+def _stage_factor(v: str) -> "tuple[int, float]":
+    sid, sep, factor = v.partition(":")
+    if not sep:
+        raise ValueError(v)
+    i, f = int(sid), float(factor)
+    if i < 0 or f <= 0:
+        raise ValueError(v)
+    return (i, f)
+
 
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
@@ -96,6 +146,11 @@ class FaultPlan:
     straggler_ms: float = 0.0
     straggler_at_step: int = 0
     stall_s: float = 3600.0
+    # Stage-scoped (MPMD runtime only; the SPMD Trainer rejects armed
+    # stage faults at construction -- see stage_fault_keys).
+    stage_kill_at: Optional[tuple] = None     # (stage, step)
+    stage_nan_at: Optional[tuple] = None      # (stage, step)
+    stage_straggler: Optional[tuple] = None   # (stage, factor)
     on_attempt: int = 0
     attempt: int = 0
     # Telemetry one-shot latch (mutable contents are legal on a
@@ -228,6 +283,17 @@ class FaultPlan:
 
         return apply
 
+    def stage_fault_keys(self) -> "list[str]":
+        """The armed stage-scoped fault keys. Consumers that are NOT
+        the MPMD pipeline runtime must hard-reject a plan where this
+        is non-empty: a stage fault silently injecting nothing makes
+        the chaos test pass vacuously (the loadgen fleet-fault
+        discipline, applied to training)."""
+        return [
+            k for k in STAGE_FAULT_KEYS
+            if getattr(self, k) is not None
+        ]
+
     def wants_ckpt_corruption(self, step: int) -> bool:
         return self.active and self.corrupt_ckpt_at_step == step
 
@@ -314,6 +380,16 @@ def fault_plan_from_env(env=None) -> Optional[FaultPlan]:
     casts = {
         **{k: (int, "an integer") for k in _INT_KEYS},
         **{k: (float, "a number") for k in _FLOAT_KEYS},
+        "stage_kill_at": (
+            _stage_step, "'<stage>:<step>' (non-negative ints)",
+        ),
+        "stage_nan_at": (
+            _stage_step, "'<stage>:<step>' (non-negative ints)",
+        ),
+        "stage_straggler": (
+            _stage_factor,
+            "'<stage>:<factor>' (non-negative int : factor > 0)",
+        ),
     }
     fields = parse_kv_spec(spec, ENV_FAULTS, casts)
     return FaultPlan(attempt=current_attempt(env), **fields)

@@ -90,6 +90,7 @@ class Supervisor:
         poll_s: float = 0.2,
         max_preemptions: int = 100,
         max_rollbacks: int = 8,
+        max_stage_restarts: Optional[int] = None,
     ):
         if not cmd:
             raise ValueError("empty command")
@@ -103,6 +104,11 @@ class Supervisor:
             raise ValueError(
                 f"max_rollbacks {max_rollbacks} must be >= 0"
             )
+        if max_stage_restarts is not None and max_stage_restarts < 0:
+            raise ValueError(
+                f"max_stage_restarts {max_stage_restarts} must be "
+                ">= 0"
+            )
         self.cmd = list(cmd)
         self.max_restarts = max_restarts
         self.log_dir = log_dir
@@ -111,6 +117,7 @@ class Supervisor:
         self.backoff = backoff
         self.max_preemptions = max_preemptions
         self.max_rollbacks = max_rollbacks
+        self.max_stage_restarts = max_stage_restarts
         self.no_restart_on = set(no_restart_on)
         self.kill_grace_s = kill_grace_s
         self.poll_s = poll_s
@@ -174,6 +181,17 @@ class Supervisor:
         env = dict(os.environ, **{
             ENV_ATTEMPT: str(attempt), ENV_RUN_ID: self.run_id,
         })
+        if self.max_stage_restarts is not None:
+            # The per-stage budget rides DOWN to the child: an MPMD
+            # pipeline run (tpu_hpc.parallel.mpmd) recovers stage
+            # failures in-process -- those recoveries never exit, so
+            # they can never burn --max-restarts/--max-rollbacks; the
+            # exported bound caps how long a flapping stage may keep
+            # trying before the child dies with a code the budgets
+            # above DO account (StageBudgetExhausted.exit_code).
+            env["TPU_HPC_MAX_STAGE_RESTARTS"] = str(
+                self.max_stage_restarts
+            )
         # Flight-recorder dumps land next to the attempt logs (unless
         # the operator already pointed them elsewhere): the evidence
         # of WHY an attempt died belongs with that attempt's log.
@@ -449,6 +467,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "spans",
     )
     ap.add_argument(
+        "--max-stage-restarts", type=int, default=None,
+        help="per-STAGE restart budget exported to the child as "
+        "TPU_HPC_MAX_STAGE_RESTARTS (MPMD pipeline runs, "
+        "tpu_hpc.parallel.mpmd): stage-local recoveries happen "
+        "inside the child and never burn --max-restarts/"
+        "--max-rollbacks; this bounds how often any ONE stage may "
+        "restart before the child gives up with a budget-accounted "
+        "exit (default: the child's own default, 3)",
+    )
+    ap.add_argument(
         "--no-restart-on", type=str, default="",
         help="comma-separated exit codes that end the run immediately "
         "(e.g. '2' for usage errors)",
@@ -471,6 +499,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         no_restart_on=no_restart,
         max_preemptions=args.max_preemptions,
         max_rollbacks=args.max_rollbacks,
+        max_stage_restarts=args.max_stage_restarts,
     )
 
 

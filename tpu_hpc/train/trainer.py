@@ -500,6 +500,22 @@ class Trainer:
         # chaos kinds (nan_loss / grad_spike) perturb the jitted step
         # itself, so the plan must exist before the step is built.
         self.fault_plan = fault_plan_from_env()
+        if self.fault_plan is not None:
+            stage_keys = self.fault_plan.stage_fault_keys()
+            if stage_keys:
+                # Vacuous-pass guard: the stage-scoped chaos kinds
+                # target the MPMD pipeline runtime's per-stage fault
+                # domains; on this SPMD Trainer they would never fire
+                # and the chaos test would pass by doing nothing.
+                raise ValueError(
+                    f"TPU_HPC_FAULTS arms stage fault(s) "
+                    f"{', '.join(stage_keys)}, but this is an SPMD "
+                    "Trainer run -- stage faults are consumed only "
+                    "by the MPMD pipeline runtime "
+                    "(tpu_hpc.parallel.mpmd / bench.py --workload "
+                    "llama-pp --pp-runtime mpmd); refusing to run a "
+                    "chaos schedule that cannot inject"
+                )
         # Numeric-health guard (resilience.guard): None when
         # cfg.guard_mode == "off" -- the step program then stays
         # byte-identical to a pre-guard trainer (HLO no-creep pins).
