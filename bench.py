@@ -810,6 +810,148 @@ def bench_llama_pp_mpmd(
     }
 
 
+def bench_elastic(
+    steps: int, shrink_at: int = 2, grow_at: int = 4,
+) -> dict:
+    """The preemption-storm acceptance row (tpu_hpc.elastic): one
+    training run driven through shrink -> train -> grow -> train by
+    the topology coordinator, ZERO process restarts, judged against a
+    fixed-topology reference on the final layout. The banked
+    ``elastic_morph_*`` family carries the transition costs -- mean
+    stall seconds per morph as the headline, morph count and wire
+    bytes as side keys (all lower-is-better) -- so a coordinator
+    change that starts moving more bytes or stalling longer at the
+    same chaos schedule fails ``--bank``. ``loss_parity`` records
+    whether the morphing run's loss stream stayed bit-identical to
+    the fixed run (the data-extent-preserving layout policy's whole
+    point)."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_hpc.config import TrainingConfig
+    from tpu_hpc.elastic import TopologyCoordinator, choose_layout
+    from tpu_hpc.runtime import MeshSpec, build_mesh, init_distributed
+    from tpu_hpc.train.trainer import Trainer
+
+    init_distributed(verbose=False)
+    n_dev = jax.device_count()
+    # The storm must actually change the topology: shrink keeps half
+    # the pool, so the data axis is pinned to the extent both halves
+    # can carry.
+    extent = max(n_dev // 2, 1)
+    batch = extent * 4
+
+    def init_params():
+        k1, k2 = jax.random.split(jax.random.key(7))
+        return {
+            "w1": jax.random.normal(k1, (64, 128), jnp.float32) * 0.1,
+            "w2": jax.random.normal(k2, (128, 16), jnp.float32) * 0.1,
+        }
+
+    def forward(params, model_state, b, rng):
+        pred = jnp.tanh(b["x"] @ params["w1"]) @ params["w2"]
+        return jnp.mean((pred - b["y"]) ** 2), model_state, {}
+
+    class _DS:
+        def batch_at(self, step, gbs):
+            k = jax.random.key(1000 + int(step))
+            kx, ky = jax.random.split(k)
+            return {
+                "x": jax.random.normal(kx, (gbs, 64), jnp.float32),
+                "y": jax.random.normal(ky, (gbs, 16), jnp.float32),
+            }
+
+    def cfg_for(path):
+        return TrainingConfig(
+            epochs=steps, steps_per_epoch=1, global_batch_size=batch,
+            learning_rate=1e-2, weight_decay=0.01, metrics_path=path,
+        )
+
+    def factory_for(cfg):
+        def factory(mesh):
+            params = init_params()
+            return Trainer(
+                cfg, mesh, forward, params,
+                param_pspecs=jax.tree.map(lambda _: P(), params),
+                batch_pspec=P("data"),
+            )
+        return factory
+
+    def losses_from(path):
+        out = []
+        with open(path) as f:
+            for line in f:
+                r = json.loads(line)
+                if r.get("event") == "epoch":
+                    out.append((r["step"], r["loss"]))
+        return out
+
+    tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+    # Fixed-topology reference on the FINAL layout (the full pool,
+    # same layout policy) -- built before the chaos schedule is
+    # armed, or the un-managed Trainer would rightly refuse it.
+    fixed_path = os.path.join(tmp, "fixed.jsonl")
+    decision = choose_layout(
+        jax.devices(), global_batch=batch, current_data_extent=extent
+    )
+    fixed_mesh = build_mesh(
+        MeshSpec(axes=dict(decision.axes)), devices=jax.devices()
+    )
+    fixed_tr = factory_for(cfg_for(fixed_path))(fixed_mesh)
+    fixed_tr.fit(_DS())
+
+    morph_path = os.path.join(tmp, "morph.jsonl")
+    prev = os.environ.get("TPU_HPC_FAULTS")
+    os.environ["TPU_HPC_FAULTS"] = (
+        f"slice_down_at_step={shrink_at},slice_up_at_step={grow_at}"
+    )
+    t0 = _time.perf_counter()
+    try:
+        coord = TopologyCoordinator(
+            factory_for(cfg_for(morph_path)),
+            global_batch=batch, data_extent=extent,
+        )
+        summary = coord.run(_DS())
+    finally:
+        if prev is None:
+            os.environ.pop("TPU_HPC_FAULTS", None)
+        else:
+            os.environ["TPU_HPC_FAULTS"] = prev
+    wall = _time.perf_counter() - t0
+    parity = losses_from(fixed_path) == losses_from(morph_path)
+    morphs = summary["morph_count"]
+    print(
+        f"elastic | {n_dev} devices, shrink@{shrink_at} "
+        f"grow@{grow_at} | {morphs} morphs, "
+        f"{summary['wire_bytes']} wire bytes, "
+        f"{summary['stall_s']:.3f}s stall | restarts "
+        f"{summary['restarts']} | loss parity {parity} | "
+        f"{wall:.1f}s wall",
+        file=sys.stderr,
+    )
+    return {
+        "metric": "elastic_morph_stall_s",
+        "value": round(summary["stall_s"] / max(morphs, 1), 6),
+        "unit": "s",
+        "vs_baseline": None,
+        "faults": (
+            f"slice_down_at_step={shrink_at},"
+            f"slice_up_at_step={grow_at}"
+        ),
+        "morphs": morphs,
+        "morph_wire_bytes": summary["wire_bytes"],
+        "stall_s": summary["stall_s"],
+        "restarts": summary["restarts"],
+        "segments": len(summary["segments"]),
+        "loss_parity": parity,
+        "n_devices": n_dev,
+    }
+
+
 def serve_record(summary: dict, disagg: bool = False) -> dict:
     """Serving summary -> the training-bench record schema
     (metric/value/unit/vs_baseline), with the serving-native latency
@@ -1417,7 +1559,7 @@ def main(argv=None) -> int:
         "--workload",
         choices=(
             "llama", "llama-sp", "llama-pp", "pp", "llama-long",
-            "unet", "serve", "loadgen",
+            "unet", "serve", "loadgen", "elastic",
         ),
         default=None,  # resolved after --serve alias handling
         help="'pp' is an alias for 'llama-pp' (the pipeline workload "
@@ -1633,6 +1775,18 @@ def main(argv=None) -> int:
         "state HBM bytes read+written per step)",
     )
     ap.add_argument(
+        "--elastic-shrink-at", type=int, default=None, metavar="N",
+        help="topology coordinator chaos: lose half the device pool "
+        "at step N (live shrink, no restart; --workload elastic "
+        "only; default 2)",
+    )
+    ap.add_argument(
+        "--elastic-grow-at", type=int, default=None, metavar="N",
+        help="topology coordinator chaos: the lost slice returns at "
+        "step N (live grow back to the full pool; --workload "
+        "elastic only; default 4)",
+    )
+    ap.add_argument(
         "--supervise", type=int, default=0, metavar="N",
         help="re-launch this bench under the resilience supervisor "
         "with N bounded restarts (attempt-unique logs in "
@@ -1832,6 +1986,42 @@ def main(argv=None) -> int:
                if args.all else
                f"--workload {args.workload} would silently run flat")
         )
+    if args.workload != "elastic":
+        # The misplaced-flag discipline, elastic edition: a morph
+        # schedule on a workload that never morphs must be a CLI
+        # error, not a fixed-topology row wearing a storm label.
+        for flag, val in (
+            ("--elastic-shrink-at", args.elastic_shrink_at),
+            ("--elastic-grow-at", args.elastic_grow_at),
+        ):
+            if val is not None:
+                ap.error(
+                    f"{flag} is only consumed by --workload elastic; "
+                    f"--workload {args.workload} would silently run "
+                    "fixed-topology"
+                )
+    else:
+        shrink = (
+            args.elastic_shrink_at
+            if args.elastic_shrink_at is not None else 2
+        )
+        grow = (
+            args.elastic_grow_at
+            if args.elastic_grow_at is not None else 4
+        )
+        if not 0 < shrink < grow:
+            ap.error(
+                f"--elastic-shrink-at {shrink} must be > 0 and < "
+                f"--elastic-grow-at {grow} (the storm is shrink -> "
+                "train -> grow -> train)"
+            )
+        if grow >= args.steps:
+            ap.error(
+                f"--elastic-grow-at {grow} needs --steps > {grow}: "
+                "the grow morph would never fire and the chaos "
+                "schedule would fail its vacuous-pass guard"
+            )
+        args.elastic_shrink_at, args.elastic_grow_at = shrink, grow
     if args.comm_table is not None and args.comm_mode != "auto":
         # Planner flags on non-auto modes: the --comm-mode guard
         # discipline. A table nothing consults must be a CLI error,
@@ -1954,6 +2144,11 @@ def main(argv=None) -> int:
             fleet_min=args.fleet_min or 1,
             fleet_swap_at=args.fleet_swap_at,
             fleet_router=args.fleet_router or "affinity",
+        )
+    elif args.workload == "elastic":
+        rec = bench_elastic(
+            args.steps, shrink_at=args.elastic_shrink_at,
+            grow_at=args.elastic_grow_at,
         )
     else:
         rec = bench_unet(args.steps)

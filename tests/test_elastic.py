@@ -1,0 +1,543 @@
+"""Topology-morphing coordinator (tpu_hpc.elastic): grow/shrink
+mid-run with no restart.
+
+The pinned contracts:
+
+* THE acceptance: a preemption-storm chaos run (shrink at step 2,
+  grow back at step 4) driven by the coordinator produces a loss
+  stream AND final params bit-identical to a fixed-topology run on
+  the final layout -- zero process restarts (one pid), zero
+  steady-state recompiles (per-segment compile counters pinned), and
+  the shrink moves ZERO wire bytes (the data-extent-preserving layout
+  keeps every surviving device's shard resident).
+* The morph-request channel (resilience.signals.MorphChannel): the
+  scheduler-facing sibling of the SIGTERM contract -- post/pending/
+  ack round-trips through the JSONL file, and a channel-driven morph
+  acks with the transition's wire bytes and stall.
+* Vacuous-pass guards, both directions: a Trainer OUTSIDE the
+  coordinator hard-rejects armed slice faults; the coordinator
+  hard-fails a run that ends with an armed slice fault that never
+  fired; a no-op morph target is refused, not acked.
+* The layout policy: the data-axis extent is preserved whenever
+  legal (what makes bit-identity possible at all -- see
+  elastic/layout.py for why a changed extent re-blocks the batch);
+  when preservation is impossible the decision says so.
+* Topology re-planning: the device-set fingerprint changes across a
+  morph and a ``comm_mode="auto"`` trainer re-plans against the new
+  fingerprint (one ``comm_plan`` event per topology segment).
+* Supervisor accounting: completed morphs are booked as ZERO budget
+  burned (``morphs_complete``), and the channel path is exported to
+  every supervised child.
+"""
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_hpc.config import TrainingConfig
+from tpu_hpc.elastic import (
+    TopologyCoordinator,
+    choose_layout,
+    legal_extents,
+)
+from tpu_hpc.resilience.signals import (
+    ENV_MORPH_CHANNEL,
+    MorphChannel,
+)
+from tpu_hpc.runtime import MeshSpec, build_mesh
+from tpu_hpc.train.trainer import Trainer
+
+N_DEV = 8  # conftest forces 8 sim devices
+
+
+def _init_params():
+    k1, k2 = jax.random.split(jax.random.key(7))
+    return {
+        "w1": jax.random.normal(k1, (16, 32), jnp.float32) * 0.1,
+        "w2": jax.random.normal(k2, (32, 4), jnp.float32) * 0.1,
+    }
+
+
+def _forward(params, model_state, batch, rng):
+    pred = jnp.tanh(batch["x"] @ params["w1"]) @ params["w2"]
+    return jnp.mean((pred - batch["y"]) ** 2), model_state, {}
+
+
+class _DS:
+    def batch_at(self, step, gbs):
+        k = jax.random.key(1000 + int(step))
+        kx, ky = jax.random.split(k)
+        return {
+            "x": jax.random.normal(kx, (gbs, 16), jnp.float32),
+            "y": jax.random.normal(ky, (gbs, 4), jnp.float32),
+        }
+
+
+def _cfg(path, steps=6, **kw):
+    return TrainingConfig(
+        epochs=steps, steps_per_epoch=1, global_batch_size=16,
+        learning_rate=1e-2, weight_decay=0.01, metrics_path=path,
+        **kw,
+    )
+
+
+def _factory(cfg):
+    def factory(mesh):
+        params = _init_params()
+        return Trainer(
+            cfg, mesh, _forward, params,
+            param_pspecs=jax.tree.map(lambda _: P(), params),
+            batch_pspec=P("data"),
+        )
+    return factory
+
+
+def _losses(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            r = json.loads(line)
+            if r.get("event") == "epoch":
+                out.append((r["step"], r["loss"]))
+    return out
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+# ---------------------------------------------------------------------
+# THE acceptance: preemption storm, bit-identical, zero restarts
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def storm(tmp_path_factory):
+    """One fixed-topology reference plus one coordinator-driven storm
+    (shrink@2 -> train -> grow@4 -> train) -- every acceptance pin
+    reads from here."""
+    tmp = tmp_path_factory.mktemp("storm")
+    fixed_path = str(tmp / "fixed.jsonl")
+    fixed_tr = _factory(_cfg(fixed_path))(build_mesh(
+        MeshSpec(axes={"data": 4, "replica": 2}),
+        devices=jax.devices(),
+    ))
+    fixed_res = fixed_tr.fit(_DS())
+
+    morph_path = str(tmp / "morph.jsonl")
+    ckpt_dir = str(tmp / "ck")
+    prev = os.environ.get("TPU_HPC_FAULTS")
+    os.environ["TPU_HPC_FAULTS"] = (
+        "slice_down_at_step=2,slice_up_at_step=4"
+    )
+    try:
+        coord = TopologyCoordinator(
+            _factory(_cfg(morph_path)), global_batch=16,
+            data_extent=4, checkpoint_dir=ckpt_dir,
+        )
+        summary = coord.run(_DS())
+    finally:
+        if prev is None:
+            os.environ.pop("TPU_HPC_FAULTS", None)
+        else:
+            os.environ["TPU_HPC_FAULTS"] = prev
+    return {
+        "fixed_res": fixed_res,
+        "fixed_params": jax.device_get(fixed_tr.state.params),
+        "fixed_path": fixed_path,
+        "coord": coord,
+        "summary": summary,
+        "morph_path": morph_path,
+        "ckpt_dir": ckpt_dir,
+    }
+
+
+class TestPreemptionStorm:
+    def test_loss_stream_bit_identical(self, storm):
+        fixed = _losses(storm["fixed_path"])
+        morph = _losses(storm["morph_path"])
+        assert len(fixed) == 6
+        assert fixed == morph  # bit-identical, not allclose
+
+    def test_final_params_bit_identical(self, storm):
+        got = jax.device_get(storm["coord"].trainer.state.params)
+        for a, b in zip(
+            jax.tree.leaves(storm["fixed_params"]),
+            jax.tree.leaves(got),
+        ):
+            np.testing.assert_array_equal(a, b)
+
+    def test_zero_process_restarts(self, storm):
+        s = storm["summary"]
+        assert s["restarts"] == 0
+        assert s["pid"] == os.getpid()
+        assert s["final_loss"] == storm["fixed_res"]["final_loss"]
+
+    def test_storm_shape(self, storm):
+        s = storm["summary"]
+        assert s["morph_count"] == 2
+        assert [m["kind"] for m in s["morphs"]] == ["shrink", "grow"]
+        assert [m["step"] for m in s["morphs"]] == [2, 4]
+        segs = [
+            (seg["n_devices"], seg["axes"]) for seg in s["segments"]
+        ]
+        assert segs == [
+            (8, {"data": 4, "replica": 2}),
+            (4, {"data": 4}),
+            (8, {"data": 4, "replica": 2}),
+        ]
+
+    def test_shrink_moves_zero_wire_bytes(self, storm):
+        """Every surviving device already holds its shard: the
+        data-extent-preserving shrink is a pure drop, not a move.
+        The grow pays real wire bytes (new devices need replicas)."""
+        shrink, grow = storm["summary"]["morphs"]
+        assert shrink["wire_bytes"] == 0
+        assert grow["wire_bytes"] > 0
+        assert storm["summary"]["wire_bytes"] == grow["wire_bytes"]
+
+    def test_extent_preserved_on_both_morphs(self, storm):
+        assert all(
+            m["preserved_data_extent"]
+            for m in storm["summary"]["morphs"]
+        )
+
+    def test_zero_steady_state_recompiles(self, storm):
+        """Compile accounting: each segment's only compiles are its
+        own warmup (same count every segment -- nothing recompiles
+        mid-segment), and each morph's reshard programs are counted
+        on the morph record."""
+        segs = storm["summary"]["segments"]
+        counts = {seg["compiled_epoch_fns"] for seg in segs}
+        assert len(counts) == 1
+        for m in storm["summary"]["morphs"]:
+            assert m["compiled_programs"] >= 0
+
+    def test_topology_morph_events_schema_valid(self, storm):
+        from tpu_hpc.obs.schema import validate_file
+
+        validate_file(storm["morph_path"])
+        recs = _records(storm["morph_path"])
+        morphs = [
+            r for r in recs if r.get("event") == "topology_morph"
+        ]
+        assert len(morphs) == 2
+        for r in morphs:
+            assert r["trace_id"]
+            assert r["stall_s"] >= 0
+            assert r["plan"]["axes"]
+        assert morphs[0]["reason"] == "shrink"
+        assert morphs[0]["src_mesh"] == {"data": 4, "replica": 2}
+        assert morphs[0]["tgt_mesh"] == {"data": 4}
+        assert morphs[1]["reason"] == "grow"
+        # The injection announcements ride next to their effects.
+        faults = [r for r in recs if r.get("event") == "fault"]
+        assert [f["kind"] for f in faults] == [
+            "slice_down", "slice_up",
+        ]
+        spans = [
+            r for r in recs
+            if r.get("event") == "span" and r.get("name") == "morph"
+        ]
+        assert len(spans) == 2
+
+    def test_sidecar_topology_history_records_morphs(self, storm):
+        from tpu_hpc.reshard.elastic import read_topology_history
+
+        hist = read_topology_history(storm["ckpt_dir"])
+        reasons = [e["reason"] for e in hist]
+        assert reasons == ["morph-shrink", "morph-grow"]
+        assert hist[0]["mesh"] == {"data": 4}
+        assert hist[1]["mesh"] == {"data": 4, "replica": 2}
+        assert [e["device_count"] for e in hist] == [4, 8]
+
+    def test_report_renders_topology_morphs(self, storm):
+        from tpu_hpc.obs.report import build_report, format_report
+
+        rep = build_report(_records(storm["morph_path"]))
+        el = rep["elastic"]
+        assert el["morphs"] == 2
+        assert el["wire_bytes"] == storm["summary"]["wire_bytes"]
+        assert el["stall_s"] > 0
+        text = format_report(rep)
+        assert "## Topology morphs" in text
+        assert "zero process restarts" in text
+
+    def test_regress_flattens_elastic_namespace(self, storm):
+        from tpu_hpc.obs.regress import report_metrics
+        from tpu_hpc.obs.report import build_report
+
+        flat = report_metrics(
+            build_report(_records(storm["morph_path"]))
+        )
+        assert flat["elastic.morphs"] == 2.0
+        assert flat["elastic.wire_bytes"] == float(
+            storm["summary"]["wire_bytes"]
+        )
+        assert flat["elastic.stall_s"] > 0
+
+
+# ---------------------------------------------------------------------
+# layout policy
+# ---------------------------------------------------------------------
+class TestLayout:
+    def test_legal_extents(self):
+        assert legal_extents(8, 16) == [1, 2, 4, 8]
+        assert legal_extents(6, 16) == [1, 2]  # 3, 6 don't divide 16
+        assert legal_extents(4, 12) == [1, 2, 4]
+
+    def test_preserves_current_extent_when_legal(self):
+        d = choose_layout(
+            jax.devices()[:4], global_batch=16,
+            current_data_extent=4,
+        )
+        assert d.axes == {"data": 4}
+        assert d.preserved_data_extent is True
+        d2 = choose_layout(
+            jax.devices(), global_batch=16, current_data_extent=4,
+        )
+        assert d2.axes == {"data": 4, "replica": 2}
+        assert d2.preserved_data_extent is True
+
+    def test_impossible_preservation_falls_back_and_says_so(self):
+        # extent 8 cannot fit on 4 devices: the decision re-plans and
+        # flags that bit-exact continuity was given up.
+        d = choose_layout(
+            jax.devices()[:4], global_batch=16,
+            current_data_extent=8,
+        )
+        assert d.axes["data"] <= 4
+        assert d.preserved_data_extent is False
+
+    def test_empty_device_set_is_a_typed_error(self):
+        with pytest.raises(ValueError, match="non-empty"):
+            choose_layout([], global_batch=16)
+
+    def test_awkward_device_count_still_has_extent_one(self):
+        # 5 devices, batch 16: only extent 1 is legal -- the layout
+        # degrades to replication rather than refusing to run.
+        d = choose_layout(jax.devices()[:5], global_batch=16)
+        assert d.axes == {"data": 1, "replica": 5}
+        assert d.data_extent == 1
+
+    def test_decision_summary_is_json_safe(self):
+        d = choose_layout(
+            jax.devices(), global_batch=16, current_data_extent=4,
+        )
+        s = json.dumps(d.summary())
+        assert "axes" in s and "fingerprint" in s
+
+
+# ---------------------------------------------------------------------
+# the morph-request channel
+# ---------------------------------------------------------------------
+class TestMorphChannel:
+    def test_post_pending_ack_round_trip(self, tmp_path):
+        ch = MorphChannel(str(tmp_path / "chan.jsonl"))
+        s0 = ch.post("shrink", 4, step=2)
+        s1 = ch.post("grow", 8, step=5)
+        pend = ch.pending()
+        assert [(r.kind, r.n_devices, r.step) for r in pend] == [
+            ("shrink", 4, 2), ("grow", 8, 5),
+        ]
+        ch.ack(s0, step=2, wire_bytes=0)
+        assert [r.seq for r in ch.pending()] == [s1]
+        ch.ack(s1, step=5, wire_bytes=123)
+        assert ch.pending() == []
+        acked = ch.acked()
+        assert len(acked) == 2
+        assert acked[1]["wire_bytes"] == 123
+
+    def test_invalid_request_rejected(self, tmp_path):
+        ch = MorphChannel(str(tmp_path / "chan.jsonl"))
+        with pytest.raises(ValueError, match="kind"):
+            ch.post("explode", 4)
+        with pytest.raises(ValueError, match="n_devices"):
+            ch.post("shrink", 0)
+
+    def test_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(ENV_MORPH_CHANNEL, raising=False)
+        assert MorphChannel.from_env() is None
+        p = str(tmp_path / "c.jsonl")
+        monkeypatch.setenv(ENV_MORPH_CHANNEL, p)
+        ch = MorphChannel.from_env()
+        assert ch is not None and ch.path == p
+
+    def test_channel_driven_morph_acks_with_costs(self, tmp_path):
+        """A scheduler-shaped request (no chaos env at all) drives
+        the same live transition, and the ack carries the evidence."""
+        ch = MorphChannel(str(tmp_path / "chan.jsonl"))
+        ch.post("shrink", 4, step=2)
+        coord = TopologyCoordinator(
+            _factory(_cfg(str(tmp_path / "m.jsonl"), steps=4)),
+            global_batch=16, data_extent=4, channel=ch,
+        )
+        summary = coord.run(_DS())
+        assert summary["morph_count"] == 1
+        assert summary["morphs"][0]["source"] == "channel"
+        assert summary["restarts"] == 0
+        acked = ch.acked()
+        assert len(acked) == 1
+        assert acked[0]["step"] == 2
+        assert acked[0]["tgt_mesh"] == {"data": 4}
+        assert "wire_bytes" in acked[0]
+        assert ch.pending() == []
+
+    def test_noop_morph_target_is_refused(self, tmp_path):
+        ch = MorphChannel(str(tmp_path / "chan.jsonl"))
+        ch.post("grow", N_DEV, step=1)  # already at the full pool
+        coord = TopologyCoordinator(
+            _factory(_cfg(str(tmp_path / "m.jsonl"), steps=3)),
+            global_batch=16, data_extent=4, channel=ch,
+        )
+        with pytest.raises(RuntimeError, match="no-op"):
+            coord.run(_DS())
+
+
+# ---------------------------------------------------------------------
+# vacuous-pass guards, both directions + parse discipline
+# ---------------------------------------------------------------------
+class TestSliceFaultDiscipline:
+    def test_typed_parse(self):
+        from tpu_hpc.resilience.faults import fault_plan_from_env
+
+        plan = fault_plan_from_env({
+            "TPU_HPC_FAULTS":
+                "slice_down_at_step=2,slice_up_at_step=4",
+        })
+        assert plan.slice_down_at_step == 2
+        assert plan.slice_up_at_step == 4
+        assert plan.slice_fault_keys() == [
+            "slice_down_at_step", "slice_up_at_step",
+        ]
+
+    def test_malformed_value_names_key_and_type(self):
+        from tpu_hpc.resilience.faults import fault_plan_from_env
+
+        with pytest.raises(
+            ValueError, match=r"slice_down_at_step.*int"
+        ):
+            fault_plan_from_env(
+                {"TPU_HPC_FAULTS": "slice_down_at_step=soon"}
+            )
+
+    def test_unmanaged_trainer_rejects_slice_faults(
+        self, monkeypatch, tmp_path
+    ):
+        """Direction one: a Trainer outside the coordinator cannot
+        morph, so an armed slice fault would silently never fire."""
+        monkeypatch.setenv("TPU_HPC_FAULTS", "slice_down_at_step=2")
+        with pytest.raises(ValueError, match="elastic coordinator"):
+            _factory(_cfg(str(tmp_path / "m.jsonl")))(build_mesh(
+                MeshSpec(axes={"data": 4, "replica": 2}),
+                devices=jax.devices(),
+            ))
+
+    def test_unfired_slice_fault_fails_the_run(
+        self, monkeypatch, tmp_path
+    ):
+        """Direction two: the coordinator refuses to let a chaos
+        schedule pass when its armed fault never fired."""
+        monkeypatch.setenv(
+            "TPU_HPC_FAULTS", "slice_down_at_step=99"
+        )
+        coord = TopologyCoordinator(
+            _factory(_cfg(str(tmp_path / "m.jsonl"), steps=3)),
+            global_batch=16, data_extent=4,
+        )
+        with pytest.raises(RuntimeError, match="never fired"):
+            coord.run(_DS())
+
+
+# ---------------------------------------------------------------------
+# topology re-plan: fingerprint changes, comm_mode="auto" follows
+# ---------------------------------------------------------------------
+class TestTopologyReplan:
+    def test_fingerprint_digest_changes_across_morph(self):
+        from tpu_hpc.comm.planner import fingerprint_devices
+
+        full = fingerprint_devices(jax.devices())
+        half = fingerprint_devices(jax.devices()[:4])
+        assert full.digest != half.digest
+
+    def test_comm_auto_replans_per_topology_segment(self, tmp_path):
+        """Every segment's Trainer re-resolves comm_mode="auto"
+        against ITS device set: one comm_plan event per segment, and
+        the shrunken segment's fingerprint differs from the full
+        pool's."""
+        path = str(tmp_path / "m.jsonl")
+        ch = MorphChannel(str(tmp_path / "chan.jsonl"))
+        ch.post("shrink", 4, step=2)
+        coord = TopologyCoordinator(
+            _factory(_cfg(path, steps=4, comm_mode="auto")),
+            global_batch=16, data_extent=4, channel=ch,
+        )
+        summary = coord.run(_DS())
+        assert summary["morph_count"] == 1
+        plans = [
+            r for r in _records(path)
+            if r.get("event") == "comm_plan"
+        ]
+        assert len(plans) == len(summary["segments"]) == 2
+        fps = [p["fingerprint"] for p in plans]
+        assert fps[0] != fps[1]
+
+
+# ---------------------------------------------------------------------
+# supervisor accounting: morphs burn zero budget
+# ---------------------------------------------------------------------
+class TestSupervisorMorphAccounting:
+    def test_channel_exported_and_morphs_booked_as_zero_burn(
+        self, tmp_path, monkeypatch
+    ):
+        from tpu_hpc.resilience.supervisor import Supervisor
+
+        monkeypatch.delenv(ENV_MORPH_CHANNEL, raising=False)
+        log_dir = str(tmp_path / "logs")
+        # The child plays an elastic-managed run: it finds the
+        # exported channel, completes two morphs (posts acks), exits
+        # clean -- no restart machinery involved.
+        child = (
+            "import json, os\n"
+            "p = os.environ['TPU_HPC_MORPH_CHANNEL']\n"
+            "from tpu_hpc.resilience.signals import MorphChannel\n"
+            "ch = MorphChannel(p)\n"
+            "s0 = ch.post('shrink', 4, step=2)\n"
+            "s1 = ch.post('grow', 8, step=4)\n"
+            "ch.ack(s0, step=2, wire_bytes=0)\n"
+            "ch.ack(s1, step=4, wire_bytes=123)\n"
+        )
+        sup = Supervisor(
+            [sys.executable, "-c", child],
+            max_restarts=0, log_dir=log_dir,
+        )
+        assert sup.run() == 0
+        events = _records(os.path.join(log_dir, "supervisor.jsonl"))
+        done = [
+            e for e in events if e["event"] == "morphs_complete"
+        ]
+        assert len(done) == 1
+        assert done[0]["count"] == 2
+        assert done[0]["budget_burned"] == 0
+        from tpu_hpc.obs.schema import validate_record
+
+        validate_record(done[0])
+
+    def test_no_channel_no_event(self, tmp_path, monkeypatch):
+        from tpu_hpc.resilience.supervisor import Supervisor
+
+        monkeypatch.delenv(ENV_MORPH_CHANNEL, raising=False)
+        log_dir = str(tmp_path / "logs")
+        sup = Supervisor(
+            [sys.executable, "-c", "pass"],
+            max_restarts=0, log_dir=log_dir,
+        )
+        assert sup.run() == 0
+        events = _records(os.path.join(log_dir, "supervisor.jsonl"))
+        assert not [
+            e for e in events if e["event"] == "morphs_complete"
+        ]

@@ -512,6 +512,82 @@ class TestStageFaultSpec:
 
 
 # ---------------------------------------------------------------------
+# slice loss: remap onto a surviving device, budget untouched
+# ---------------------------------------------------------------------
+class TestSliceRemap:
+    def test_slice_loss_remaps_without_burning_budget(
+        self, data, clean_run, fresh_bus
+    ):
+        """The stage whose slice goes away remaps onto a surviving
+        device instead of dying through the restart path: zero budget
+        burned, zero redispatches, loss stream + final params
+        bit-identical to the no-fault run."""
+        pipe = _build(
+            data,
+            fault_spec="slice_down_at_step=1,slice_up_at_step=2",
+            events_path=fresh_bus,
+        )
+        last = CFG.n_stages - 1
+        home = list(pipe._home_devices)
+        res = pipe.train(clean_run["batches"])
+
+        # The remap trail: off the lost slice onto stage 0's device,
+        # then back home when the slice returns.
+        assert [r["reason"] for r in res["stage_remaps"]] == [
+            "slice-lost", "slice-restored",
+        ]
+        assert [r["stage"] for r in res["stage_remaps"]] == [
+            last, last,
+        ]
+        assert res["stage_remaps"][0]["to_device"] == str(home[0])
+        assert res["stage_remaps"][1]["to_device"] == str(home[last])
+        assert pipe.devices[last] is home[last]
+
+        # The headline: NOT a stage failure. No restart budget
+        # charged, no recovery row, nothing replayed.
+        assert res["stage_restarts"] == {}
+        assert res["stage_rollbacks"] == {}
+        assert res["recoveries"] == []
+        assert res["redispatched"] == 0
+
+        # Bit-identical continuity across both remaps.
+        assert res["losses"] == clean_run["result"]["losses"]
+        for s in range(CFG.n_stages):
+            assert _tree_equal(
+                pipe.stage_state(s), clean_run["states"][s]
+            ), f"stage {s} final state diverged"
+
+        # Evidence trail: stage_remap events (schema-valid), and NO
+        # stage_down/stage_up pair -- this is not the crash path.
+        from tpu_hpc.obs.schema import load_records, validate_file
+
+        validate_file(fresh_bus)
+        recs = load_records(fresh_bus)
+        remaps = [r for r in recs if r["event"] == "stage_remap"]
+        assert [r["reason"] for r in remaps] == [
+            "slice-lost", "slice-restored",
+        ]
+        assert all(r["stage"] == last for r in remaps)
+        assert not [r for r in recs if r["event"] == "stage_down"]
+        faults = [r for r in recs if r["event"] == "fault"]
+        assert [f["kind"] for f in faults] == [
+            "slice_down", "slice_up",
+        ]
+
+    def test_unfired_slice_fault_fails_loudly(self, data):
+        pipe = _build(data, fault_spec="slice_down_at_step=99")
+        params, tokens, targets = data
+        with pytest.raises(RuntimeError, match="never fired"):
+            pipe.train([(tokens, targets)])
+
+    def test_slice_up_without_down_rejected(self, data):
+        with pytest.raises(
+            ValueError, match="slice_up_at_step"
+        ):
+            _build(data, fault_spec="slice_up_at_step=1")
+
+
+# ---------------------------------------------------------------------
 # snapshot integrity
 # ---------------------------------------------------------------------
 def test_corrupt_snapshot_fails_restore_loudly(clean_run):

@@ -64,6 +64,15 @@ class TestCompare:
         assert lower_is_better(
             "serve_spec_ngram_tokens_per_s_per_chip.itl_ms_p50"
         )
+        # Elastic topology morphing (elastic/coordinator.py): stall
+        # seconds, wire bytes, and morph counts all regress UPWARD --
+        # a run that morphs more, moves more bytes, or stalls longer
+        # for the same fault storm got worse.
+        assert lower_is_better("elastic_morph_stall_s")
+        assert lower_is_better("elastic_morph_stall_s.morphs")
+        assert lower_is_better("elastic_morph_stall_s.morph_wire_bytes")
+        assert lower_is_better("elastic.wire_bytes")
+        assert lower_is_better("elastic.stall_s")
 
     def test_spec_config_fields_not_compared(self):
         """spec_k is config; drafted/accepted/rejected/verify_steps

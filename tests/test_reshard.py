@@ -758,7 +758,55 @@ class TestElasticRestore:
             mgr.wait()
         names = sorted(os.listdir(os.path.join(d, SIDECAR_DIR)))
         kept = {f"{s}.json" for s in mgr.all_steps()}
-        assert set(names) == kept
+        # The topology-history file shares the dir but is GC'd by
+        # entry, not by file -- it never matches the per-step scan.
+        assert set(names) - {"topology_history.json"} == kept
+        mgr.close()
+
+    def test_topology_history_pruned_with_checkpoints(
+        self, mesh_a, tmp_path
+    ):
+        """The morph-history file is GC'd alongside the sidecars:
+        ``save`` entries for collected checkpoints vanish, morph
+        entries older than the oldest retained checkpoint vanish,
+        and everything at or past the retention floor survives --
+        the history cannot grow without bound on a long run."""
+        from tpu_hpc.ckpt import CheckpointManager
+        from tpu_hpc.reshard.elastic import (
+            append_topology_history,
+            read_topology_history,
+        )
+
+        d = str(tmp_path / "ck")
+        mgr = CheckpointManager(d, max_to_keep=2, async_save=False)
+        # Interleave saves with coordinator-style morph entries, the
+        # shape a real elastic run writes.
+        for s in (1, 2, 3, 4):
+            mgr.save(self._state(mesh_a, P("data")), step=s)
+            mgr.wait()
+            append_topology_history(
+                d, s, {"axes": {"data": 4}},
+                reason="morph-shrink" if s % 2 else "morph-grow",
+            )
+        kept_steps = set(mgr.all_steps())
+        assert kept_steps == {3, 4}
+        history = read_topology_history(d)
+        assert history, "history must survive pruning, trimmed"
+        floor = min(kept_steps)
+        for entry in history:
+            if entry["reason"] == "save":
+                assert entry["step"] in kept_steps
+            else:
+                assert entry["step"] >= floor
+        # Both retained saves and both retained morphs are present.
+        assert {
+            e["step"] for e in history if e["reason"] == "save"
+        } == kept_steps
+        assert {
+            e["reason"] for e in history if e["reason"] != "save"
+        } == {"morph-shrink", "morph-grow"}
+        # Stale entries are genuinely gone, not just shadowed.
+        assert all(e["step"] >= floor for e in history)
         mgr.close()
 
 

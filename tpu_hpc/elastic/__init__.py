@@ -1,0 +1,23 @@
+"""tpu_hpc.elastic -- topology-morphing coordinator.
+
+Grow/shrink a training run's device set mid-run with no process
+restart: quiesce at a step boundary, reshard the live state onto the
+cheapest legal layout for the new device set, rebuild the step
+executables, resume. See :mod:`tpu_hpc.elastic.coordinator` for the
+transition anatomy and :mod:`tpu_hpc.elastic.layout` for the layout
+policy (and why the data-axis extent is pinned for bit-exact
+continuity).
+"""
+from tpu_hpc.elastic.coordinator import TopologyCoordinator
+from tpu_hpc.elastic.layout import (
+    LayoutDecision,
+    choose_layout,
+    legal_extents,
+)
+
+__all__ = [
+    "TopologyCoordinator",
+    "LayoutDecision",
+    "choose_layout",
+    "legal_extents",
+]

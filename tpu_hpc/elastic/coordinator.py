@@ -1,0 +1,361 @@
+"""The topology coordinator: grow/shrink mid-run with no restart.
+
+Elastic resume before this module was restart-shaped: a preemption
+notice meant snapshot, exit 75, relaunch, reshard the checkpoint back
+in. Correct, but the whole process pays bring-up again and the
+supervisor's restart machinery is in the loop for an event that was
+PLANNED. This coordinator makes a planned topology change a live
+transition instead::
+
+    coord = TopologyCoordinator(
+        trainer_factory,            # callable(mesh) -> Trainer
+        global_batch=cfg.global_batch_size,
+        data_extent=4,              # sustainable across the storm
+    )
+    summary = coord.run(dataset)
+
+On a morph event -- a ``slice_down_at_step``/``slice_up_at_step``
+chaos fault, or a scheduler request on the morph channel
+(resilience.signals.MorphChannel) -- the coordinator:
+
+1. **quiesces** the running Trainer at the first step boundary at or
+   past the event's step (the trainer's ``quiesce_check`` hook caps
+   its chunk to land exactly there; nothing is saved, nothing exits);
+2. **chooses the target layout** for the new device set
+   (:func:`tpu_hpc.elastic.layout.choose_layout`: planner cost tables
+   + the reshard wire-byte model; the data-axis extent is preserved
+   whenever legal, which is what keeps the loss stream bit-identical
+   across the morph);
+3. **morphs live**: builds the new mesh/Trainer, then moves params +
+   optimizer state + step/rng state on-device through the bounded
+   reshard engine (``max_inflight_bytes="auto"``) and hands the tree
+   to the new Trainer via ``adopt_state`` -- the in-memory step stays
+   the data-stream truth, so the resumed stream picks up exactly
+   where the quiesce stopped;
+4. **resumes** fit() on the new topology. The only recompiles are the
+   new layout's warmup; steady state afterward compiles nothing.
+
+Zero process restarts by construction: everything happens in this
+process, so a completed morph burns none of the supervisor's
+restart/preemption/rollback budgets (it emits a ``morphs_complete``
+accounting event instead). Every morph emits a ``topology_morph``
+record (wire bytes, stall seconds, layout decision, trace id) and
+appends to the checkpoint sidecars' topology history.
+
+Chaos discipline (both vacuous-pass directions): a Trainer outside
+this coordinator hard-rejects armed slice faults (it cannot morph);
+this coordinator hard-FAILS a run that ends with an armed slice fault
+that never fired -- a chaos schedule that injected nothing must not
+pass.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+from tpu_hpc import obs
+from tpu_hpc.elastic.layout import choose_layout
+from tpu_hpc.resilience.faults import fault_plan_from_env
+from tpu_hpc.resilience.signals import (
+    ENV_ELASTIC_MANAGED,
+    MorphChannel,
+)
+
+
+class TopologyCoordinator:
+    """Runs a Trainer through planned topology transitions.
+
+    ``trainer_factory``: callable(mesh) -> Trainer. Called once per
+    topology; every Trainer must be built from the same config and
+    dataset contract (the coordinator re-plans the mesh, not the
+    run). ``devices``: the FULL device pool (default ``jax.devices()``)
+    -- shrink events keep a prefix of it, grow events extend back
+    toward it. ``data_extent``: pin the data axis to this extent on
+    every layout (the bit-exact-continuity knob; must divide every
+    device count the run will morph through). ``checkpoint_dir``:
+    where sidecar topology history lands (default: none recorded).
+    """
+
+    def __init__(
+        self,
+        trainer_factory: Callable[[Any], Any],
+        *,
+        global_batch: int,
+        devices: Optional[Sequence[Any]] = None,
+        data_extent: Optional[int] = None,
+        table_dir: Optional[str] = None,
+        checkpoint_dir: Optional[str] = None,
+        channel: Optional[MorphChannel] = None,
+        sink: Optional[str] = None,
+    ):
+        self.trainer_factory = trainer_factory
+        self.global_batch = int(global_batch)
+        self.all_devices = list(
+            devices if devices is not None else jax.devices()
+        )
+        self.data_extent = data_extent
+        self.table_dir = table_dir
+        self.checkpoint_dir = checkpoint_dir
+        self.channel = channel or MorphChannel.from_env()
+        self.sink = sink
+        self.fault_plan = fault_plan_from_env()
+        self._consumed_faults: set = set()
+        self.morphs: List[dict] = []
+        self.pid = os.getpid()
+        # The live Trainer of the CURRENT topology segment (tests
+        # compare its final params bit-for-bit against a
+        # fixed-topology run).
+        self.trainer: Optional[Any] = None
+
+    # -- event sources -------------------------------------------------
+    def _fault_events(self) -> List[dict]:
+        """Slice-chaos events still to fire (attempt-scoped like every
+        other injection; the two-slice pod model: slice_down keeps the
+        surviving half of the pool, slice_up restores the full set)."""
+        plan = self.fault_plan
+        if plan is None or not plan.active:
+            return []
+        half = max(len(self.all_devices) // 2, 1)
+        events = []
+        if (
+            plan.slice_down_at_step is not None
+            and "slice_down" not in self._consumed_faults
+        ):
+            events.append({
+                "kind": "shrink", "fault": "slice_down",
+                "n_devices": half, "step": plan.slice_down_at_step,
+                "source": "fault",
+            })
+        if (
+            plan.slice_up_at_step is not None
+            and "slice_up" not in self._consumed_faults
+        ):
+            events.append({
+                "kind": "grow", "fault": "slice_up",
+                "n_devices": len(self.all_devices),
+                "step": plan.slice_up_at_step,
+                "source": "fault",
+            })
+        return events
+
+    def _next_event(self) -> Optional[dict]:
+        """The earliest un-honored morph event, chaos or channel."""
+        events = self._fault_events()
+        if self.channel is not None:
+            for req in self.channel.pending():
+                events.append({
+                    "kind": req.kind, "n_devices": req.n_devices,
+                    "step": req.step, "source": "channel",
+                    "seq": req.seq,
+                })
+        if not events:
+            return None
+        return min(events, key=lambda e: (e["step"], e["kind"]))
+
+    def _quiesce_check(self, done: int) -> Optional[int]:
+        """The Trainer's quiesce hook: the step boundary the earliest
+        pending event wants (``step >= N`` semantics -- never before
+        the event's step, never before where the run already is)."""
+        ev = self._next_event()
+        if ev is None:
+            return None
+        return max(int(ev["step"]), int(done))
+
+    # -- the run loop --------------------------------------------------
+    def run(self, dataset, epochs: Optional[int] = None) -> Dict:
+        """Train to completion through every morph event. Returns a
+        summary: per-topology fit segments, the morph records, total
+        wire bytes / stall seconds, and the zero-restart evidence
+        (one pid, restarts=0)."""
+        prev = os.environ.get(ENV_ELASTIC_MANAGED)
+        os.environ[ENV_ELASTIC_MANAGED] = "1"
+        try:
+            return self._run(dataset, epochs)
+        finally:
+            if prev is None:
+                os.environ.pop(ENV_ELASTIC_MANAGED, None)
+            else:
+                os.environ[ENV_ELASTIC_MANAGED] = prev
+
+    def _build(self, devices, state=None, current_extent=None):
+        from tpu_hpc.runtime import MeshSpec, build_mesh
+
+        decision = choose_layout(
+            devices,
+            global_batch=self.global_batch,
+            state=state,
+            current_data_extent=(
+                self.data_extent
+                if self.data_extent is not None else current_extent
+            ),
+            table_dir=self.table_dir,
+        )
+        mesh = build_mesh(
+            MeshSpec(axes=dict(decision.axes)), devices=list(devices)
+        )
+        trainer = self.trainer_factory(mesh)
+        trainer.quiesce_check = self._quiesce_check
+        return decision, trainer
+
+    def _run(self, dataset, epochs) -> Dict:
+        devices = list(self.all_devices)
+        _, trainer = self._build(devices)
+        segments: List[dict] = []
+        while True:
+            self.trainer = trainer
+            result = trainer.fit(dataset, epochs=epochs)
+            segments.append({
+                "n_devices": int(trainer.mesh.size),
+                "axes": {
+                    k: int(v) for k, v in trainer.mesh.shape.items()
+                },
+                "compiled_epoch_fns": len(trainer._epoch_fns),
+                "fit": result,
+            })
+            if not result.get("quiesced"):
+                break
+            ev = self._next_event()
+            if ev is None:  # pragma: no cover - hook/event race
+                break
+            trainer = self._morph(trainer, ev)
+        leftover = [
+            e["fault"] for e in self._fault_events()
+        ]
+        if leftover:
+            raise RuntimeError(
+                f"TPU_HPC_FAULTS armed slice fault(s) "
+                f"{', '.join(leftover)} that never fired -- the run "
+                "ended before their step; refusing to let a chaos "
+                "schedule pass vacuously"
+            )
+        return {
+            "segments": segments,
+            "morphs": list(self.morphs),
+            "morph_count": len(self.morphs),
+            "wire_bytes": sum(m["wire_bytes"] for m in self.morphs),
+            "stall_s": round(
+                sum(m["stall_s"] for m in self.morphs), 6
+            ),
+            "restarts": 0,
+            "pid": self.pid,
+            "final_loss": segments[-1]["fit"]["final_loss"],
+            "preempted": segments[-1]["fit"].get("preempted", False),
+        }
+
+    # -- one transition ------------------------------------------------
+    def _morph(self, old_trainer, ev: dict):
+        from tpu_hpc.reshard import plan_reshard
+        from tpu_hpc.reshard.elastic import (
+            append_topology_history,
+        )
+
+        n_target = int(ev["n_devices"])
+        n_current = int(old_trainer.mesh.size)
+        if n_target == n_current:
+            raise RuntimeError(
+                f"morph event {ev} targets the current device count "
+                f"({n_current}) -- a no-op transition cannot inject; "
+                "refusing to ack it"
+            )
+        if n_target > len(self.all_devices):
+            raise RuntimeError(
+                f"morph event {ev} wants {n_target} devices but the "
+                f"pool holds {len(self.all_devices)}"
+            )
+        step = int(jax.device_get(old_trainer.state.step))
+        src_axes = {
+            k: int(v) for k, v in old_trainer.mesh.shape.items()
+        }
+        seq = len(self.morphs)
+        tid = obs.step_trace_id(step)
+        # Morph evidence lands in the RUN LOG the trainer writes
+        # (cfg.metrics_path, host 0) unless the coordinator was given
+        # its own sink -- the transition belongs next to the epoch
+        # records it interrupts.
+        sink = self.sink
+        if sink is None and hasattr(old_trainer, "_sink"):
+            sink = old_trainer._sink()
+        if ev["source"] == "fault":
+            # The injection announcement every other chaos kind makes
+            # (faults.FaultPlan._announce): cause next to effects.
+            obs.get_bus().emit(
+                "fault", sink=sink, kind=ev["fault"],
+                step=step, trace_id=tid,
+            )
+        t0 = time.perf_counter()
+        devices = self.all_devices[:n_target]
+        decision, new_trainer = self._build(
+            devices,
+            state=old_trainer.state,
+            current_extent=int(
+                old_trainer.mesh.shape.get("data", 1)
+            ),
+        )
+        plan = plan_reshard(
+            old_trainer.state,
+            new_trainer._state_shardings,
+            max_inflight_bytes="auto",
+            label=f"morph{seq}",
+        )
+        morphed = plan.execute(
+            old_trainer.state, donate=True, sink=sink
+        )
+        new_trainer.adopt_state(morphed)
+        stall_s = time.perf_counter() - t0
+        obs.emit_span(
+            "morph", stall_s, sink=sink, step=step,
+            trace_id=tid,
+        )
+        rec = {
+            "event": "topology_morph",
+            "step": step,
+            "trace_id": tid,
+            "src_mesh": src_axes,
+            "tgt_mesh": dict(decision.axes),
+            "wire_bytes": int(plan.wire_bytes),
+            "stall_s": round(stall_s, 6),
+            "reason": ev["kind"],
+            "n_devices_from": n_current,
+            "n_devices_to": n_target,
+            "morph_seq": seq,
+            "preserved_data_extent": decision.preserved_data_extent,
+            "compiled_programs": int(plan.compiled_program_count),
+            "plan": decision.summary(),
+        }
+        if plan.predicted_cost_s is not None:
+            rec["predicted_cost_s"] = round(plan.predicted_cost_s, 6)
+        obs.get_bus().emit_record(rec, sink=sink)
+        if self.checkpoint_dir:
+            append_topology_history(
+                self.checkpoint_dir, step,
+                {
+                    "mesh": dict(decision.axes),
+                    "device_count": n_target,
+                },
+                reason=f"morph-{ev['kind']}",
+            )
+        if ev["source"] == "channel" and self.channel is not None:
+            self.channel.ack(
+                ev["seq"], step=step,
+                wire_bytes=int(plan.wire_bytes),
+                stall_s=round(stall_s, 6),
+                tgt_mesh=dict(decision.axes),
+            )
+        elif ev["source"] == "fault":
+            self._consumed_faults.add(ev["fault"])
+        self.morphs.append({
+            "seq": seq,
+            "step": step,
+            "kind": ev["kind"],
+            "source": ev["source"],
+            "src_mesh": src_axes,
+            "tgt_mesh": dict(decision.axes),
+            "wire_bytes": int(plan.wire_bytes),
+            "stall_s": round(stall_s, 6),
+            "preserved_data_extent": decision.preserved_data_extent,
+            "compiled_programs": int(plan.compiled_program_count),
+        })
+        return new_trainer

@@ -1,0 +1,214 @@
+"""Target-layout choice for a topology morph.
+
+Given the device set that will survive (or the expanded set a
+returning slice provides), pick the cheapest LEGAL mesh layout to
+morph onto. Two cost sources, both already measured elsewhere in the
+tree -- this module only composes them:
+
+* the transition itself: the reshard engine's exact wire-byte model
+  (:func:`tpu_hpc.reshard.plan.modeled_wire_bytes`) over the live
+  state's shardings, priced by the planner's tier model;
+* the steady state after it: the PR-12 collective planner's
+  grad-sync decision (measured cost table when one exists for the
+  fingerprint, alpha-beta fallback otherwise) plus a data-parallel
+  compute term.
+
+The one non-obvious rule is ``preserve_data_extent`` (default on):
+the loss stream is bit-identical across a morph ONLY when the data
+axis keeps its extent -- batch-stat reductions reassociate otherwise
+(1-2 ulp from the second step on, measured). So a shrink from
+``{data: 4, replica: 2}`` on 8 devices goes to ``{data: 4}`` on 4,
+never to ``{data: 8}``-anything: surplus devices ride a pure
+``replica`` axis (params replicated across it, batch split only over
+``data``), and the arithmetic per step is unchanged. Layouts that
+cannot preserve the extent (the surviving set no longer divides by
+it) fall back to the cheapest legal extent -- and the decision
+records that bit-exact continuity was given up, so the parity pin
+knows not to expect it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# Normalization constants for the steady-state score. Absolute scale
+# is irrelevant (only the ordering of candidates matters); the
+# horizon says how many future steps a transition cost amortizes
+# over -- short horizons prefer cheap transitions, long horizons
+# prefer throughput.
+STEP_ITEM_COST_S = 1e-6
+HORIZON_STEPS = 1000
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutDecision:
+    """The chosen layout plus the evidence for the choice (rides the
+    ``topology_morph`` event's ``plan`` field)."""
+
+    axes: Dict[str, int]
+    n_devices: int
+    data_extent: int
+    preserved_data_extent: bool
+    transition_wire_bytes: int
+    predicted_transition_s: float
+    predicted_step_s: float
+    source: str
+    fingerprint: str
+    candidates: List[dict]
+
+    def summary(self) -> dict:
+        return {
+            "axes": dict(self.axes),
+            "n_devices": self.n_devices,
+            "data_extent": self.data_extent,
+            "preserved_data_extent": self.preserved_data_extent,
+            "transition_wire_bytes": self.transition_wire_bytes,
+            "predicted_transition_s": round(
+                self.predicted_transition_s, 6
+            ),
+            "predicted_step_s": round(self.predicted_step_s, 6),
+            "source": self.source,
+            "fingerprint": self.fingerprint,
+            "candidates": self.candidates,
+        }
+
+
+def _axes_for(data: int, replica: int) -> Dict[str, int]:
+    """Mesh axes for a (data, replica) factorization. A pure-data
+    layout stays one-axis so it is mesh-identical to what a
+    fixed-topology run on that device count would build -- the parity
+    pin compares against exactly that."""
+    if replica == 1:
+        return {"data": data}
+    return {"data": data, "replica": replica}
+
+
+def legal_extents(n_devices: int, global_batch: int) -> List[int]:
+    """Data-axis extents legal on ``n_devices``: divisors of the
+    device count that also divide the global batch (every shard must
+    hold a whole number of items)."""
+    return [
+        d for d in range(1, n_devices + 1)
+        if n_devices % d == 0 and global_batch % d == 0
+    ]
+
+
+def _transition_wire_bytes(state: Any, mesh) -> int:
+    """Modeled wire bytes to land ``state`` replicated on ``mesh``
+    (the coordinator's replicated-param layout policy): the reshard
+    engine's exact per-device model, summed over leaves. Leaves
+    without a committed sharding (host scalars) cost their full
+    size per new device and are negligible either way."""
+    from tpu_hpc.reshard.plan import modeled_wire_bytes
+
+    tgt = NamedSharding(mesh, P())
+    wire = 0
+    for leaf in jax.tree.leaves(state):
+        src = getattr(leaf, "sharding", None)
+        shape = tuple(getattr(leaf, "shape", ()))
+        itemsize = getattr(
+            getattr(leaf, "dtype", None), "itemsize", 4
+        )
+        if src is None or not shape:
+            continue
+        wire += modeled_wire_bytes(shape, itemsize, src, tgt)
+    return wire
+
+
+def choose_layout(
+    devices: Sequence[Any],
+    *,
+    global_batch: int,
+    state: Any = None,
+    grad_payload_bytes: Optional[int] = None,
+    current_data_extent: Optional[int] = None,
+    preserve_data_extent: bool = True,
+    table_dir: Optional[str] = None,
+) -> LayoutDecision:
+    """The cheapest legal layout for ``devices``.
+
+    ``state``: the live state tree (its shardings feed the transition
+    wire-byte model; None skips the transition term -- initial
+    bring-up has nothing to move). ``grad_payload_bytes``: per-step
+    gradient bytes for the planner's steady-state term (default: the
+    state's param-leaf bytes when derivable, else 0).
+    ``current_data_extent`` + ``preserve_data_extent``: pin the data
+    axis for bit-exact continuity when the new device count allows
+    it.
+    """
+    from tpu_hpc.comm.planner import Planner, tier_cost
+    from tpu_hpc.runtime import MeshSpec, build_mesh
+
+    n = len(devices)
+    if n < 1:
+        raise ValueError("choose_layout needs a non-empty device set")
+    extents = legal_extents(n, global_batch)
+    if not extents:
+        raise ValueError(
+            f"no legal data extent: {n} devices, global batch "
+            f"{global_batch} -- no divisor of the device count "
+            "divides the batch"
+        )
+    preserved = False
+    if (
+        preserve_data_extent
+        and current_data_extent is not None
+        and current_data_extent in extents
+    ):
+        extents = [current_data_extent]
+        preserved = True
+    planner = Planner.for_devices(list(devices), table_dir=table_dir)
+    payload = grad_payload_bytes
+    if payload is None:
+        params = getattr(state, "params", None)
+        payload = sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree.leaves(params)
+        ) if params is not None else 0
+    tier = "dcn" if planner.fingerprint.n_slices > 1 else "ici"
+    scored = []
+    for d in extents:
+        r = n // d
+        axes = _axes_for(d, r)
+        wire = 0
+        if state is not None:
+            mesh = build_mesh(
+                MeshSpec(axes=dict(axes)), devices=list(devices)
+            )
+            wire = _transition_wire_bytes(state, mesh)
+        transition_s = tier_cost(tier, wire) if wire else 0.0
+        comm_s, source = (0.0, "model")
+        if payload:
+            comm_s, source = planner.cost("all_reduce", payload)
+        compute_s = STEP_ITEM_COST_S * global_batch / d
+        step_s = compute_s + comm_s
+        scored.append({
+            "axes": axes,
+            "data": d,
+            "replica": r,
+            "transition_wire_bytes": int(wire),
+            "predicted_transition_s": round(transition_s, 6),
+            "predicted_step_s": round(step_s, 6),
+            "score": transition_s + HORIZON_STEPS * step_s,
+            "source": source,
+        })
+    scored.sort(key=lambda c: (c["score"], -c["data"]))
+    best = scored[0]
+    return LayoutDecision(
+        axes=best["axes"],
+        n_devices=n,
+        data_extent=best["data"],
+        preserved_data_extent=preserved,
+        transition_wire_bytes=best["transition_wire_bytes"],
+        predicted_transition_s=best["predicted_transition_s"],
+        predicted_step_s=best["predicted_step_s"],
+        source=best["source"],
+        fingerprint=planner.fingerprint.digest,
+        candidates=[
+            {k: v for k, v in c.items() if k != "score"}
+            for c in scored
+        ],
+    )

@@ -108,6 +108,15 @@ _LOWER_IS_BETTER = (
     # counter; "bubble" covers bubble_fraction, "mttr" covers
     # recovery_mttr_s.)
     "stage_down", "bubble", "mttr",
+    # Topology morphing (tpu_hpc.elastic): more morphs at the same
+    # chaos schedule, more wire bytes per transition, or a longer
+    # quiesce-to-resume stall is the regression -- the --bank gate
+    # fails on elastic drift like it does on pipeline/fleet drift.
+    # ("wire_bytes" above already covers elastic.wire_bytes and the
+    # morph_wire_bytes side key; "stall" covers elastic.stall_s;
+    # "morph" covers the morph counters and the elastic_morph_*
+    # headline rows.)
+    "morph",
 )
 
 
@@ -207,6 +216,15 @@ def report_metrics(rep: dict) -> Dict[str, float]:
             flat["pipeline.recovery_mttr_s"] = float(
                 pl["recovery_mttr_s"]
             )
+    el = rep.get("elastic")
+    if el:
+        # The judged elastic signals: morph count, total wire bytes
+        # moved and total quiesce-to-resume stall (all lower-is-better
+        # via the morph/wire_bytes/stall tokens). The per-morph
+        # timeline is identity detail the totals already cover.
+        flat["elastic.morphs"] = float(el["morphs"])
+        flat["elastic.wire_bytes"] = float(el["wire_bytes"])
+        flat["elastic.stall_s"] = float(el["stall_s"])
     g = rep.get("guard")
     if g:
         flat["guard.poisoned"] = float(g["poisoned"])
@@ -262,6 +280,13 @@ _BANKED_SIDE_KEYS = (
     # ANALYTIC bubble_fraction; it is schedule-determined and
     # constant at equal config, so judging it is a no-op there.)
     "bubble_fraction", "recovery_mttr_s",
+    # Elastic rows (bench.py --workload elastic): the morph count and
+    # total transition wire bytes ride next to the stall-seconds
+    # headline (all lower-is-better via the "morph"/"wire_bytes"
+    # tokens) -- a layout-policy change that starts moving more bytes
+    # per transition fails --bank even while the stall headline still
+    # rides within tolerance.
+    "morphs", "morph_wire_bytes",
 )
 
 

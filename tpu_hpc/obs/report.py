@@ -373,6 +373,44 @@ def _pipeline(records: Sequence[dict]) -> Optional[dict]:
     }
 
 
+def _elastic(records: Sequence[dict]) -> Optional[dict]:
+    """Topology-morph breakdown (tpu_hpc.elastic): the per-morph
+    timeline plus the totals the regress gate's ``elastic.*``
+    namespace judges -- morph count, wire bytes moved, quiesce-to-
+    resume stall. MPMD stage-slice remaps (budget-free recoveries)
+    ride along."""
+    morphs = [
+        r for r in records if r.get("event") == "topology_morph"
+    ]
+    remaps = [r for r in records if r.get("event") == "stage_remap"]
+    if not morphs and not remaps:
+        return None
+    return {
+        "morphs": len(morphs),
+        "wire_bytes": sum(
+            int(r.get("wire_bytes", 0)) for r in morphs
+        ),
+        "stall_s": round(
+            sum(float(r.get("stall_s", 0.0)) for r in morphs), 6
+        ),
+        "stage_remaps": len(remaps),
+        "timeline": [
+            {
+                "step": r["step"],
+                "reason": r.get("reason"),
+                "src_mesh": r["src_mesh"],
+                "tgt_mesh": r["tgt_mesh"],
+                "wire_bytes": r["wire_bytes"],
+                "stall_s": r["stall_s"],
+                "preserved_data_extent": r.get(
+                    "preserved_data_extent"
+                ),
+            }
+            for r in morphs
+        ],
+    }
+
+
 def _guard(records: Sequence[dict]) -> Optional[dict]:
     """Numeric-health guard breakdown: verdict counts, skip count,
     and the rollback timeline with its goodput cost (steps re-trained
@@ -501,6 +539,7 @@ def build_report(
         "loadgen": _loadgen(records),
         "fleet": _fleet(records),
         "pipeline": _pipeline(records),
+        "elastic": _elastic(records),
         "guard": _guard(records),
         "ckpt": _ckpt(records),
         "memory": _memory(records),
@@ -752,6 +791,34 @@ def format_report(rep: dict) -> str:
                 for e in pl["stages"][sid]
             )
             lines.append(f"- stage {sid} timeline: {steps}")
+    el = rep.get("elastic")
+    if el is not None:
+        lines += [
+            "",
+            "## Topology morphs",
+            "",
+            f"- {el['morphs']} live transition(s), "
+            f"{el['wire_bytes'] / 2**20:.2f} MiB over the wire, "
+            f"{el['stall_s']:.3f}s total stall -- zero process "
+            "restarts",
+        ]
+        for m in el["timeline"]:
+            lines.append(
+                f"- step {m['step']}: {m['src_mesh']} -> "
+                f"{m['tgt_mesh']} ({m['reason']}), "
+                f"{m['wire_bytes']} wire bytes in "
+                f"{m['stall_s']:.3f}s"
+                + (
+                    "" if m.get("preserved_data_extent")
+                    else " [data extent changed -- bit-exact "
+                    "continuity given up]"
+                )
+            )
+        if el["stage_remaps"]:
+            lines.append(
+                "- MPMD stage remaps (restart budget not burned): "
+                f"{el['stage_remaps']}"
+            )
     fl = rep.get("fleet")
     if fl is not None:
         lines += [

@@ -65,6 +65,8 @@ SPANS: Dict[str, str] = {
     "decode": "one batched decode step (all slots)",
     "elastic_reshard": "cross-topology restore reshard",
     "kv_transfer": "disagg prefill->decode KV hop",
+    "morph": "live topology transition: quiesce -> reshard -> "
+             "rebuild -> resume (tpu_hpc.elastic)",
     "prefill": "one prompt prefill forward (slab whole-prompt or one "
                "paged chunk)",
     "prefill_chunk": "scheduler-level per-request prefill advance "
@@ -226,6 +228,25 @@ EVENTS: Dict[str, EventSpec] = {
         ("from_step", "src_mesh", "tgt_mesh"),
         optional=("plan", "device_count"),
     ),
+    # -- live topology morph (tpu_hpc.elastic coordinator): one record
+    #    per completed in-place transition -- no process exited, no
+    #    checkpoint was read; the report's elastic section and the
+    #    regress gate's elastic.* namespace read exactly this --
+    "topology_morph": EventSpec(
+        ("step", "src_mesh", "tgt_mesh", "wire_bytes", "stall_s"),
+        optional=(
+            "reason", "plan", "n_devices_from", "n_devices_to",
+            "morph_seq", "preserved_data_extent", "compiled_programs",
+            "predicted_cost_s",
+        ),
+    ),
+    # One MPMD stage remapped onto a surviving device after its slice
+    # was reclaimed (parallel/mpmd.py): the restart budget is NOT
+    # charged -- the device went away, the stage did nothing wrong.
+    "stage_remap": EventSpec(
+        ("stage", "reason"),
+        optional=("from_device", "to_device", "restore_step"),
+    ),
     # -- numeric-health guard (resilience/guard.py via the Trainer):
     #    one verdict per anomalous step, one rollback record per
     #    rollback-to-last-good -- the report's guard section and the
@@ -338,6 +359,12 @@ EVENTS: Dict[str, EventSpec] = {
     ),
     "giving_up": EventSpec(("attempt", "rc", "why")),
     "heartbeat_stall": EventSpec(("attempt", "timeout_s")),
+    # Morph-channel accounting (supervisor): how many live topology
+    # transitions the attempt completed -- with, by contract, ZERO
+    # restart/preemption/rollback budget burned (nothing exited).
+    "morphs_complete": EventSpec(
+        ("attempt", "count"), optional=("budget_burned",),
+    ),
 }
 
 

@@ -737,6 +737,12 @@ class MpmdPipeline:
         self.clock_s = 0.0
         self.wire_bytes = 0
         self.redispatched = 0
+        # Home devices: where each stage lives when its slice is
+        # healthy. Slice chaos (slice_down_at_step) moves the last
+        # stage OFF its home onto a surviving device; slice_up moves
+        # it back. self.devices is the live placement.
+        self._home_devices = list(self.devices)
+        self.remaps: List[dict] = []
         self.recoveries: List[dict] = []
         self.poisoned_windows: List[dict] = []
         self.bubble_fractions: List[float] = []
@@ -812,6 +818,25 @@ class MpmdPipeline:
                     f"{S} stages -- a stage fault naming a stage "
                     "that does not exist would pass vacuously"
                 )
+        # Slice-scoped chaos (the elastic fault family): the LAST
+        # stage's slice goes away and the stage must remap onto a
+        # surviving device without burning the restart budget.
+        if plan.slice_fault_keys():
+            if S < 2:
+                raise ValueError(
+                    "slice faults need a >=2-stage pipeline -- a "
+                    "1-stage run has no surviving stage device to "
+                    "remap onto, so the injection would pass "
+                    "vacuously"
+                )
+            down, up = plan.slice_down_at_step, plan.slice_up_at_step
+            if up is not None and (down is None or down >= up):
+                raise ValueError(
+                    f"slice_up_at_step={up} without an earlier "
+                    "slice_down_at_step: the stage is still on its "
+                    "home device, so the restore would inject "
+                    "nothing -- refusing a vacuous chaos schedule"
+                )
 
     def _arm_straggler(self) -> None:
         plan = self.fault_plan
@@ -859,6 +884,73 @@ class MpmdPipeline:
         plan._announce("stage_nan", step, dump=False)
         return True
 
+    # -- slice loss: remap, don't restart ------------------------------
+    def _maybe_remap_slice(self, step: int) -> None:
+        """Consume the slice fault family at a step boundary. Losing
+        a stage's slice is a TOPOLOGY event, not a stage failure: the
+        stage remaps onto a surviving device and replays nothing (its
+        step-boundary snapshot IS the current state), so the stage
+        restart budget is untouched -- the supervisor's budgets exist
+        for crashes, and a planned slice change is not one."""
+        plan = self.fault_plan
+        if plan is None or not plan.active:
+            return
+        sid = self.bundle.n_stages - 1
+        down = plan.slice_down_at_step
+        if (
+            down is not None
+            and "slice_down" not in plan._announced
+            and step >= down
+        ):
+            plan._announce("slice_down", step, dump=False)
+            self._remap_stage(
+                sid, self._home_devices[0], "slice-lost", step
+            )
+        up = plan.slice_up_at_step
+        if (
+            up is not None
+            and "slice_up" not in plan._announced
+            and step >= up
+        ):
+            plan._announce("slice_up", step, dump=False)
+            self._remap_stage(
+                sid, self._home_devices[sid], "slice-restored", step
+            )
+
+    def _remap_stage(
+        self, sid: int, device: Any, reason: str, step: int
+    ) -> None:
+        """Rebuild one stage on a DIFFERENT device from its snapshot.
+        Same mechanics as _recover's rebuild -- warmup + load_state --
+        minus the two things that make recovery a budgeted event:
+        no ``supervisor.charge``, no microbatch replay (remaps land
+        at step boundaries, where the snapshot is the live state)."""
+        from_dev = str(self.devices[sid])
+        self.devices[sid] = device
+        new = self._new_worker(sid)
+        new.warmup()
+        new.load_state(self.snapshots[sid])
+        if self.fault_plan is not None:
+            armed = self.fault_plan.stage_straggler
+            if armed is not None and armed[0] == sid:
+                new.cost_factor = armed[1]
+        t_down = self.clock_s = max(
+            self.clock_s, self.workers[sid].beat
+        )
+        t_up = t_down + RESTART_COST_S
+        new.avail = new.beat = t_up
+        self.clock_s = t_up
+        self.workers[sid] = new
+        self.remaps.append({
+            "stage": sid, "reason": reason, "step": step,
+            "from_device": from_dev, "to_device": str(device),
+        })
+        self._emit(
+            "stage_remap", stage=sid, reason=reason, step=step,
+            from_device=from_dev, to_device=str(device),
+            restore_step=self.snapshots[sid]["step"],
+        )
+
     # -- one training step --------------------------------------------
     def run_step(
         self, step: int, tokens: Any, targets: Any,
@@ -869,6 +961,7 @@ class MpmdPipeline:
         step-boundary snapshots. Recovers stage-locally on any stage
         failure and replays until the step completes clean; returns
         the per-microbatch loss values."""
+        self._maybe_remap_slice(step)
         while True:
             try:
                 out = self._attempt_step(
@@ -1185,6 +1278,20 @@ class MpmdPipeline:
         per-stage budgets used, wire bytes, compile counts)."""
         for step, (tokens, targets) in enumerate(batches):
             self.losses.append(self.run_step(step, tokens, targets))
+        plan = self.fault_plan
+        if plan is not None and plan.active:
+            leftover = [
+                k for k in ("slice_down", "slice_up")
+                if getattr(plan, f"{k}_at_step") is not None
+                and k not in plan._announced
+            ]
+            if leftover:
+                raise RuntimeError(
+                    f"TPU_HPC_FAULTS armed slice fault(s) "
+                    f"{', '.join(leftover)} that never fired -- the "
+                    "run ended before their step; refusing to let a "
+                    "chaos schedule pass vacuously"
+                )
         mttrs = [r["mttr_s"] for r in self.recoveries]
         return {
             "steps": len(self.losses),
@@ -1200,6 +1307,7 @@ class MpmdPipeline:
             "stage_restarts": dict(self.supervisor.restarts),
             "stage_rollbacks": dict(self.supervisor.rollbacks),
             "redispatched": self.redispatched,
+            "stage_remaps": list(self.remaps),
             "poisoned_windows": list(self.poisoned_windows),
             "stragglers": dict(self.straggler_flags),
             "wire_bytes": self.wire_bytes,

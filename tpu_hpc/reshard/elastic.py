@@ -117,6 +117,55 @@ def _sidecar_path(directory: str, step: int) -> str:
     return os.path.join(directory, SIDECAR_DIR, f"{int(step)}.json")
 
 
+def _history_path(directory: str) -> str:
+    # Not ``<step>.json``-shaped, so the per-step sidecar scan never
+    # mistakes it for a checkpoint record.
+    return os.path.join(directory, SIDECAR_DIR, "topology_history.json")
+
+
+def append_topology_history(
+    directory: str, step: int, topology: Optional[dict],
+    reason: str = "save",
+) -> None:
+    """Record that the run was on ``topology`` at ``step`` (host 0
+    only). The history file is the in-place morph audit: a run that
+    shrank and grew back writes one entry per transition (plus one per
+    save), so "what shape was the run in at step N" is answerable
+    after the fact without replaying the event log. Entries are
+    pruned WITH their checkpoint steps (:func:`prune_sidecars`) --
+    morph entries (``reason != "save"``) are dropped once they fall
+    before the oldest retained checkpoint (no retained step could
+    restore into a world where they matter)."""
+    if jax.process_index() != 0:
+        return
+    mesh = (topology or {}).get("mesh")
+    entry = {
+        "step": int(step),
+        "mesh": dict(mesh) if mesh else None,
+        "device_count": (topology or {}).get("device_count"),
+        "reason": str(reason),
+    }
+    path = _history_path(directory)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    history = read_topology_history(directory)
+    history.append(entry)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(history, f)
+    os.replace(tmp, path)
+
+
+def read_topology_history(directory: str) -> List[dict]:
+    """The topology-history entries, in append order (empty for
+    pre-history checkpoints)."""
+    try:
+        with open(_history_path(directory)) as f:
+            data = json.load(f)
+        return list(data) if isinstance(data, list) else []
+    except (OSError, ValueError):
+        return []
+
+
 def write_sidecar(
     directory: str, step: int, state: Any,
     extra: Optional[dict] = None,
@@ -141,6 +190,7 @@ def write_sidecar(
     with open(tmp, "w") as f:
         json.dump(topo, f)
     os.replace(tmp, path)
+    append_topology_history(directory, step, topo, reason="save")
     return path
 
 
@@ -178,19 +228,48 @@ def read_sidecar(directory: str, step: int) -> Optional[dict]:
 
 
 def prune_sidecars(directory: str, keep_steps) -> None:
-    """Drop sidecars whose checkpoint orbax has garbage-collected."""
+    """Drop sidecars whose checkpoint orbax has garbage-collected,
+    and prune the topology-history file to match: ``save`` entries
+    for GC'd steps go with their sidecars, morph entries older than
+    the oldest retained checkpoint go too (a morph-history file on a
+    long run would otherwise grow without bound)."""
     meta = os.path.join(directory, SIDECAR_DIR)
     try:
         names = os.listdir(meta)
     except OSError:
         return
-    keep = {f"{int(s)}.json" for s in keep_steps}
+    steps = {int(s) for s in keep_steps}
+    keep = {f"{s}.json" for s in steps}
+    history = os.path.basename(_history_path(directory))
     for name in names:
+        if name == history:
+            continue
         if name.endswith(".json") and name not in keep:
             try:
                 os.remove(os.path.join(meta, name))
             except OSError:
                 pass
+    old = read_topology_history(directory)
+    if not old:
+        return
+    floor = min(steps) if steps else 0
+    kept = [
+        e for e in old
+        if (
+            int(e.get("step", -1)) in steps
+            if e.get("reason") == "save"
+            else int(e.get("step", -1)) >= floor
+        )
+    ]
+    if kept != old:
+        path = _history_path(directory)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(kept, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
 
 
 def live_mesh_of(template: Any):

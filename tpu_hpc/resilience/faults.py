@@ -65,6 +65,24 @@ of passing a chaos test vacuously):
                          exercises cross-stage slow detection and the
                          bubble telemetry.
 
+Slice-scoped faults (consumed ONLY by topology-aware runtimes -- the
+elastic coordinator, ``tpu_hpc.elastic``, and the MPMD pipeline; a
+plain SPMD Trainer hard-rejects them at construction unless it is
+running UNDER the coordinator, so a slice fault on a run that cannot
+morph fails loudly instead of passing a chaos test vacuously):
+
+* ``slice_down_at_step=N``  a planned slice loss at the first
+                         progress point where ``step >= N``: the
+                         coordinator quiesces at the step boundary and
+                         morphs onto the surviving device set; the
+                         MPMD runtime remaps the lost stage onto
+                         surviving devices WITHOUT burning its restart
+                         budget.
+* ``slice_up_at_step=N``    the wave recedes: a slice returns at
+                         ``step >= N`` and the run grows back onto the
+                         full device set (same quiesce-morph-resume
+                         path, in reverse).
+
 ``on_attempt`` (default 0) scopes injection to one restart ordinal so
 a supervised run fails once and then completes -- the
 restart-with-resume round trip, deterministic end to end.
@@ -93,6 +111,8 @@ _INT_KEYS = (
     "nan_loss_at_step",
     "grad_spike_at_step",
     "straggler_at_step",
+    "slice_down_at_step",
+    "slice_up_at_step",
     "on_attempt",
 )
 
@@ -108,6 +128,14 @@ STAGE_FAULT_KEYS = (
     "stage_kill_at",
     "stage_nan_at",
     "stage_straggler",
+)
+
+# Slice-scoped fault keys: planned topology events only a
+# morph-capable runtime (tpu_hpc.elastic coordinator, MPMD pipeline)
+# can honor. Plain int steps, so _INT_KEYS carries the casts.
+SLICE_FAULT_KEYS = (
+    "slice_down_at_step",
+    "slice_up_at_step",
 )
 
 
@@ -151,6 +179,10 @@ class FaultPlan:
     stage_kill_at: Optional[tuple] = None     # (stage, step)
     stage_nan_at: Optional[tuple] = None      # (stage, step)
     stage_straggler: Optional[tuple] = None   # (stage, factor)
+    # Slice-scoped (morph-capable runtimes only -- see
+    # slice_fault_keys for the vacuous-pass guard contract).
+    slice_down_at_step: Optional[int] = None
+    slice_up_at_step: Optional[int] = None
     on_attempt: int = 0
     attempt: int = 0
     # Telemetry one-shot latch (mutable contents are legal on a
@@ -291,6 +323,18 @@ class FaultPlan:
         discipline, applied to training)."""
         return [
             k for k in STAGE_FAULT_KEYS
+            if getattr(self, k) is not None
+        ]
+
+    def slice_fault_keys(self) -> "list[str]":
+        """The armed slice-scoped fault keys. Same vacuous-pass
+        contract as :meth:`stage_fault_keys`: a runtime that cannot
+        morph its topology (a plain SPMD Trainer outside the elastic
+        coordinator) must hard-reject a plan where this is non-empty,
+        and a morph-capable runtime must hard-fail a run where an
+        armed slice fault never got the chance to fire."""
+        return [
+            k for k in SLICE_FAULT_KEYS
             if getattr(self, k) is not None
         ]
 

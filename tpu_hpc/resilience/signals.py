@@ -19,13 +19,24 @@ contract here:
 Anything else nonzero is an ordinary crash. Exit codes are the ONLY
 channel a dead process has, which is why they are pinned constants
 here rather than conventions scattered through launch scripts.
+
+Next to the exit-code contract lives the MORPH channel
+(``MorphChannel``): planned topology events -- "slice N goes away in
+90 s", "a slice came back" -- are requests to a LIVE process, not
+death notices, so they ride a file-based request/ack log instead of a
+signal. The elastic coordinator (tpu_hpc.elastic) drains it, quiesces
+at a step boundary, and morphs in place; a completed morph burns zero
+supervisor budget because no process ever exited.
 """
 from __future__ import annotations
 
+import dataclasses
+import json
+import os
 import signal
 import sys
 import threading
-from typing import Iterable, Optional, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 # sysexits.h EX_TEMPFAIL: a clean preemption snapshot; relaunch resumes.
 EXIT_RESUMABLE = 75
@@ -75,6 +86,120 @@ def exit_code_for(preempted: bool, rolled_back: bool = False) -> int:
 def resumable_exit() -> None:
     """Exit now under the resumable contract (snapshot already taken)."""
     sys.exit(EXIT_RESUMABLE)
+
+
+# Path of the morph request/ack log, exported by whoever schedules
+# topology events (supervisor, bench harness) to the process that can
+# honor them (the elastic coordinator).
+ENV_MORPH_CHANNEL = "TPU_HPC_MORPH_CHANNEL"
+
+# Exported by the elastic coordinator to the Trainers it manages:
+# "slice faults are MY job -- your vacuous-pass guard may stand down".
+# A Trainer constructed outside the coordinator still hard-rejects an
+# armed slice fault (faults.FaultPlan.slice_fault_keys contract).
+ENV_ELASTIC_MANAGED = "TPU_HPC_ELASTIC_MANAGED"
+
+
+@dataclasses.dataclass(frozen=True)
+class MorphRequest:
+    """One planned topology event on the morph channel.
+
+    ``kind``      "shrink" (a slice is being reclaimed) or "grow" (a
+                  slice came back).
+    ``n_devices`` the TARGET device count after the event -- the
+                  scheduler knows the allocation, the run does not.
+    ``step``      earliest step the transition may happen at (the
+                  coordinator quiesces at the first chunk boundary
+                  with ``step >= this``); 0 means "as soon as legal".
+    ``seq``       position in the channel file, assigned by post();
+                  acks join on it.
+    """
+
+    kind: str
+    n_devices: int
+    step: int = 0
+    seq: int = -1
+
+
+class MorphChannel:
+    """File-based request/ack log for planned topology events.
+
+    Append-only JSONL: requests are ``{"kind", "n_devices", "step"}``
+    rows, acks are ``{"ack": seq, ...}`` rows. Appends are O_APPEND
+    single-write atomic (same discipline as the heartbeat/supervisor
+    logs), so a scheduler posting while the coordinator drains never
+    tears a row. The file IS the audit trail: after the run, every
+    requested wave and every completed morph is one grep away.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["MorphChannel"]:
+        env = os.environ if env is None else env
+        path = env.get(ENV_MORPH_CHANNEL, "").strip()
+        return cls(path) if path else None
+
+    def _rows(self) -> List[dict]:
+        try:
+            with open(self.path) as f:
+                return [
+                    json.loads(line)
+                    for line in f if line.strip()
+                ]
+        except FileNotFoundError:
+            return []
+
+    def _append(self, row: dict) -> None:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    def post(self, kind: str, n_devices: int, step: int = 0) -> int:
+        """Schedule a topology event; returns its seq."""
+        if kind not in ("shrink", "grow"):
+            raise ValueError(
+                f"morph kind {kind!r} must be 'shrink' or 'grow'"
+            )
+        if n_devices < 1:
+            raise ValueError(
+                f"morph n_devices {n_devices} must be >= 1"
+            )
+        seq = sum(1 for r in self._rows() if "kind" in r)
+        self._append(
+            {"kind": kind, "n_devices": int(n_devices),
+             "step": int(step), "seq": seq}
+        )
+        return seq
+
+    def pending(self) -> List[MorphRequest]:
+        """Requests not yet acked, in post order."""
+        reqs, acked = [], set()
+        seq = 0
+        for row in self._rows():
+            if "ack" in row:
+                acked.add(int(row["ack"]))
+            elif "kind" in row:
+                reqs.append(MorphRequest(
+                    kind=row["kind"],
+                    n_devices=int(row["n_devices"]),
+                    step=int(row.get("step", 0)),
+                    seq=seq,
+                ))
+                seq += 1
+        return [r for r in reqs if r.seq not in acked]
+
+    def ack(self, seq: int, **info) -> None:
+        """Mark request ``seq`` completed; ``info`` (wire bytes, stall
+        seconds, target mesh) rides along for the audit trail."""
+        self._append({"ack": int(seq), **info})
+
+    def acked(self) -> List[dict]:
+        """The ack rows, in append order (supervisor accounting)."""
+        return [r for r in self._rows() if "ack" in r]
 
 
 class PreemptionGuard:

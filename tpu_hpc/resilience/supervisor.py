@@ -51,9 +51,11 @@ from tpu_hpc.obs.schema import stamp
 from tpu_hpc.resilience.heartbeat import ENV_ATTEMPT, ENV_HEARTBEAT
 from tpu_hpc.resilience.retry import backoff_delays
 from tpu_hpc.resilience.signals import (
+    ENV_MORPH_CHANNEL,
     EXIT_HANG,
     EXIT_RESUMABLE,
     EXIT_ROLLBACK,
+    MorphChannel,
     describe_exit,
 )
 
@@ -130,6 +132,24 @@ class Supervisor:
         self.run_id = os.environ.get(ENV_RUN_ID) or gen_run_id()
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
+        # Morph-request channel (resilience.signals.MorphChannel): the
+        # scheduler-facing sibling of the SIGTERM contract. SIGTERM
+        # says "this allocation is going away, snapshot and exit";
+        # a morph request says "the topology is CHANGING, transition
+        # live". The supervisor owns the channel file next to its logs
+        # and exports it to every child so an elastic-managed run
+        # (tpu_hpc.elastic.TopologyCoordinator) can consume requests
+        # without the supervisor's restart machinery in the loop. An
+        # operator-exported channel path is honored as-is.
+        self.morph_channel: Optional[MorphChannel] = None
+        chan_path = os.environ.get(ENV_MORPH_CHANNEL)
+        if chan_path:
+            self.morph_channel = MorphChannel(chan_path)
+        elif log_dir:
+            self.morph_channel = MorphChannel(
+                os.path.join(log_dir, "morph_channel.jsonl")
+            )
+        self._morphs_accounted = 0
 
     # -- event log ----------------------------------------------------
     def _event(self, **rec) -> None:
@@ -197,6 +217,11 @@ class Supervisor:
         # of WHY an attempt died belongs with that attempt's log.
         if self.log_dir and ENV_FLIGHT_DIR not in env:
             env[ENV_FLIGHT_DIR] = self.log_dir
+        if (
+            self.morph_channel is not None
+            and ENV_MORPH_CHANNEL not in env
+        ):
+            env[ENV_MORPH_CHANNEL] = self.morph_channel.path
         if self.heartbeat:
             env[ENV_HEARTBEAT] = self.heartbeat
             # Clear the previous attempt's heartbeat: a stale file
@@ -247,6 +272,30 @@ class Supervisor:
             if log_f:
                 log_f.close()
 
+    # -- morph accounting ---------------------------------------------
+    def _account_morphs(self, attempt: int) -> None:
+        """Book completed live topology morphs as ZERO budget burned.
+        A morph acked on the channel means the child transitioned
+        in-process -- no exit, no relaunch -- so by construction it
+        cannot have consumed the restart, preemption, or rollback
+        budgets. The ``morphs_complete`` event makes that accounting
+        auditable next to the attempt_* rows it would otherwise be
+        conflated with."""
+        if self.morph_channel is None:
+            return
+        try:
+            acked = self.morph_channel.acked()
+        except (OSError, ValueError):
+            return
+        fresh = len(acked) - self._morphs_accounted
+        if fresh <= 0:
+            return
+        self._morphs_accounted = len(acked)
+        self._event(
+            event="morphs_complete", attempt=attempt, count=fresh,
+            budget_burned=0,
+        )
+
     # -- the loop -----------------------------------------------------
     def run(self) -> int:
         old = {}
@@ -281,6 +330,7 @@ class Supervisor:
                     duration_s=round(time.monotonic() - t0, 3),
                     log=log_path,
                 )
+                self._account_morphs(attempt)
                 if rc == 0:
                     return 0
                 if self._stop_requested:

@@ -40,7 +40,10 @@ from tpu_hpc.resilience.heartbeat import (
     Heartbeat,
     current_attempt,
 )
-from tpu_hpc.resilience.signals import PreemptionGuard
+from tpu_hpc.resilience.signals import (
+    ENV_ELASTIC_MANAGED,
+    PreemptionGuard,
+)
 from tpu_hpc.train.metrics import GoodputMeter, ThroughputMeter
 
 
@@ -516,6 +519,23 @@ class Trainer:
                     "llama-pp --pp-runtime mpmd); refusing to run a "
                     "chaos schedule that cannot inject"
                 )
+            slice_keys = self.fault_plan.slice_fault_keys()
+            if slice_keys and os.environ.get(
+                ENV_ELASTIC_MANAGED
+            ) != "1":
+                # Same vacuous-pass contract for the slice-scoped
+                # kinds: a fixed-topology Trainer cannot morph, so a
+                # slice fault here would never fire. Under the elastic
+                # coordinator (which exports ENV_ELASTIC_MANAGED and
+                # consumes the fault itself) the guard stands down.
+                raise ValueError(
+                    f"TPU_HPC_FAULTS arms slice fault(s) "
+                    f"{', '.join(slice_keys)}, but this Trainer is "
+                    "not running under the elastic coordinator "
+                    "(tpu_hpc.elastic) -- a fixed-topology run "
+                    "cannot morph; refusing to run a chaos schedule "
+                    "that cannot inject"
+                )
         # Numeric-health guard (resilience.guard): None when
         # cfg.guard_mode == "off" -- the step program then stays
         # byte-identical to a pre-guard trainer (HLO no-creep pins).
@@ -811,7 +831,29 @@ class Trainer:
         # stops the run, BEFORE the emergency snapshot -- the hook for
         # recipe-level cleanup (flush custom logs, export metrics).
         self.on_preempt: Optional[Callable[[Any, int], None]] = None
+        # Elastic quiesce hook (tpu_hpc.elastic coordinator):
+        # callable(done_step) -> Optional[target_step], polled at
+        # every chunk boundary. A target caps the next chunk so the
+        # loop lands EXACTLY on it; reaching it stops fit() cleanly
+        # with result["quiesced"]=True -- state live, nothing saved,
+        # nothing exited -- so the coordinator can morph and resume.
+        self.quiesce_check: Optional[
+            Callable[[int], Optional[int]]
+        ] = None
+        self._adopted = False
+        self._quiesced = False
         self._watchdog: Optional[HangWatchdog] = None
+
+    def adopt_state(self, state: "TrainState") -> None:
+        """Adopt a LIVE state tree (the elastic coordinator's morph
+        path). The tree must already lie in this trainer's planned
+        shardings -- reshard onto ``self._state_shardings`` first.
+        An adopted trainer's fit() trusts the in-memory step over any
+        disk checkpoint: a morph never wrote a snapshot, so the newest
+        checkpoint predates the transition and resuming from it would
+        silently re-train the morphed span."""
+        self.state = state
+        self._adopted = True
 
     # -- the HOT LOOP body lives in make_step_fn (SURVEY 3.1/3.4);
     # self._step_impl is bound in __init__ --
@@ -1148,7 +1190,14 @@ class Trainer:
             self._skip_windows = guard_lib.load_state(
                 self._guard_dir()
             )["skip_windows"]
-        start_step = self.maybe_resume()
+        self._quiesced = False
+        if self._adopted:
+            # Live morphed state (adopt_state): the in-memory step IS
+            # the data-stream truth. Disk holds only pre-morph
+            # snapshots -- restoring one would rewind past the morph.
+            start_step = int(jax.device_get(self.state.step))
+        else:
+            start_step = self.maybe_resume()
         # Preemption safety: TPU-VM spot/maintenance events deliver
         # SIGTERM with a short grace window. Snapshot-then-exit is the
         # recovery model (the reference's PBS-resubmission + snapshot
@@ -1287,6 +1336,7 @@ class Trainer:
             else None,
             "preempted": preempted,
             "rolled_back": self._rolled_back,
+            "quiesced": self._quiesced,
             "goodput": goodput,
         }
 
@@ -1300,9 +1350,22 @@ class Trainer:
         while done < total_steps:
             if self._watchdog is not None:
                 self._watchdog.tick()
+            # Elastic quiesce: the coordinator's hook names the step
+            # boundary it wants the run stopped at. Reaching it stops
+            # the loop with everything live (no save, no exit); a
+            # future target caps the chunk so the loop lands exactly
+            # on it instead of overshooting into the next epoch.
+            quiesce_at = None
+            if self.quiesce_check is not None:
+                quiesce_at = self.quiesce_check(done)
+                if quiesce_at is not None and quiesce_at <= done:
+                    self._quiesced = True
+                    break
             epoch = done // steps_per_epoch
             chunk = min(steps_per_epoch - done % steps_per_epoch,
                         total_steps - done)
+            if quiesce_at is not None:
+                chunk = min(chunk, quiesce_at - done)
             # Guard skip windows: the data offset is constant within
             # one dispatched chunk (it rides in as one traced scalar),
             # so a chunk must never span a window boundary -- cap it
