@@ -276,12 +276,13 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
     def fake_bench_serve(requests, slots, max_new, disagg=False,
                          paged=False, block_size=None, kv_blocks=None,
                          prefill_chunk=None, spec="off", spec_k=None,
-                         draft_ckpt=None):
+                         draft_ckpt=None, host_blocks=None):
         seen.update(requests=requests, slots=slots, max_new=max_new,
                     disagg=disagg, paged=paged,
                     block_size=block_size, kv_blocks=kv_blocks,
                     prefill_chunk=prefill_chunk, spec=spec,
-                    spec_k=spec_k, draft_ckpt=draft_ckpt)
+                    spec_k=spec_k, draft_ckpt=draft_ckpt,
+                    host_blocks=host_blocks)
         return {"metric": "serve_tokens_per_s_per_chip", "value": 1,
                 "unit": "tokens/s/chip", "vs_baseline": None}
 
@@ -296,7 +297,8 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
                     "disagg": False, "paged": False,
                     "block_size": None, "kv_blocks": None,
                     "prefill_chunk": None, "spec": "off",
-                    "spec_k": None, "draft_ckpt": None}
+                    "spec_k": None, "draft_ckpt": None,
+                    "host_blocks": None}
     seen.clear()
     assert bench.main(["--workload", "serve"]) == 0
     assert seen["requests"] == 32 and seen["slots"] == 8
@@ -318,6 +320,12 @@ def test_serve_mode_routes_flags(bench, monkeypatch):
         "--serve-spec", "ngram", "--spec-k", "3",
     ]) == 0
     assert seen["spec"] == "ngram" and seen["spec_k"] == 3
+    seen.clear()
+    assert bench.main([
+        "--workload", "serve", "--serve-paged",
+        "--serve-host-blocks", "4096",
+    ]) == 0
+    assert seen["paged"] is True and seen["host_blocks"] == 4096
 
 
 def test_serve_alias_conflicts_with_explicit_workload(bench, monkeypatch):
@@ -338,9 +346,10 @@ def test_loadgen_mode_routes_flags(bench, monkeypatch):
                            model="bench", spec="off", spec_k=None,
                            draft_ckpt=None, fleet=0, fleet_min=1,
                            fleet_swap_at=None,
-                           fleet_router="affinity"):
+                           fleet_router="affinity", host_blocks=None):
         seen.update(scenario=scenario, requests=requests, slots=slots,
-                    max_new=max_new, paged=paged, spec=spec)
+                    max_new=max_new, paged=paged, spec=spec,
+                    host_blocks=host_blocks)
         return {"metric": "loadgen_x_ttft_ms_p95", "value": 1.0,
                 "unit": "virtual_ms", "vs_baseline": None}
 
@@ -353,7 +362,8 @@ def test_loadgen_mode_routes_flags(bench, monkeypatch):
     ])
     assert rc == 0
     assert seen == {"scenario": "bursty", "requests": 32, "slots": 4,
-                    "max_new": 16, "paged": False, "spec": "off"}
+                    "max_new": 16, "paged": False, "spec": "off",
+                    "host_blocks": None}
     seen.clear()
     assert bench.main([
         "--workload", "loadgen", "--loadgen-scenario",
@@ -361,6 +371,14 @@ def test_loadgen_mode_routes_flags(bench, monkeypatch):
     ]) == 0
     assert seen["scenario"] == "shared_prefix"
     assert seen["paged"] is True
+    seen.clear()
+    assert bench.main([
+        "--workload", "loadgen", "--loadgen-scenario",
+        "long_idle_sessions", "--serve-paged",
+        "--serve-host-blocks", "512",
+    ]) == 0
+    assert seen["scenario"] == "long_idle_sessions"
+    assert seen["host_blocks"] == 512
     # Misplaced scenario flag = CLI error (the --comm-mode
     # discipline), never a silently-plain run recorded as the
     # scenario.
@@ -381,10 +399,16 @@ def test_paged_flags_guarded_like_comm_mode(bench, monkeypatch):
     for flag, val in (
         ("--serve-block-size", "16"),
         ("--serve-kv-blocks", "64"),
+        ("--serve-host-blocks", "4096"),
         ("--serve-prefill-chunk", "128"),
     ):
         with pytest.raises(SystemExit):
             bench.main(["--workload", "serve", flag, val])
+    # A 1-slot host tier could never hold a page (slot 0 is scratch):
+    # a parse error, not a row labeled tiered that never spilled.
+    with pytest.raises(SystemExit):
+        bench.main(["--workload", "serve", "--serve-paged",
+                    "--serve-host-blocks", "1"])
     # The tiny dev model is only legal where quantiles are
     # virtual-clock (loadgen); a wall-clock serve row on it would
     # wear the bench label while measuring a different machine.

@@ -515,3 +515,72 @@ class TestSpecDraftTerm:
                 seq_len=4096, do_compile=False,
                 draft_cfg=full_7b.cfg,
             )
+
+
+class TestHostTierTerm:
+    """The host-DRAM KV page-tier budget (serve/tier.py via
+    --kv-host-tier): host bytes are DRAM, never HBM -- they must be
+    reported for sizing without moving the fits verdict, and the
+    markdown must carry the resident-sessions multiplier the tier
+    exists to buy."""
+
+    @pytest.fixture(scope="class")
+    def with_tier(self, full_7b):
+        return fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False,
+            kv_blocks=1024, kv_block_size=16, kv_host_blocks=9216,
+        )
+
+    def test_host_bytes_never_in_hbm_total(self, full_7b, with_tier):
+        base = fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False,
+            kv_blocks=1024, kv_block_size=16,
+        )
+        # Full-width per host (device_get assembles the sharded rows
+        # before the numpy store): no tp/dp division.
+        assert with_tier.kv_host_bytes == \
+            fit.kv_paged_bytes(full_7b.cfg, 9216, 16)
+        # DRAM, not HBM: the total and the verdict must not move.
+        assert with_tier.total_bytes == base.total_bytes
+        assert with_tier.fits == base.fits
+        d = with_tier.to_json()
+        assert d["kv_host_blocks"] == 9216
+        assert d["kv_host_bytes"] == with_tier.kv_host_bytes
+
+    def test_markdown_resident_sessions_multiplier(self, with_tier):
+        md = fit.to_markdown(with_tier)
+        assert "Host KV tier (serve/tier.py)" in md
+        assert "NOT in the HBM total" in md
+        # 1023 device pages + 9215 host pages over 1023: the ~10x
+        # headline resident-sessions claim, computed not asserted by
+        # hand-wave.
+        assert "**10.0x the resident sessions**" in md
+
+    def test_tier_requires_paged_pool(self, full_7b):
+        with pytest.raises(ValueError, match="kv_blocks"):
+            fit.analyze(
+                cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+                seq_len=4096, do_compile=False, kv_host_blocks=64,
+            )
+
+    def test_cli_requires_kv_blocks(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            fit.main([
+                "--no-compile", "--kv-host-tier", "64", "--json",
+            ])
+        assert e.value.code == 2
+        assert "--kv-blocks" in capsys.readouterr().err
+
+    def test_cli_flag_reaches_analyze(self, capsys):
+        rc = fit.main([
+            "--no-compile", "--kv-blocks", "1024",
+            "--kv-host-tier", "9216", "--json",
+        ])
+        import json as _json
+
+        out = _json.loads(capsys.readouterr().out)
+        assert out["kv_host_blocks"] == 9216
+        assert out["kv_host_bytes"] > 0
+        assert rc in (0, 1)

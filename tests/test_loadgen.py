@@ -742,3 +742,89 @@ class TestLongMixes:
         )
         assert sa["ttft_ms_p99"] == sb["ttft_ms_p99"]
         assert sa["decode_steps"] == sb["decode_steps"]
+
+
+# ---------------------------------------------------------------------
+# the host-DRAM KV tier under the return wave (serve/tier.py,
+# full-suite tier only -- the fast tier representatives live in
+# test_tier.py)
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+class TestTierShedContrast:
+    """End-to-end acceptance for the host tier: the same seeded
+    ``long_idle_sessions`` schedule against an HBM-only pool and an
+    identical pool plus host slots. The HBM-only run evicts the parked
+    first-visit pages to seat the filler wave, re-prefills the return
+    wave from scratch, drains too slowly, and sheds part of it; the
+    tiered run spilled those pages instead, prefix-hits after the
+    refill hop, and sheds nothing -- zero steady-state recompiles on
+    both sides. (The bench-scale pair of this contrast is banked in
+    BENCH_HISTORY.jsonl.)"""
+
+    def _engine(self, host_blocks):
+        from tpu_hpc.serve import PagedConfig, PagedEngine
+
+        mesh = build_mesh(MeshSpec(axes={"data": 4, "model": 2}))
+        params = llama2.init_llama(jax.random.key(0), TINY)
+        engine = PagedEngine(
+            params, TINY,
+            ServeConfig(slots=4, max_seq_len=48,
+                        prefill_buckets=(8, 16)),
+            mesh,
+            # 20 usable pages: the filler wave cannot seat without
+            # reclaiming the chat wave's parked prefix pages.
+            PagedConfig(block_size=4, num_blocks=21, prefill_chunk=8,
+                        host_blocks=host_blocks),
+        )
+        engine.warmup()
+        return engine
+
+    def _drive(self, engine, path):
+        # rate 15/s puts the 3x return wave above the HBM-only drain
+        # rate (full re-prefill at 8 virtual-ms/token) but below the
+        # tiered one (prefix hit + 0.5 ms/page refill hop) -- the
+        # regime where ONLY the reclamation policy decides the shed.
+        sc = build_scenario(
+            "long_idle_sessions", seed=7, n_requests=48,
+            vocab_size=TINY.vocab_size, max_prompt=MAX_PROMPT,
+            max_new=MAX_NEW, rate_per_s=15.0,
+        )
+        harness = LoadHarness(
+            engine, sc, metrics_path=str(path),
+            prefill_ms_per_token=8.0,
+        )
+        return harness.run(n_devices=jax.device_count())
+
+    def test_return_wave_sheds_only_without_the_tier(
+        self, scoped_obs, tmp_path,
+    ):
+        hbm = self._engine(0)
+        warmed_hbm = hbm.compile_count
+        sh = self._drive(hbm, tmp_path / "hbm.jsonl")
+
+        tiered = self._engine(129)
+        warmed_tier = tiered.compile_count
+        st = self._drive(tiered, tmp_path / "tier.jsonl")
+
+        # The contrast: identical HBM budget, identical schedule --
+        # only the reclamation policy differs, and only the HBM-only
+        # run sheds returning users.
+        assert sh["tenants"]["return"]["shed"] > 0
+        assert st["tenants"]["return"]["shed"] == 0
+        assert st["tenants"]["filler"]["shed"] == 0
+        assert st["tenants"]["chat"]["shed"] == 0
+        # Mechanism, not luck: the HBM-only pool churned through
+        # evictions and never hit; the tiered pool spilled the parked
+        # chains, refilled them on the return wave, and resolved
+        # return prompts from the trie.
+        assert hbm.paged_stats["prefix_hits"] == 0
+        assert st["prefix_hit_rate"] > 0
+        assert tiered.host_tier.stats["kv_spill_pages"] > 0
+        assert tiered.host_tier.stats["kv_refill_pages"] > 0
+        assert (
+            tiered.paged_stats["trie_evictions"]
+            < hbm.paged_stats["trie_evictions"]
+        )
+        # Zero steady-state recompiles on both sides of the contrast.
+        assert hbm.compile_count == warmed_hbm
+        assert tiered.compile_count == warmed_tier

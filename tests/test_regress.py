@@ -73,6 +73,21 @@ class TestCompare:
         assert lower_is_better("elastic_morph_stall_s.morph_wire_bytes")
         assert lower_is_better("elastic.wire_bytes")
         assert lower_is_better("elastic.stall_s")
+        # Host KV tier (serve/tier.py): pages thrashing across the
+        # HBM/DRAM boundary, wire volume over the hop, and the
+        # returning tenant's latency/shed all regress UPWARD;
+        # resident_sessions (like prefix_hit_rate) regresses by
+        # DROPPING -- higher-is-better by deliberate token absence.
+        assert lower_is_better("serve.kv_spill_wire_bytes")
+        assert lower_is_better("serve.kv_refill_wire_bytes")
+        assert lower_is_better("serve.kv_hop_ms_p95")
+        tiered = "loadgen_long_idle_sessions_paged_tiered_ttft_ms_p95"
+        assert lower_is_better(tiered)
+        assert lower_is_better(f"{tiered}.ttft_on_return_ms_p95")
+        assert lower_is_better(f"{tiered}.shed_on_return")
+        assert lower_is_better(f"{tiered}.kv_spill_wire_bytes")
+        assert lower_is_better(f"{tiered}.kv_refill_wire_bytes")
+        assert not lower_is_better(f"{tiered}.resident_sessions")
 
     def test_spec_config_fields_not_compared(self):
         """spec_k is config; drafted/accepted/rejected/verify_steps
@@ -114,6 +129,32 @@ class TestCompare:
         assert flat == {
             "serve.prefix_hit_rate": 0.5,
             "serve.block_stalls": 2.0,
+        }
+
+    def test_tier_config_fields_not_compared(self):
+        """kv_host_blocks/inflight are tier CONFIG, used/free follow
+        it, and the spill/refill EVENT counts scale with workload --
+        the gate judges the wire bytes and the hop quantiles only."""
+        from tpu_hpc.obs.regress import report_metrics
+
+        flat = report_metrics({
+            "serve": {
+                "kv_host_blocks": 64, "kv_host_used": 10,
+                "kv_host_free": 53, "kv_host_drops": 1,
+                "kv_host_inflight_bytes": 1 << 20,
+                "kv_spills": 3, "kv_spill_pages": 12,
+                "kv_refills": 2, "kv_refill_pages": 8,
+                "kv_spill_wire_bytes": 4096.0,
+                "kv_refill_wire_bytes": 2048.0,
+                "kv_hop_ms_p50": 0.4, "kv_hop_ms_p95": 0.9,
+                "requests": 8,
+            },
+        })
+        assert flat == {
+            "serve.kv_spill_wire_bytes": 4096.0,
+            "serve.kv_refill_wire_bytes": 2048.0,
+            "serve.kv_hop_ms_p50": 0.4,
+            "serve.kv_hop_ms_p95": 0.9,
         }
 
     def test_identical_passes(self):
@@ -383,6 +424,47 @@ class TestBank:
         violations, _ = compare(base, bank_metrics([row(0.5)]))
         assert [v["metric"] for v in violations] == [key]
         assert compare(base, bank_metrics([row(0.95)]))[0] == []
+
+    def test_bank_lifts_tier_side_keys(self):
+        """The host-tier row's mechanism metrics are banked side
+        keys: TTFT-on-return and shed_on_return (lower), spill/refill
+        wire bytes (lower), resident_sessions (higher) ride the
+        --bank gate next to the tiered latency headline -- a tier
+        that starts shedding returns or thrashing pages fails even
+        while p95 TTFT holds."""
+        from tpu_hpc.obs.regress import bank_metrics, compare
+
+        name = "loadgen_long_idle_sessions_paged_tiered_ttft_ms_p95"
+
+        def row(ret_p95=40.0, shed=0, resident=20, spill=4096.0):
+            return {
+                "event": "bench", "metric": name, "value": 100.0,
+                "ttft_on_return_ms_p50": 20.0,
+                "ttft_on_return_ms_p95": ret_p95,
+                "shed_on_return": shed,
+                "resident_sessions": resident,
+                "kv_spill_wire_bytes": spill,
+                "kv_refill_wire_bytes": spill / 2,
+            }
+
+        base = bank_metrics([row()])
+        for key in (
+            "ttft_on_return_ms_p50", "ttft_on_return_ms_p95",
+            "shed_on_return", "resident_sessions",
+            "kv_spill_wire_bytes", "kv_refill_wire_bytes",
+        ):
+            assert f"{name}.{key}" in base, key
+        assert compare(base, bank_metrics([row()]))[0] == []
+        for bad, key in (
+            (row(ret_p95=80.0), "ttft_on_return_ms_p95"),
+            (row(shed=5), "shed_on_return"),
+            (row(resident=2), "resident_sessions"),
+            (row(spill=40960.0), "kv_spill_wire_bytes"),
+        ):
+            violations, _ = compare(base, bank_metrics([bad]))
+            assert f"{name}.{key}" in [
+                v["metric"] for v in violations
+            ], key
 
     def test_bank_metrics_keep_high_water_mark(self):
         records = [

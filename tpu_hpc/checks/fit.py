@@ -153,6 +153,11 @@ class FitResult:
     kv_block_bytes: int = 0      # per chip, PAGED decode KV pool
     kv_blocks: int = 0           # physical pages the paged term assumes
     kv_block_size: int = 0       # tokens per page
+    # Host-DRAM KV page tier (serve/tier.py): parked prefixes spill
+    # into host buffers, so this term is DRAM, not HBM -- reported
+    # for sizing but never part of total_bytes or the fits verdict.
+    kv_host_blocks: int = 0      # host tier slots incl. scratch
+    kv_host_bytes: int = 0       # per host, full-width K+V buffers
     # Speculative-decode draft model (serve/spec.py): its params live
     # on the same chips and its KV pool mirrors the target's pages --
     # a draft that does not fit must fail THIS report, not OOM at
@@ -552,6 +557,7 @@ def analyze(
     kv_cache_dtype: str = "bfloat16",
     kv_blocks: int = 0,
     kv_block_size: int = 16,
+    kv_host_blocks: int = 0,
     draft_cfg: Optional[llama2.LlamaConfig] = None,
 ) -> FitResult:
     """Shard/fit analysis of the hybrid FSDPxTP(+SP) train step.
@@ -640,6 +646,22 @@ def analyze(
             denom *= tp_size
         kv_block_bytes_chip = -(-full // denom)
 
+    # Host-tier term (``kv_host_blocks > 0``, serve/tier.py): the
+    # host-DRAM buffers parked prefixes spill into. Full-width per
+    # host (the spill gather device_gets the sharded rows before the
+    # numpy store), and host DRAM -- never part of the HBM verdict.
+    kv_host_bytes = 0
+    if kv_host_blocks:
+        if not kv_blocks:
+            raise ValueError(
+                "a host KV tier needs the paged pool term too "
+                "(kv_blocks > 0): the tier spills the paged pool's "
+                "pages"
+            )
+        kv_host_bytes = kv_paged_bytes(
+            cfg, kv_host_blocks, kv_block_size, kv_cache_dtype
+        )
+
     # Speculative-draft term (``draft_cfg``, serve/spec.py): the
     # draft's serving params (fp32, TP-sharded over the model axis
     # where its heads divide, else replicated -- serve/weights.py's
@@ -705,6 +727,8 @@ def analyze(
             kv_block_bytes=kv_block_bytes_chip,
             kv_blocks=kv_blocks,
             kv_block_size=kv_block_size if kv_blocks else 0,
+            kv_host_blocks=kv_host_blocks,
+            kv_host_bytes=kv_host_bytes,
             draft_n_params=draft_n_params,
             draft_param_bytes=draft_params_chip,
             draft_kv_block_bytes=draft_kv_chip,
@@ -774,6 +798,8 @@ def analyze(
         kv_block_bytes=kv_block_bytes_chip,
         kv_blocks=kv_blocks,
         kv_block_size=kv_block_size if kv_blocks else 0,
+        kv_host_blocks=kv_host_blocks,
+        kv_host_bytes=kv_host_bytes,
         draft_n_params=draft_n_params,
         draft_param_bytes=draft_params_chip,
         draft_kv_block_bytes=draft_kv_chip,
@@ -1016,6 +1042,27 @@ def to_markdown(r: FitResult) -> str:
                 "mix; shrink --kv-blocks."
             ),
         ]
+    if r.kv_host_blocks:
+        # The tier's sizing line: host DRAM buys parked-session KV
+        # capacity at ZERO HBM cost, so the multiplier is the page
+        # ratio (minus each pool's scratch slot). This is the number
+        # --kv-host-tier exists to print: how many more idle sessions
+        # stay resident (return visits prefetch their prefix back
+        # instead of re-prefilling) at the same device pool.
+        dev_pages = max(r.kv_blocks - 1, 1)
+        host_pages = max(r.kv_host_blocks - 1, 0)
+        mult = (dev_pages + host_pages) / dev_pages
+        lines += [
+            "",
+            f"Host KV tier (serve/tier.py): {r.kv_host_blocks} host "
+            f"slots x {r.kv_block_size} tokens = "
+            f"{r.kv_host_bytes:,} bytes ({r.kv_host_bytes/GIB:.2f} "
+            f"GiB) of host DRAM per host -- NOT in the HBM total "
+            f"above. Parked-session KV capacity: {dev_pages:,} "
+            f"device pages HBM-only vs {dev_pages + host_pages:,} "
+            f"pages with the tier -- **{mult:.1f}x the resident "
+            f"sessions** at equal HBM.",
+        ]
     lines += [
         "",
         "Static accounting is exact (eval_shape + the PartitionSpec "
@@ -1257,6 +1304,14 @@ def main(argv=None) -> int:
     parser.add_argument("--kv-block-size", type=int, default=16,
                         help="tokens per page for --kv-blocks "
                         "(default 16)")
+    parser.add_argument("--kv-host-tier", type=int, default=0,
+                        metavar="N",
+                        help="budget a host-DRAM KV page tier "
+                        "(serve/tier.py): N host slots incl. scratch "
+                        "that parked session prefixes spill into; "
+                        "reported as host DRAM next to the HBM "
+                        "verdict with the resident-sessions "
+                        "multiplier (requires --kv-blocks)")
     parser.add_argument("--spec-draft", type=str, default=None,
                         choices=("half", *sorted(llama2.PRESETS)),
                         help="budget a speculative-decode draft model "
@@ -1316,6 +1371,11 @@ def main(argv=None) -> int:
     }
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
+    if args.kv_host_tier and not args.kv_blocks:
+        parser.error(
+            "--kv-host-tier needs --kv-blocks: the tier spills the "
+            "paged pool's pages"
+        )
     draft_cfg = None
     if args.spec_draft is not None:
         if not args.kv_blocks:
@@ -1347,6 +1407,7 @@ def main(argv=None) -> int:
         kv_cache_dtype=args.kv_cache_dtype,
         kv_blocks=args.kv_blocks,
         kv_block_size=args.kv_block_size,
+        kv_host_blocks=args.kv_host_tier,
         draft_cfg=draft_cfg,
     )
     md = to_markdown(r)

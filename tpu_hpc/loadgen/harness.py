@@ -200,9 +200,17 @@ class _CostModelEngine:
         prefill_ms_per_token: float,
         faults: Dict[str, float],
         draft_cost_frac: float = 0.15,
+        hop_ms_per_page: float = 0.5,
     ):
         self._engine = engine
         self._clock = clock
+        # Host-tier hop cost (serve/tier.py): each page spilled to or
+        # refilled from host DRAM charges this much modeled time --
+        # ~an order cheaper per token than prefill (a DMA, not a
+        # forward pass), which is the whole tier thesis. Engines
+        # without a tier never move pages, so legacy runs charge 0
+        # and stay byte-identical.
+        self._hop_s_per_page = hop_ms_per_page / 1e3
         self._decode_s = decode_step_ms / 1e3 * faults["decode_delay"]
         self._prefill_s_per_token = (
             prefill_ms_per_token / 1e3 * faults["prefill_delay"]
@@ -227,8 +235,9 @@ class _CostModelEngine:
 
     def __getattr__(self, name):
         # Cost-neutral surface (serve_cfg, the paged protocol's
-        # admit/release/validate_request, stats/occupancy reads)
-        # delegates; only the compute calls below charge time.
+        # release/validate_request, stats/occupancy reads) delegates;
+        # only the compute calls below (and admit/prefetch_prompt,
+        # which charge the host-tier hop) cost time.
         return getattr(self._engine, name)
 
     def _draft_forwarded(self) -> int:
@@ -236,6 +245,42 @@ class _CostModelEngine:
         if spec is None or spec.draft is None:
             return 0
         return spec.draft.prefill_forwarded_total
+
+    def _hop_pages(self) -> int:
+        tier = getattr(self._engine, "host_tier", None)
+        if tier is None:
+            return 0
+        return (
+            tier.stats["kv_spill_pages"] + tier.stats["kv_refill_pages"]
+        )
+
+    def _charge_hop(self, pages_before: int) -> None:
+        """Charge the tier pages moved since ``pages_before``. Folded
+        into ``prefill_charged_s``: like a prefill chunk, a hop is
+        EXPECTED admission-path work, and the stall detector must not
+        shed tenants on it."""
+        pages = self._hop_pages() - pages_before
+        if pages > 0:
+            cost = self._hop_s_per_page * pages
+            self.prefill_charged_s += cost
+            self._clock.advance(cost)
+
+    def admit(self, *args, **kwargs):
+        # A host-tier admit may spill parked pages to make room; the
+        # charge must land even when admission then fails (the bytes
+        # moved either way).
+        before = self._hop_pages()
+        try:
+            return self._engine.admit(*args, **kwargs)
+        finally:
+            self._charge_hop(before)
+
+    def prefetch_prompt(self, prompt):
+        before = self._hop_pages()
+        try:
+            return self._engine.prefetch_prompt(prompt)
+        finally:
+            self._charge_hop(before)
 
     def prefill(self, idx: int, prompt: List[int]) -> int:
         out = self._engine.prefill(idx, prompt)
@@ -446,6 +491,7 @@ class LoadHarness:
         stall_factor: float = 3.0,
         faults: Optional[Dict[str, float]] = None,
         capture: Optional[AnomalyCapture] = None,
+        hop_ms_per_page: float = 0.5,
     ):
         self.scenario = scenario
         self.metrics_path = metrics_path
@@ -468,7 +514,7 @@ class LoadHarness:
             )
         self.engine = _CostModelEngine(
             engine, self.clock, decode_step_ms, prefill_ms_per_token,
-            faults,
+            faults, hop_ms_per_page=hop_ms_per_page,
         )
         self.meter = LoadMeter(metrics_path=metrics_path,
                                clock=self.clock)

@@ -41,7 +41,15 @@ replay `python -m tpu_hpc.serve` ships:
                         scenario (serve/fleet.py): autoscale rides
                         the swings, prefix affinity rides the
                         prompts, and the chaos harness injects a
-                        mid-run weight swap + replica kill on top.
+                        mid-run weight swap + replica kill on top;
+* ``long_idle_sessions`` returning chat users: first visits cache
+                        their prompts, a filler wave floods the page
+                        pool while the chatters idle, then everyone
+                        returns at once -- the host-DRAM tier's
+                        acceptance scenario (serve/tier.py): an
+                        HBM-only pool must shed the return wave, a
+                        tiered pool (parked pages spilled, refilled
+                        on return) must shed none.
 """
 from __future__ import annotations
 
@@ -517,6 +525,98 @@ def build_scenario(
             prefixes=prefixes,
         )
 
+    if name == "long_idle_sessions":
+        # Returning chat users: a wave of first visits caches its
+        # prompts in the trie, a filler wave floods the page pool
+        # while the chatters idle, then every chatter comes back at
+        # once with its old prompt plus a short new turn. An
+        # HBM-only pool evicted the parked prompts to seat the
+        # fillers, so the return wave re-prefills from scratch,
+        # drains slowly, and overflows the (tight) backlog bound --
+        # returns shed. A host-tiered pool SPILLED the parked pages
+        # instead; the return wave prefix-hits after a cheap
+        # refill hop and drains fast -- zero returns shed. The
+        # tenant split keeps the contrast measurable per class
+        # (TTFT-on-return is ``tenants["return"]``'s quantiles).
+        n_sessions = max(1, n // 3)
+        n_fill = max(1, n // 3)
+        n_return = max(1, n - n_sessions - n_fill)
+        ret_suffix = max(1, min(max_new, max_prompt // 4))
+        first_hi = max(lo_p, max_prompt - ret_suffix)
+        first_lo = max(lo_p, first_hi // 2)
+        tenants = (
+            TenantClass("chat", priority=1, share=0.34),
+            TenantClass("filler", priority=0, share=0.33),
+            TenantClass("return", priority=1, share=0.33),
+        )
+        first_prompts = [
+            tuple(
+                int(x) for x in rng.integers(
+                    0, vocab_size,
+                    size=int(rng.integers(first_lo, first_hi + 1)),
+                )
+            )
+            for _ in range(n_sessions)
+        ]
+        idle_gap_ms = 1000.0
+        chat_arr = poisson_arrivals(rng, n_sessions, rate_per_s)
+        fill_arr = (
+            float(chat_arr.max()) + idle_gap_ms
+            + poisson_arrivals(rng, n_fill, rate_per_s)
+        )
+        # The whole cohort returns in a tight wave (3x the base
+        # rate): the drain-rate contrast (prefix hit vs full
+        # re-prefill) is what decides whether the backlog bound
+        # overflows.
+        ret_arr = (
+            float(fill_arr.max()) + idle_gap_ms
+            + poisson_arrivals(rng, n_return, rate_per_s * 3)
+        )
+        reqs = []
+        for i in range(n_sessions):
+            reqs.append((
+                "chat", 1, float(chat_arr[i]), first_prompts[i],
+                int(rng.integers(2, max_new + 1)),
+            ))
+        for i in range(n_fill):
+            plen = int(rng.integers(
+                max(lo_p, (3 * max_prompt) // 4), max_prompt + 1
+            ))
+            reqs.append((
+                "filler", 0, float(fill_arr[i]),
+                tuple(
+                    int(x)
+                    for x in rng.integers(0, vocab_size, size=plen)
+                ),
+                int(rng.integers(2, max_new + 1)),
+            ))
+        for i in range(n_return):
+            base = first_prompts[i % n_sessions]
+            suffix = tuple(
+                int(x)
+                for x in rng.integers(0, vocab_size, size=ret_suffix)
+            )
+            reqs.append((
+                "return", 1, float(ret_arr[i]), base + suffix,
+                int(rng.integers(2, max_new + 1)),
+            ))
+        reqs.sort(key=lambda r: r[2])
+        return Scenario(
+            name=name, seed=seed, tenants=tenants,
+            requests=tuple(
+                LoadRequest(
+                    rid=f"{name[:2]}{k:05d}",
+                    tenant=t, priority=p, arrival_ms=a,
+                    prompt=prompt, max_new_tokens=mn,
+                )
+                for k, (t, p, a, prompt, mn) in enumerate(reqs)
+            ),
+            # Tight backlog: the return wave must DRAIN, not park --
+            # the shed-vs-zero-shed contrast is the acceptance
+            # signal, and an unbounded queue would absorb it.
+            queue_limit=max(2, n // 8),
+        )
+
     assert name == "colocate"
     # Two classes: when the colocated train step trips the stall
     # watermark, admission control sheds `background` and the
@@ -549,5 +649,5 @@ def build_scenario(
 SCENARIOS: Tuple[str, ...] = (
     "steady", "bursty", "heavy_tail", "multi_tenant",
     "saturating_burst", "colocate", "shared_prefix", "decode_heavy",
-    "diurnal",
+    "diurnal", "long_idle_sessions",
 )

@@ -117,6 +117,16 @@ _LOWER_IS_BETTER = (
     # "morph" covers the morph counters and the elastic_morph_*
     # headline rows.)
     "morph",
+    # Host-DRAM KV tier (serve/tier.py): more pages crossing the
+    # HBM/DRAM boundary at the same workload means the tier is
+    # thrashing -- the --bank gate fails on spill/refill drift like
+    # it does on morph drift. ("wire_bytes" above already covers the
+    # kv_spill_wire_bytes / kv_refill_wire_bytes side keys; "ttft"
+    # and "shed" cover ttft_on_return_ms_* and shed_on_return;
+    # "resident_sessions" deliberately matches NO token -- like
+    # prefix_hit_rate it judges higher-is-better by absence: a tier
+    # change that sheds returning sessions fails the gate.)
+    "spill", "refill",
 )
 
 
@@ -162,12 +172,22 @@ def report_metrics(rep: dict) -> Dict[str, float]:
         # acceptance_rate (higher-is-better by token absence) and
         # draft_ms (lower, via "_ms") are the two judged speculative
         # signals.
+        # Host-tier rows split the same way (serve/tier.py):
+        # kv_host_blocks / kv_host_inflight_bytes are pool CONFIG
+        # and kv_host_used/free follow it; the kv_spills/kv_refills
+        # EVENT counts and the pages they carried are raw counts a
+        # bigger workload inflates -- the judged tier signals are
+        # the wire bytes (lower via "wire_bytes") and the hop
+        # quantiles (lower via "_ms").
         if isinstance(val, (int, float)) and key not in (
             "requests", "kv_block_size", "kv_blocks",
             "kv_blocks_free_min", "prefill_chunks",
             "prefix_hits", "prefix_hit_blocks",
             "spec_k", "drafted", "accepted", "rejected",
             "verify_steps",
+            "kv_host_blocks", "kv_host_used", "kv_host_free",
+            "kv_host_inflight_bytes", "kv_spills", "kv_refills",
+            "kv_spill_pages", "kv_refill_pages", "kv_host_drops",
         ):
             flat[f"serve.{key}"] = float(val)
     lg = rep.get("loadgen")
@@ -287,6 +307,17 @@ _BANKED_SIDE_KEYS = (
     # per transition fails --bank even while the stall headline still
     # rides within tolerance.
     "morphs", "morph_wire_bytes",
+    # Host-tier rows (bench.py --serve-host-blocks, the
+    # long_idle_sessions scenario): the returning-tenant latency
+    # quantiles and shed count (lower via "ttft"/"shed"), the
+    # resident-session count (higher by token absence), and the
+    # cross-tier wire bytes (lower via "wire_bytes") all ride next
+    # to the scenario's TTFT headline -- a tier change that sheds
+    # returning sessions or starts thrashing pages across the
+    # boundary fails --bank even while the headline holds.
+    "ttft_on_return_ms_p50", "ttft_on_return_ms_p95",
+    "shed_on_return", "resident_sessions",
+    "kv_spill_wire_bytes", "kv_refill_wire_bytes",
 )
 
 

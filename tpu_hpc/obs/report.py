@@ -175,6 +175,16 @@ def _serve(records: Sequence[dict]) -> Optional[dict]:
             # signals (regress excludes the identity + raw counts).
             "spec_mode", "spec_k", "acceptance_rate", "draft_ms",
             "drafted", "accepted", "rejected", "verify_steps",
+            # Host-DRAM KV tier (serve/tier.py): pool config
+            # (kv_host_blocks, inflight) is identity, the wire bytes
+            # and hop quantiles are the judged signals (regress
+            # excludes the config + raw counts).
+            "kv_host_blocks", "kv_host_used", "kv_host_free",
+            "kv_host_drops", "kv_host_inflight_bytes",
+            "kv_host_inflight_source", "kv_hop_ms_p50",
+            "kv_hop_ms_p95", "kv_spills", "kv_spill_pages",
+            "kv_spill_wire_bytes", "kv_refills", "kv_refill_pages",
+            "kv_refill_wire_bytes",
         )
         if k in s
     }
@@ -711,6 +721,21 @@ def format_report(rep: dict) -> str:
                 f"cache hit rate {s.get('prefix_hit_rate', 0.0):.0%} "
                 f"({s.get('prefix_hit_blocks', 0)} pages reused, "
                 f"{s.get('prefill_chunks', 0)} prefill chunks)"
+            )
+        if s.get("kv_host_blocks"):
+            lines.append(
+                f"- host KV tier: {s['kv_host_blocks']} host slots "
+                f"({s.get('kv_host_used', 0)} used, "
+                f"{s.get('kv_host_drops', 0)} drops); "
+                f"{s.get('kv_spill_pages', 0)} pages spilled / "
+                f"{s.get('kv_refill_pages', 0)} refilled "
+                f"({s.get('kv_spill_wire_bytes', 0)} + "
+                f"{s.get('kv_refill_wire_bytes', 0)} wire bytes), "
+                f"hop p50/p95 {s.get('kv_hop_ms_p50', 0.0):.1f} / "
+                f"{s.get('kv_hop_ms_p95', 0.0):.1f} ms "
+                f"(inflight bound "
+                f"{s.get('kv_host_inflight_bytes', 0)} B, "
+                f"{s.get('kv_host_inflight_source', '?')})"
             )
         if s.get("spec_mode"):
             lines.append(

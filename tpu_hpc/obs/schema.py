@@ -201,6 +201,21 @@ EVENTS: Dict[str, EventSpec] = {
         ("action",),
         optional=("rid", "slot", "n", "block", "blocks", "reason"),
     ),
+    # -- host-DRAM KV page tier (serve/tier.py): one record per
+    #    bounded transfer group -- parked pages leaving HBM for host
+    #    buffers (kv_spill) and host-resident chains prefetched back
+    #    before a returning request seats (kv_refill). Spill/refill
+    #    runs at admission cadence, so producers emit these ring-only
+    #    (the kv_block discipline); the wire-byte and page aggregates
+    #    ride the serve_summary instead. --
+    "kv_spill": EventSpec(
+        ("pages", "bytes"),
+        optional=("reason", "host_free", "blocks"),
+    ),
+    "kv_refill": EventSpec(
+        ("pages", "bytes"),
+        optional=("reason", "host_free", "blocks"),
+    ),
     # -- resharding engine (tpu_hpc/reshard): one record per executed
     #    plan, modeled wire/peak bytes next to measured moved bytes --
     "reshard_plan": EventSpec(

@@ -565,6 +565,15 @@ class ServingFleet:
                         chosen = cand
                         affinity = True
                         self.router_stats["affinity_routes"] += 1
+                        # Host-tier prefetch on the affinity hit: the
+                        # warm replica starts pulling this prompt's
+                        # spilled prefix pages out of host DRAM NOW,
+                        # while the request still rides the queue --
+                        # the hop hides behind queueing instead of
+                        # stretching TTFT-on-return.
+                        eng = cand.engine
+                        if getattr(eng, "host_tier", None) is not None:
+                            eng.prefetch_prompt(req.prompt)
                     elif cand in pool:
                         # The mapping stays: the trie is still warm
                         # for the next, calmer arrival.

@@ -95,12 +95,16 @@ class PagedConfig:
     ``num_blocks - 1``). ``prefill_chunk``: chunked-prefill stride in
     tokens (0 = whole-prompt bucketed prefill); must be block-aligned
     so every chunk starts on a page boundary. ``prefix_cache``: keep
-    finished prompts' full pages in the prefix trie for reuse."""
+    finished prompts' full pages in the prefix trie for reuse.
+    ``host_blocks``: host-DRAM page slots behind the HBM pool
+    (serve/tier.py; 0 = no tier). Like ``num_blocks`` it INCLUDES a
+    reserved scratch slot 0, so a non-zero tier needs >= 2 slots."""
 
     block_size: int = 16
     num_blocks: int = 64
     prefill_chunk: int = 0
     prefix_cache: bool = True
+    host_blocks: int = 0
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -111,6 +115,18 @@ class PagedConfig:
             raise ValueError(
                 f"num_blocks must be >= 2 (scratch + at least one "
                 f"usable page), got {self.num_blocks}"
+            )
+        if self.host_blocks < 0 or self.host_blocks == 1:
+            raise ValueError(
+                f"host_blocks must be 0 (no host tier) or >= 2 "
+                f"(scratch + at least one resident slot), got "
+                f"{self.host_blocks}"
+            )
+        if self.host_blocks and not self.prefix_cache:
+            raise ValueError(
+                "host_blocks needs prefix_cache=True: the host tier "
+                "spills TRIE-parked pages (a pool with no trie has "
+                "nothing parked to spill)"
             )
         if self.prefill_chunk < 0 or (
             self.prefill_chunk % self.block_size
@@ -141,6 +157,7 @@ def derive_paged_config(
     num_blocks: Optional[int] = None,
     prefill_chunk: Optional[int] = None,
     align_capacity: bool = False,
+    host_blocks: Optional[int] = None,
 ) -> Tuple["PagedConfig", int]:
     """CLI-shared sizing: ``(PagedConfig, capacity)`` from the flag
     values, with every invalid combination raising ``ValueError``
@@ -176,6 +193,7 @@ def derive_paged_config(
             else slots * max_seq // bs + 1
         ),
         prefill_chunk=prefill_chunk or 0,
+        host_blocks=host_blocks or 0,
     )
     return cfg, max_seq
 
@@ -208,16 +226,35 @@ class BlockAllocator:
     times -- no page is ever both free and referenced, double-freed,
     or leaked. ``retain``/``release`` move refcounts; a page frees
     only at refcount zero, which is what lets the prefix trie keep a
-    finished request's prompt pages alive for future hits."""
+    finished request's prompt pages alive for future hits.
 
-    def __init__(self, num_blocks: int):
+    With ``host_blocks > 0`` (the host-DRAM tier, serve/tier.py) the
+    identity extends across tiers: device scratch + free + referenced
+    plus host scratch + free + resident must equal
+    ``num_blocks + host_blocks`` -- a page lives in exactly one tier
+    at a time. ``spill``/``refill`` move a page's accounting between
+    tiers; the device<->host copies themselves are the tier's job."""
+
+    def __init__(self, num_blocks: int, host_blocks: int = 0):
         if num_blocks < 2:
             raise ValueError(f"num_blocks {num_blocks} must be >= 2")
+        if host_blocks < 0 or host_blocks == 1:
+            raise ValueError(
+                f"host_blocks {host_blocks} must be 0 or >= 2"
+            )
         self.num_blocks = num_blocks
+        self.host_blocks = host_blocks
         # LIFO: the most recently freed page is the next handed out --
         # it is the page most likely still warm in HBM caches.
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._ref: Dict[int, int] = {}
+        # Host tier: slot 0 mirrors the device scratch block (refill
+        # padding gathers from it, spill padding scatters to it).
+        self._host_free: List[int] = (
+            list(range(host_blocks - 1, 0, -1)) if host_blocks else []
+        )
+        self._host_used: set = set()
+        self.host_drops = 0
 
     @property
     def free_blocks(self) -> int:
@@ -226,6 +263,14 @@ class BlockAllocator:
     @property
     def used_blocks(self) -> int:
         return len(self._ref)
+
+    @property
+    def host_free_slots(self) -> int:
+        return len(self._host_free)
+
+    @property
+    def host_used_slots(self) -> int:
+        return len(self._host_used)
 
     def refcount(self, block: int) -> int:
         return self._ref.get(block, 0)
@@ -292,6 +337,60 @@ class BlockAllocator:
             raise
         return new, True
 
+    # -- host-tier accounting (serve/tier.py moves the bytes) ----------
+    def spill(self, block: int) -> int:
+        """Move one device page's accounting to the host tier: frees
+        the device page, returns the host slot now holding it.
+
+        Refuses pages any live request still shares (refcount above
+        the spiller's single trie reference) -- the PR-8 shared-leaf
+        eviction lesson applied to spill: a page a live request still
+        reads through its block table must stay in HBM, or the next
+        decode gather reads a recycled page."""
+        n = self._ref.get(block)
+        if n is None:
+            raise ValueError(f"spill of unreferenced block {block}")
+        if n != 1:
+            raise ValueError(
+                f"spill of shared block {block} (refcount {n}): a "
+                "page a live request still reads must stay in HBM"
+            )
+        if not self._host_free:
+            raise BlockBudgetError(
+                f"host tier full ({len(self._host_used)} of "
+                f"{self.host_blocks} slot(s) resident)"
+            )
+        slot = self._host_free.pop()
+        self._host_used.add(slot)
+        del self._ref[block]
+        self._free.append(block)
+        return slot
+
+    def refill(self, host_slot: int) -> int:
+        """Bring one host-resident page's accounting back: allocates a
+        device page at refcount 1, frees the host slot. Raises
+        :class:`BlockBudgetError` when the device pool is full (the
+        caller's spill/evict pass must free pages first)."""
+        if host_slot not in self._host_used:
+            raise ValueError(
+                f"refill of non-resident host slot {host_slot}"
+            )
+        block = self.alloc(1)[0]
+        self._host_used.remove(host_slot)
+        self._host_free.append(host_slot)
+        return block
+
+    def host_drop(self, host_slot: int) -> None:
+        """Discard a host-resident page (host-tier eviction, or a
+        trie re-insert adopting a freshly recomputed device copy)."""
+        if host_slot not in self._host_used:
+            raise ValueError(
+                f"host drop of non-resident slot {host_slot}"
+            )
+        self._host_used.remove(host_slot)
+        self._host_free.append(host_slot)
+        self.host_drops += 1
+
     def check_invariant(self) -> None:
         """Raises if the accounting identity is violated (the property
         suite calls this after every random operation)."""
@@ -313,6 +412,38 @@ class BlockAllocator:
                 f"page accounting broken: scratch + {len(free)} free "
                 f"+ {len(held)} held = {total} != {self.num_blocks}"
             )
+        hfree = set(self._host_free)
+        if len(hfree) != len(self._host_free):
+            raise AssertionError(
+                "duplicate slots on the host free list"
+            )
+        if hfree & self._host_used:
+            raise AssertionError(
+                f"host slots both free and resident: "
+                f"{sorted(hfree & self._host_used)}"
+            )
+        if self.host_blocks and (0 in hfree or 0 in self._host_used):
+            raise AssertionError(
+                "host scratch slot leaked into the tier"
+            )
+        htotal = (
+            1 + len(hfree) + len(self._host_used)
+            if self.host_blocks else 0
+        )
+        if self.host_blocks and htotal != self.host_blocks:
+            raise AssertionError(
+                f"host tier accounting broken: scratch + "
+                f"{len(hfree)} free + {len(self._host_used)} resident "
+                f"= {htotal} != {self.host_blocks}"
+            )
+        # The cross-tier identity the host tier extends the pool
+        # with: scratch + free + referenced + host == total pages.
+        if total + htotal != self.num_blocks + self.host_blocks:
+            raise AssertionError(
+                f"cross-tier accounting broken: device {total} + "
+                f"host {htotal} != "
+                f"{self.num_blocks + self.host_blocks}"
+            )
 
 
 @dataclasses.dataclass
@@ -322,6 +453,10 @@ class _TrieNode:
         default_factory=dict
     )
     last_used: int = 0
+    # Host-tier residency (serve/tier.py): the host slot holding this
+    # block's K/V while it is spilled out of HBM; None = device-
+    # resident (block is the live page id; spilled nodes park -1).
+    host: Optional[int] = None
 
 
 class PrefixTrie:
@@ -359,18 +494,66 @@ class PrefixTrie:
     def match(self, prompt: Sequence[int]) -> List[int]:
         """Physical pages of the longest cached full-block prefix of
         ``prompt`` (possibly empty). Bumps LRU clocks; takes no
-        references -- the caller retains what it keeps."""
+        references -- the caller retains what it keeps. Stops at the
+        first HOST-resident node: a spilled page has no device id to
+        share until a prefetch (serve/tier.py) refills it."""
         blocks: List[int] = []
         level = self._root
         now = self._tick()
         for key in self._full_blocks(prompt):
             node = level.get(key)
-            if node is None:
+            if node is None or node.host is not None:
                 break
             node.last_used = now
             blocks.append(node.block)
             level = node.children
         return blocks
+
+    def spilled_chain(
+        self, prompt: Sequence[int]
+    ) -> List[_TrieNode]:
+        """The HOST-resident nodes along ``prompt``'s cached chain, in
+        chain order -- what a prefetch must refill before
+        :meth:`match` can serve the full prefix. Read-only: no LRU
+        bump (the refill itself is the evidence of heat)."""
+        out: List[_TrieNode] = []
+        level = self._root
+        for key in self._full_blocks(prompt):
+            node = level.get(key)
+            if node is None:
+                break
+            if node.host is not None:
+                out.append(node)
+            level = node.children
+        return out
+
+    def spillable(
+        self, allocator: BlockAllocator
+    ) -> List[_TrieNode]:
+        """Device-resident nodes whose page only the trie holds and
+        whose children (if any) are all host-resident already --
+        the pages a host-tier spill may take without breaking a
+        chain's device-prefix/host-suffix shape. LRU first, so the
+        coldest suffixes leave HBM first (evict's leaf-first rule,
+        applied to spill)."""
+        cands: List[Tuple[int, _TrieNode]] = []
+
+        def walk(level: Dict) -> None:
+            for node in level.values():
+                walk(node.children)
+                if (
+                    node.host is None
+                    and all(
+                        c.host is not None
+                        for c in node.children.values()
+                    )
+                    and allocator.refcount(node.block) == 1
+                ):
+                    cands.append((node.last_used, node))
+
+        walk(self._root)
+        cands.sort(key=lambda t: t[0])
+        return [node for _, node in cands]
 
     def insert(
         self,
@@ -395,6 +578,17 @@ class PrefixTrie:
                 self.nodes += 1
                 created += 1
             else:
+                if node.host is not None:
+                    # The prefill just recomputed this block's K/V
+                    # into the request's own device page (match
+                    # stopped at the spilled node, so the chunk plan
+                    # covered it): adopt that page and drop the now-
+                    # redundant host copy -- a chain demonstrably hot
+                    # again belongs in HBM, not behind a refill hop.
+                    allocator.retain([int(blocks[i])])
+                    allocator.host_drop(node.host)
+                    node.host = None
+                    node.block = int(blocks[i])
                 node.last_used = now
             level = node.children
         return created
@@ -423,26 +617,47 @@ class PrefixTrie:
         freed = 0
         while freed < n_needed:
             leaves: List[Tuple[int, Dict, Tuple, _TrieNode]] = []
+            spilled: List[Tuple[int, Dict, Tuple, _TrieNode]] = []
 
             def walk(level: Dict) -> None:
                 for key, node in level.items():
                     if node.children:
                         walk(node.children)
+                    elif node.host is not None:
+                        # Host-resident leaf: pins no HBM, but blocks
+                        # the walk from exposing its device-resident
+                        # ancestors as leaves.
+                        spilled.append(
+                            (node.last_used, level, key, node)
+                        )
                     elif allocator.refcount(node.block) == 1:
                         leaves.append(
                             (node.last_used, level, key, node)
                         )
 
             walk(self._root)
-            if not leaves:
+            if leaves:
+                leaves.sort(key=lambda t: t[0])
+                for _, level, key, node in leaves:
+                    del level[key]
+                    self.nodes -= 1
+                    freed += allocator.release([node.block])
+                    if freed >= n_needed:
+                        break
+            elif spilled:
+                # Device leaves exhausted while still short: the pool-
+                # pressure endgame. Dropping host-resident leaves
+                # frees no HBM directly, but the re-walk then reaches
+                # their (device-resident) parents -- without this the
+                # eviction loop stalls on a full host tier while
+                # parked pages still hold HBM.
+                spilled.sort(key=lambda t: t[0])
+                for _, level, key, node in spilled:
+                    del level[key]
+                    self.nodes -= 1
+                    allocator.host_drop(node.host)
+            else:
                 break
-            leaves.sort(key=lambda t: t[0])
-            for _, level, key, node in leaves:
-                del level[key]
-                self.nodes -= 1
-                freed += allocator.release([node.block])
-                if freed >= n_needed:
-                    break
         return freed
 
 
@@ -729,10 +944,23 @@ class PagedEngine(Engine):
         # dispatches to; None means plain greedy single-token decode.
         self.spec = None
         self._spec_builders: Dict[str, Any] = {}
-        self.allocator = BlockAllocator(paged.num_blocks)
+        self._tier_builders: Dict[str, Any] = {}
+        self.allocator = BlockAllocator(
+            paged.num_blocks, host_blocks=paged.host_blocks
+        )
         self.trie: Optional[PrefixTrie] = (
             PrefixTrie(bs) if paged.prefix_cache else None
         )
+        # Host-DRAM page tier (serve/tier.py): parked pages spill to
+        # host buffers under pool pressure and prefetch back on a
+        # returning prompt. Attached AFTER the base engine exists (the
+        # tier compiles its gather/scatter through THIS executable
+        # table, so the zero-recompile pins cover it).
+        self.host_tier = None
+        if paged.host_blocks:
+            from tpu_hpc.serve.tier import HostTier
+
+            self.host_tier = HostTier(self)
         self._tables = np.full(
             (serve_cfg.slots, self.table_width), SCRATCH_BLOCK,
             np.int32,
@@ -787,6 +1015,12 @@ class PagedEngine(Engine):
         # counter, so the zero-recompile pins cover them too.
         if key[0] in self._spec_builders:
             return self._spec_builders[key[0]](key)
+        # Host-tier programs (serve/tier.py spill gather / refill
+        # scatter) build against this engine's cache abstracts --
+        # same table, same counter, so the zero-recompile pins cover
+        # the tier too.
+        if key[0] in self._tier_builders:
+            return self._tier_builders[key[0]](key)
         cache = self._cache_abstract()
         params_abs = jax.tree.map(
             lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
@@ -852,11 +1086,15 @@ class PagedEngine(Engine):
             self._get_exec(("spec_verify",))
             self._get_exec(("copy_block",))
             self.spec.warmup_draft()
+            if self.host_tier is not None:
+                self.host_tier.warmup()
             return self.compile_count_total
         for b in self.serve_cfg.prefill_buckets:
             self._get_exec(("prefill", b))
         self._get_exec(("decode",))
         self._get_exec(("copy_block",))
+        if self.host_tier is not None:
+            self.host_tier.warmup()
         return self.compile_count
 
     @property
@@ -1003,6 +1241,12 @@ class PagedEngine(Engine):
         self.allocator.retain(shared)
         fresh_needed = need - len(shared)
         short = fresh_needed - self.allocator.free_blocks
+        if short > 0 and self.host_tier is not None:
+            # Spill beats evict: a parked page moved to host DRAM is a
+            # cheap hop on return, an evicted page is a full
+            # re-prefill. Only pages the tier could not place fall
+            # through to the trie eviction below.
+            short -= self.host_tier.spill_parked(short)
         if short > 0 and self.trie is not None:
             self.paged_stats["trie_evictions"] += self.trie.evict(
                 self.allocator, short
@@ -1059,6 +1303,45 @@ class PagedEngine(Engine):
             "chunks": len(plan),
             "planned_prefill_tokens": sum(b for _, _, b in plan),
         }
+
+    def prefetch_prompt(self, prompt: Sequence[int]) -> int:
+        """Refill host-spilled prefix pages for ``prompt`` back into
+        HBM *before* the request is seated, so the host→device hop
+        hides behind queueing instead of stretching TTFT. No-op (0)
+        without a host tier. Returns pages refilled."""
+        if self.host_tier is None:
+            return 0
+        return self.host_tier.prefetch(prompt)
+
+    def admission_headroom(self, prompt: Sequence[int], max_new: int) -> bool:
+        """Cheap pre-check: could ``admit()`` plausibly succeed for
+        this request right now? Counts free pages, trie-matched pages,
+        and parked pages reclaimable by spill or eviction. Heuristic
+        only -- ``admit()``'s ``BlockBudgetError`` stays the
+        authority -- but it lets the scheduler skip the prefetch hop
+        for a request that is about to block-stall anyway."""
+        need = self.paged.blocks_for(len(prompt) + max_new)
+        matched = 0
+        if self.trie is not None:
+            matched = len(self.trie.match(list(int(t) for t in prompt)))
+        reclaimable = 0
+        if self.trie is not None:
+            # Parked exclusive pages: spillable or evictable on demand.
+            reclaimable = sum(
+                1
+                for b, c in self.allocator._ref.items()
+                if c == 1 and b != SCRATCH_BLOCK
+            ) - self._held_by_live_slots()
+        avail = self.allocator.free_blocks + matched + max(0, reclaimable)
+        return avail >= need
+
+    def _held_by_live_slots(self) -> int:
+        """Pages referenced by seated requests (refcount floor: these
+        can never be spilled or evicted)."""
+        live = set()
+        for st in self._slot_state.values():
+            live.update(st.blocks)
+        return len(live)
 
     def planned_prefill_tokens(self, slot: int) -> int:
         return sum(b for _, _, b in self._slot_state[slot].plan)
@@ -1208,9 +1491,14 @@ class PagedEngine(Engine):
                 "engines)"
             )
         self._slot_state = {}
-        self.allocator = BlockAllocator(self.paged.num_blocks)
+        self.allocator = BlockAllocator(
+            self.paged.num_blocks, host_blocks=self.paged.host_blocks
+        )
         if self.trie is not None:
             self.trie = PrefixTrie(self.paged.block_size)
+        if self.host_tier is not None:
+            # Host pages also encode old-weight K/V: flush them too.
+            self.host_tier.reset()
         self._tables[:] = SCRATCH_BLOCK
         self._tables_dev = None
         self._set_block_gauges()
@@ -1256,4 +1544,8 @@ class PagedEngine(Engine):
             "prefill_chunks": s["prefill_chunks"],
             "cow_copies": s["cow_copies"],
             "trie_evictions": s["trie_evictions"],
+            **(
+                self.host_tier.summary()
+                if self.host_tier is not None else {}
+            ),
         }

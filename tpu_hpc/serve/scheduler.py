@@ -489,6 +489,25 @@ class ContinuousBatcher:
         index.append(first)
         self._ngram_idx[req.rid] = index
 
+    def _block_stall(self, req: Request, tid: str, reason: str) -> None:
+        """Re-queue a page-short request (FIFO within its class --
+        skipping ahead to a smaller request would starve the large one
+        forever) and count the tick as a block stall."""
+        self.pending.append(req)  # _order keeps its place
+        self.stats["block_stalls"] += 1
+        get_registry().inc("serve_block_stalls_total")
+        get_bus().emit(
+            "admission",
+            sink=self._sink(),
+            action="block_stall",
+            rid=req.rid,
+            trace_id=tid,
+            tenant=req.tenant,
+            occupancy=self.occupancy,
+            pending=len(self.pending),
+            reason=reason,
+        )
+
     def _admit_paged(self, idx: int, slot: _Slot) -> bool:
         """Seat the head-of-queue request if the page pool can hold
         it; on a transient page shortage the request stays queued
@@ -505,6 +524,20 @@ class ContinuousBatcher:
             sampling = (
                 self._seeds[req.rid], req.temperature, req.top_p,
             )
+        # Host-tier prefetch-before-seat: refill this prompt's spilled
+        # prefix pages WHILE the request is still queued, so the
+        # host->device hop hides behind queueing instead of stretching
+        # TTFT. Gated on a cheap headroom pre-check -- a request that
+        # is about to block-stall anyway must not burn the hop (it
+        # would re-pay it on every stalled tick).
+        if getattr(self.engine, "host_tier", None) is not None:
+            if not self.engine.admission_headroom(
+                req.prompt, req.max_new_tokens
+            ):
+                self._block_stall(req, tid, "kv_pool_exhausted")
+                return False
+            with activate(tid):
+                self.engine.prefetch_prompt(req.prompt)
         t0 = self._clock()
         try:
             # Positional-only when no spec is attached: the disagg
@@ -523,20 +556,7 @@ class ContinuousBatcher:
                         idx, req.prompt, req.max_new_tokens
                     )
         except BlockBudgetError:
-            self.pending.append(req)  # _order keeps its place
-            self.stats["block_stalls"] += 1
-            get_registry().inc("serve_block_stalls_total")
-            get_bus().emit(
-                "admission",
-                sink=self._sink(),
-                action="block_stall",
-                rid=req.rid,
-                trace_id=tid,
-                tenant=req.tenant,
-                occupancy=self.occupancy,
-                pending=len(self.pending),
-                reason="kv_pool_exhausted",
-            )
+            self._block_stall(req, tid, "kv_pool_exhausted")
             return False
         emit_span(
             "admit", self._clock() - t0, sink=self._sink(),
@@ -786,6 +806,12 @@ class ContinuousBatcher:
         paged = getattr(self.engine, "paged_stats", None)
         if paged:
             self.stats.update(paged)
+        # Host-tier engines count page spills/refills and the wire
+        # bytes they moved; fold them in so the serve summary (and
+        # the banked regress rows) carry the tier's load.
+        tier = getattr(self.engine, "host_tier", None)
+        if tier is not None:
+            self.stats.update(tier.stats)
         # Speculative engines count drafts/accepts per verify step;
         # fold the counts (deterministic -- draft wall time stays out
         # of the batcher stats so virtual-clock replays stay

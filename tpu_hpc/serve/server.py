@@ -548,7 +548,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="run a tpu_hpc.loadgen scenario instead of the plain "
         "replay mix (catalog: steady, bursty, heavy_tail, "
         "multi_tenant, saturating_burst, colocate, shared_prefix, "
-        "decode_heavy, diurnal); --requests/"
+        "decode_heavy, diurnal, long_idle_sessions); --requests/"
         "--max-new/--seed size it, latencies run on the virtual "
         "clock (deterministic -- the regress gate's input)",
     )
@@ -586,6 +586,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="physical pages in the pool incl. the scratch page "
         "(default: slab-equivalent capacity, slots x max-seq-len / "
         "block-size + 1); requires --paged",
+    )
+    ap.add_argument(
+        "--kv-host-blocks", type=int, default=None, metavar="N",
+        help="host-DRAM page tier (serve/tier.py): N host page slots "
+        "incl. the scratch slot behind the HBM pool -- parked trie "
+        "pages spill there under pool pressure and refill on a "
+        "returning prompt (prefetch-before-seat); size it with "
+        "python -m tpu_hpc.checks.fit --kv-host-tier N; requires "
+        "--paged",
     )
     ap.add_argument(
         "--prefill-chunk", type=int, default=None, metavar="TOKENS",
@@ -749,12 +758,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for flag, val in (
             ("--kv-block-size", args.kv_block_size),
             ("--kv-blocks", args.kv_blocks),
+            ("--kv-host-blocks", args.kv_host_blocks),
             ("--prefill-chunk", args.prefill_chunk),
         ):
             if val is not None:
                 ap.error(
                     f"{flag} is only consumed together with --paged"
                 )
+    if args.kv_host_blocks is not None and args.kv_host_blocks < 2:
+        ap.error(
+            f"--kv-host-blocks {args.kv_host_blocks} must be >= 2 "
+            "(one scratch slot plus at least one page)"
+        )
     # Speculative decoding rides the paged engine only; a spec flag
     # that cannot take effect is a parse error, not a silent greedy
     # run wearing a speculative label.
@@ -911,6 +926,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 num_blocks=args.kv_blocks,
                 prefill_chunk=args.prefill_chunk,
                 align_capacity=args.max_seq_len is None,
+                host_blocks=args.kv_host_blocks,
             )
         except ValueError as e:
             ap.error(str(e))
