@@ -1,0 +1,766 @@
+"""The live telemetry plane (obs/digest.py, obs/live.py, obs/slo.py).
+
+What's pinned here, layer by layer:
+
+* the sketch's CONTRACT: every quantile within ``alpha`` relative
+  error of the exact nearest-rank value, and the merge associative /
+  commutative / duplication-safe under random interleavings -- the
+  algebra the whole fleet rollup rests on;
+* the rollup's idempotence: re-reading channels, reading them in any
+  order, or merging partial rollups from two aggregators converge to
+  the same view (cumulative counters + latest-seq-per-source);
+* burn-rate alerting: fast AND slow windows must both burn to page,
+  the page fires exactly once, never on a clean replay, and never
+  before the slow window is covered (no cold-start false positives);
+* ``digest_stale`` is non-vacuous: a clean run flags nothing, a
+  killed replica's silence is flagged as a first-class event;
+* the end-to-end acceptance: a virtual-clock 4-replica fleet run with
+  one ``slow_replica`` fault drives a deterministic
+  ``python -m tpu_hpc.obs.live --json`` rollup that names the slow
+  replica as the straggler, with zero recompiles and exactly one
+  ``slo_burn``-triggered capture bundle correlated by trace_id;
+* the committed BENCH_LIVE rows pass ``regress --bank``.
+"""
+import json
+import math
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_hpc import obs
+from tpu_hpc.loadgen import build_scenario, parse_faults
+from tpu_hpc.models import llama2
+from tpu_hpc.obs.digest import (
+    DEFAULT_ALPHA,
+    ENV_DIGEST_DIR,
+    DigestPublisher,
+    LogBucketSketch,
+    merge_digest_hists,
+    read_channel,
+    read_digest_dir,
+)
+from tpu_hpc.obs.live import (
+    ENV_FLEET_PROM_FILE,
+    Rollup,
+    fleet_prometheus_text,
+    format_scoreboard,
+    rollup_from_dir,
+    stale_entries,
+)
+from tpu_hpc.obs.live import main as live_main
+from tpu_hpc.obs.regress import main as regress_main
+from tpu_hpc.obs.regress import report_metrics
+from tpu_hpc.obs.report import build_report, format_report
+from tpu_hpc.obs.schema import load_records
+from tpu_hpc.obs.slo import BurnRateMonitor
+from tpu_hpc.serve import PagedConfig, ServeConfig
+from tpu_hpc.serve.fleet import (
+    FleetConfig,
+    FleetHarness,
+    LiveConfig,
+    build_fleet_engines,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = llama2.LlamaConfig(
+    dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+    multiple_of=16, max_seq_len=64, dtype=jnp.float32,
+)
+SERVE = ServeConfig(slots=4, max_seq_len=48, prefill_buckets=(8, 16))
+PAGED = PagedConfig(block_size=4, num_blocks=48, prefill_chunk=8)
+N_REPLICAS = 4
+
+
+# ---------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------
+def _exact_q(vals, q):
+    """Exact nearest-rank quantile -- the reference the sketch's
+    alpha bound is judged against."""
+    s = sorted(vals)
+    return s[max(0, math.ceil(q * len(s)) - 1)]
+
+
+def _assert_sketch_equal(a: LogBucketSketch, b: LogBucketSketch):
+    """Merge-order equality: buckets/counts/min/max are exact; ``sum``
+    is a float accumulated in merge order, so it is compared to
+    tolerance, never bit-exactly."""
+    da, db = a.to_dict(), b.to_dict()
+    sa, sb = da.pop("sum"), db.pop("sum")
+    assert da == db
+    assert sa == pytest.approx(sb, rel=1e-9)
+
+
+def _digest(role, key, seq, t, counters=None, gauges=None, hists=None,
+            host="h0", pid=1, **extra):
+    rec = {
+        "event": "health_digest", "role": role, "key": str(key),
+        "seq": seq, "t": t, "host": host, "pid": pid,
+        "counters": counters or {}, "gauges": gauges or {},
+        "hists": {k: v.to_dict() for k, v in (hists or {}).items()},
+        "alpha": DEFAULT_ALPHA,
+    }
+    rec.update(extra)
+    return rec
+
+
+# ---------------------------------------------------------------------
+# LogBucketSketch: the alpha bound + the merge algebra
+# ---------------------------------------------------------------------
+class TestSketch:
+    def test_quantiles_within_alpha_of_exact(self):
+        rng = random.Random(11)
+        vals = [rng.lognormvariate(0.0, 2.0) for _ in range(5000)]
+        sk = LogBucketSketch()
+        for v in vals:
+            sk.add(v)
+        for q in (0.01, 0.1, 0.5, 0.9, 0.95, 0.99, 0.999):
+            exact = _exact_q(vals, q)
+            est = sk.quantile(q)
+            assert abs(est - exact) / exact <= DEFAULT_ALPHA + 1e-9, q
+
+    def test_merged_quantiles_keep_the_bound(self):
+        """The headline property: quantiles of the UNION of streams,
+        computed from merged sketches, hold the same alpha bound --
+        sample-window histograms cannot do this."""
+        rng = random.Random(12)
+        streams = [
+            [rng.lognormvariate(0.0, 1.5) for _ in range(2000)],
+            [rng.uniform(0.1, 100.0) for _ in range(3000)],
+            [rng.expovariate(0.02) + 1e-6 for _ in range(1000)],
+        ]
+        merged = LogBucketSketch()
+        for s in streams:
+            sk = LogBucketSketch()
+            for v in s:
+                sk.add(v)
+            merged.merge(sk)
+        union = [v for s in streams for v in s]
+        assert merged.count == len(union)
+        for q in (0.5, 0.9, 0.99, 0.999):
+            exact = _exact_q(union, q)
+            assert abs(merged.quantile(q) - exact) / exact \
+                <= DEFAULT_ALPHA + 1e-9, q
+
+    def test_merge_commutative_associative_random_interleavings(self):
+        rng = random.Random(13)
+        parts = []
+        for _ in range(6):
+            sk = LogBucketSketch()
+            for _ in range(rng.randint(1, 400)):
+                sk.add(rng.lognormvariate(0.0, 2.0))
+            parts.append(sk)
+
+        def merge_order(order):
+            out = LogBucketSketch()
+            for i in order:
+                out.merge(LogBucketSketch.from_dict(parts[i].to_dict()))
+            return out
+
+        ref = merge_order(range(len(parts)))
+        for _ in range(5):
+            order = list(range(len(parts)))
+            rng.shuffle(order)
+            _assert_sketch_equal(ref, merge_order(order))
+        # Associativity: (a+b)+c == a+(b+c), via pairwise grouping.
+        a, b, c = (
+            LogBucketSketch.from_dict(parts[i].to_dict())
+            for i in range(3)
+        )
+        left = a.merge(b).merge(c)
+        a2, b2, c2 = (
+            LogBucketSketch.from_dict(parts[i].to_dict())
+            for i in range(3)
+        )
+        right = a2.merge(b2.merge(c2))
+        _assert_sketch_equal(left, right)
+
+    def test_merge_alpha_mismatch_raises(self):
+        with pytest.raises(ValueError, match="alpha"):
+            LogBucketSketch(0.01).merge(LogBucketSketch(0.02))
+
+    def test_zero_and_negative_clamp(self):
+        sk = LogBucketSketch()
+        sk.add(0.0)
+        sk.add(-3.0)
+        sk.add(1e-15)
+        assert sk.zero == 3 and sk.count == 3 and not sk.buckets
+        assert sk.quantile(0.5) == 0.0
+
+    def test_wire_roundtrip_is_lossless(self):
+        rng = random.Random(14)
+        sk = LogBucketSketch()
+        for _ in range(1000):
+            sk.add(rng.lognormvariate(1.0, 1.0))
+        rt = LogBucketSketch.from_dict(
+            json.loads(json.dumps(sk.to_dict()))
+        )
+        assert rt.to_dict() == sk.to_dict()
+        assert rt.summary() == sk.summary()
+
+    def test_empty_summary(self):
+        s = LogBucketSketch().summary()
+        assert s["count"] == 0 and s["p999"] == 0.0
+
+    def test_merge_digest_hists(self):
+        a, b = LogBucketSketch(), LogBucketSketch()
+        a.add(1.0), b.add(100.0)
+        out = merge_digest_hists([
+            {"hists": {"x_ms": a.to_dict()}},
+            {"hists": {"x_ms": b.to_dict()}},
+        ])
+        assert out["x_ms"].count == 2
+
+
+# ---------------------------------------------------------------------
+# Rollup: idempotent, order-free, mergeable
+# ---------------------------------------------------------------------
+class TestRollup:
+    def _records(self):
+        sk = LogBucketSketch()
+        sk.add(8.0, n=10)
+        recs = []
+        for key in ("0", "1", "2"):
+            for seq in range(3):
+                recs.append(_digest(
+                    "replica", key, seq, 0.1 * (seq + 1),
+                    counters={"ticks": 10.0 * (seq + 1)},
+                    gauges={"occupancy": 0.5},
+                    hists={"tick_ms": sk}, step_s=0.008,
+                ))
+        return recs
+
+    def test_ingest_idempotent_and_order_free(self):
+        recs = self._records()
+        ref = Rollup().ingest(recs).build(now=0.3)
+        rng = random.Random(15)
+        for _ in range(5):
+            shuffled = recs + recs[::2]  # duplicates too
+            rng.shuffle(shuffled)
+            got = Rollup().ingest(shuffled).build(now=0.3)
+            # The digest COUNT sees the duplicates; the VIEW must not.
+            ref.pop("digests", None), got.pop("digests", None)
+            assert got == ref
+
+    def test_stale_record_never_replaces_newer_seq(self):
+        recs = self._records()
+        roll = Rollup().ingest(recs)
+        view1 = roll.build(now=0.3)
+        roll.ingest([recs[0]])  # seq 0 replay after seq 2 seen
+        view2 = roll.build(now=0.3)
+        view1.pop("digests"), view2.pop("digests")
+        assert view1 == view2
+
+    def test_merge_two_partial_rollups_converges(self):
+        recs = self._records()
+        ref = Rollup().ingest(recs).build(now=0.3)
+        a = Rollup().ingest(recs[:5])
+        b = Rollup().ingest(recs[3:])  # overlapping coverage
+        got = a.merge(b).build(now=0.3)
+        ref.pop("digests"), got.pop("digests")
+        assert got == ref
+
+    def test_restarted_pid_counters_sum(self):
+        """A restarted process (new pid) is a NEW source: its
+        cumulative counters SUM with its predecessor's final totals
+        instead of replacing them."""
+        recs = [
+            _digest("host", "0", 5, 1.0, counters={"steps": 50.0},
+                    pid=100),
+            _digest("host", "0", 0, 2.0, counters={"steps": 7.0},
+                    pid=200),
+        ]
+        view = Rollup().ingest(recs).build(now=2.0)
+        row = view["roles"]["host"]["keys"]["0"]
+        assert row["counters"]["steps"] == 57.0
+        assert row["sources"] == 2
+
+    def test_straggler_self_excluded_strict_and_needs_peers(self):
+        def view_for(signals, factor=3.0):
+            recs = [
+                _digest("stage", str(i), 0, 1.0, step_s=s)
+                for i, s in enumerate(signals)
+            ]
+            return Rollup(
+                stale_after_s=10.0, straggler_factor=factor
+            ).ingest(recs).build(now=1.0)
+
+        # 4x the peer median: flagged.
+        assert view_for([0.008, 0.008, 0.008, 0.032])["stragglers"] \
+            == ["stage:3"]
+        # EXACTLY factor x median: strict >, not flagged.
+        assert view_for([0.01, 0.01, 0.01, 0.03])["stragglers"] == []
+        # Two members: either could be the slow one -- never flagged.
+        assert view_for([0.008, 0.8])["stragglers"] == []
+        # Self-exclusion: the straggler must not drag the median.
+        v = view_for([0.008, 0.009, 0.01, 0.09])
+        assert v["stragglers"] == ["stage:3"]
+
+    def test_stale_flag_and_entries(self):
+        recs = [
+            _digest("replica", "0", 9, 10.0),
+            _digest("replica", "1", 4, 3.0),
+        ]
+        view = Rollup(stale_after_s=2.0).ingest(recs).build(now=10.0)
+        assert view["stale"] == ["replica:1"]
+        assert not view["roles"]["replica"]["keys"]["0"]["stale"]
+        (e,) = stale_entries(view)
+        assert e["role"] == "replica" and e["key"] == "1"
+        assert e["age_s"] == 7.0 and e["last_seq"] == 4
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="stale_after_s"):
+            Rollup(stale_after_s=0.0)
+        with pytest.raises(ValueError, match="straggler_factor"):
+            Rollup(straggler_factor=1.0)
+
+    def test_prometheus_text_and_scoreboard(self):
+        sk = LogBucketSketch()
+        sk.add(8.0, n=100)
+        recs = [
+            _digest("replica", "0", 0, 1.0,
+                    counters={"slo_good": 90.0, "slo_bad": 10.0},
+                    gauges={"occupancy": 0.7},
+                    hists={"tick_ms": sk}, step_s=0.008),
+        ]
+        view = Rollup().ingest(recs).build(now=1.0)
+        text = fleet_prometheus_text(view)
+        assert 'tpu_hpc_fleet_slo_good{role="replica",key="0"} 90.0' \
+            in text
+        assert 'quantile="0.999"' in text
+        assert "tpu_hpc_fleet_slo_attainment 0.9" in text
+        board = format_scoreboard(view)
+        assert "replica" in board and "SLO: attainment 0.9000" in board
+
+
+# ---------------------------------------------------------------------
+# BurnRateMonitor: two windows, one page
+# ---------------------------------------------------------------------
+class _StubCapture:
+    def __init__(self):
+        self.calls = []
+
+    def trigger(self, reason, trace_id=None, step=None, sink=None,
+                arm_profiler=True):
+        self.calls.append((reason, trace_id, arm_profiler))
+
+
+@pytest.fixture()
+def scoped_obs(tmp_path):
+    bus = obs.EventBus(path=None, run_id="fleet-test",
+                       flight_dir=str(tmp_path))
+    reg = obs.MetricsRegistry()
+    prev_bus, prev_reg = obs.set_bus(bus), obs.set_registry(reg)
+    yield bus, reg
+    obs.set_bus(prev_bus)
+    obs.set_registry(prev_reg)
+
+
+class TestBurnRate:
+    def _mon(self, **kw):
+        kw.setdefault("target", 0.99)
+        kw.setdefault("fast_window_s", 5.0)
+        kw.setdefault("slow_window_s", 50.0)
+        kw.setdefault("threshold", 10.0)
+        return BurnRateMonitor(**kw)
+
+    def test_fires_exactly_once_on_sustained_breach(self, scoped_obs):
+        cap = _StubCapture()
+        mon = self._mon()
+        fired = []
+        good = bad = 0.0
+        for t in range(0, 120):
+            good += 8.0
+            bad += 2.0  # 20% error rate = burn 20 vs threshold 10
+            rec = mon.observe(
+                float(t), good, bad, trace_id="fleet-test:slo:x",
+                capture=cap,
+            )
+            if rec:
+                fired.append((t, rec))
+        assert len(fired) == 1
+        t_fire, rec = fired[0]
+        # Fires at the FIRST sample where the slow window is covered
+        # (t=50), never earlier -- no cold-start page.
+        assert t_fire == 50
+        assert rec["event"] == "slo_burn"
+        assert rec["burn_fast"] >= 10 and rec["burn_slow"] >= 10
+        assert rec["trace_id"] == "fleet-test:slo:x"
+        assert mon.burns == 1 and mon.fired
+        assert cap.calls == [("slo_burn", "fleet-test:slo:x", False)]
+        # rearm: the next sustained burn may page again.
+        mon.rearm()
+        good += 8.0
+        bad += 2.0
+        assert mon.observe(120.0, good, bad) is not None
+
+    def test_never_fires_on_clean_replay(self, scoped_obs):
+        mon = self._mon()
+        good = 0.0
+        for t in range(0, 200):
+            good += 10.0
+            assert mon.observe(float(t), good, 0.0) is None
+        assert mon.burns == 0 and not mon.fired
+        assert mon.budget_remaining() == pytest.approx(1.0)
+
+    def test_fast_spike_alone_does_not_page(self, scoped_obs):
+        """One bad burst trips the fast window but not the slow one:
+        no page -- the multi-window construction's whole point."""
+        mon = self._mon()
+        good = bad = 0.0
+        for t in range(0, 100):
+            if 60 <= t < 63:
+                bad += 10.0  # 100% errors for 3s of a 50s window
+            else:
+                good += 10.0
+            assert mon.observe(float(t), good, bad) is None, t
+        assert mon.burns == 0
+
+    def test_slow_window_coverage_gates_cold_start(self, scoped_obs):
+        mon = self._mon()
+        bad = 0.0
+        for t in range(0, 50):  # all errors, but slow window uncovered
+            bad += 10.0
+            assert mon.observe(float(t), 0.0, bad) is None, t
+
+    def test_time_backwards_raises(self, scoped_obs):
+        mon = self._mon()
+        mon.observe(10.0, 1.0, 0.0)
+        with pytest.raises(ValueError, match="backwards"):
+            mon.observe(9.0, 2.0, 0.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="target"):
+            BurnRateMonitor(target=1.0)
+        with pytest.raises(ValueError, match="slow_window_s"):
+            BurnRateMonitor(fast_window_s=10.0, slow_window_s=5.0)
+        with pytest.raises(ValueError, match="threshold"):
+            BurnRateMonitor(threshold=0.0)
+
+
+# ---------------------------------------------------------------------
+# DigestPublisher: channels, env gating, the registry backend
+# ---------------------------------------------------------------------
+class TestDigestPublisher:
+    def test_channel_names_never_clobber(self, tmp_path, scoped_obs):
+        p1 = DigestPublisher(str(tmp_path), "replica", "0")
+        p1.publish(t=1.0)
+        p2 = DigestPublisher(str(tmp_path), "replica", "0")
+        p2.publish(t=2.0)
+        assert p1.path != p2.path
+        assert os.path.exists(p1.path) and os.path.exists(p2.path)
+        # Both channels' records surface in a directory read.
+        recs = read_digest_dir(str(tmp_path))
+        assert len(recs) == 2
+
+    def test_from_env_unset_is_none(self, monkeypatch):
+        monkeypatch.delenv(ENV_DIGEST_DIR, raising=False)
+        assert DigestPublisher.from_env(role="host", key="0") is None
+
+    def test_due_rate_limits(self, tmp_path):
+        pub = DigestPublisher(
+            str(tmp_path), "host", "0", period_s=1.0
+        )
+        assert pub.due(0.0)
+        pub.last_publish_t = 0.0
+        assert not pub.due(0.5)
+        assert pub.due(1.0)
+
+    def test_publish_registry_uses_sketch_backend(
+        self, tmp_path, scoped_obs
+    ):
+        """The Trainer's per-host path: counters/gauges verbatim, the
+        histograms from the registry's mergeable sketch backend, and
+        the publish cost banked into obs.digest_publish_ms."""
+        _, reg = scoped_obs
+        reg.inc("steps_total", 12)
+        reg.set_gauge("lr", 0.001)
+        for v in (1.0, 2.0, 4.0, 8.0):
+            reg.observe("step_ms", v)
+        pub = DigestPublisher(str(tmp_path), "host", "0")
+        rec = pub.publish_registry(t=5.0, step_s=0.1, step=12)
+        assert rec["counters"]["steps_total"] == 12.0
+        assert rec["gauges"]["lr"] == 0.001
+        assert rec["hists"]["step_ms"]["count"] == 4
+        sk = LogBucketSketch.from_dict(rec["hists"]["step_ms"])
+        assert sk.quantile(0.999) == pytest.approx(8.0, rel=0.01)
+        # The channel file holds the byte-identical record.
+        (on_disk,) = read_channel(pub.path)
+        assert on_disk == rec
+        # The plane's own overhead is metered on the registry...
+        snap = reg.snapshot()
+        assert snap["histograms"]["obs.digest_publish_ms"]["count"] >= 1
+        # ...and the sketch backend surfaces p99.9 in the textfile.
+        assert 'quantile="0.999"' in reg.prometheus_text()
+
+    def test_sketch_snapshot_is_isolated(self, scoped_obs):
+        _, reg = scoped_obs
+        reg.observe("x_ms", 1.0)
+        snap = reg.sketch_snapshot()
+        snap["x_ms"].add(99.0)
+        assert reg.sketch_snapshot()["x_ms"].count == 1
+
+    def test_read_channel_fails_loudly_on_torn_json(self, tmp_path):
+        p = tmp_path / "digest.host.0.pid1.jsonl"
+        p.write_text('{"event": "health_digest"}\n{torn\n')
+        with pytest.raises(ValueError, match="not JSON"):
+            read_channel(str(p))
+
+
+# ---------------------------------------------------------------------
+# the fleet acceptance: straggler + burn + capture, deterministically
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def live_params():
+    return llama2.init_llama(jax.random.key(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def live_engines(live_params, devices):
+    engines = build_fleet_engines(
+        live_params, TINY, SERVE, PAGED, N_REPLICAS
+    )
+    for e in engines:
+        e._params0 = e.params
+    return engines
+
+
+@pytest.fixture()
+def engines(live_engines):
+    for e in live_engines:
+        e.reset_pool(force=True)
+        if e.params is not e._params0:
+            e.swap_params(e._params0)
+    return live_engines
+
+
+def _scenario(n=96, rate=240.0):
+    return build_scenario(
+        "diurnal", seed=7, n_requests=n, vocab_size=TINY.vocab_size,
+        max_prompt=16, max_new=6, rate_per_s=rate,
+    )
+
+
+def _fleet_run(engines, tmp_path, monkeypatch, *, faults, live):
+    digest_dir = str(tmp_path / "digests")
+    monkeypatch.setenv(ENV_DIGEST_DIR, digest_dir)
+    metrics_path = str(tmp_path / "run.jsonl")
+    capture = obs.AnomalyCapture(profile_dir=str(tmp_path / "prof"))
+    harness = FleetHarness(
+        engines, _scenario(),
+        FleetConfig(initial_replicas=N_REPLICAS,
+                    min_replicas=N_REPLICAS,
+                    max_replicas=N_REPLICAS),
+        metrics_path=metrics_path,
+        faults=parse_faults(faults),
+        live_cfg=live, capture=capture,
+    )
+    n0 = harness.fleet.compile_count_total()
+    summary = harness.run(n_devices=jax.device_count())
+    summary["_recompiles"] = (
+        harness.fleet.compile_count_total() - n0
+    )
+    return summary, harness, digest_dir, metrics_path
+
+
+class TestFleetLiveAcceptance:
+    def test_slow_replica_straggler_burn_and_capture(
+        self, engines, tmp_path, monkeypatch, scoped_obs, capsys
+    ):
+        """The ISSUE's acceptance run: 4 replicas on the virtual
+        clock, replica 1 slowed 4x. The rollup names it as the
+        straggler, the fleet SLO burns exactly once, the burn arms
+        exactly one capture bundle correlated by trace_id, zero
+        recompiles -- and the external CLI reader of the same channel
+        directory reproduces the harness's own rollup exactly."""
+        prom_path = str(tmp_path / "fleet.prom")
+        monkeypatch.setenv(ENV_FLEET_PROM_FILE, prom_path)
+        live = LiveConfig(
+            period_s=0.02, itl_slo_ms=16.0, slo_target=0.99,
+            fast_window_s=0.1, slow_window_s=0.4, burn_threshold=5.0,
+            stale_after_s=30.0, straggler_factor=3.0,
+        )
+        summary, harness, digest_dir, metrics_path = _fleet_run(
+            engines, tmp_path, monkeypatch,
+            faults="slow_replica=1:4", live=live,
+        )
+        assert summary["_recompiles"] == 0
+
+        lv = summary["live"]
+        assert lv["stragglers"] == ["replica:1"]
+        assert lv["stale_keys"] == [] and lv["digest_stale"] == 0
+        assert lv["slo_burns"] == 1
+        assert 0.0 < lv["slo_attainment"] < 1.0
+        assert lv["slo_bad"] > 0
+        assert lv["trace_id"] == "fleet-test:slo:diurnal"
+        assert lv["digests"] >= N_REPLICAS
+
+        # Exactly one slo_burn, exactly one capture bundle, one
+        # correlated story: all three join on the trace_id.
+        events = load_records(metrics_path, validate=True)
+        burns = [e for e in events if e["event"] == "slo_burn"]
+        caps = [e for e in events if e["event"] == "capture_triggered"]
+        assert len(burns) == 1 and len(caps) == 1
+        assert burns[0]["trace_id"] == lv["trace_id"]
+        assert caps[0]["trace_id"] == lv["trace_id"]
+        assert caps[0]["reason"] == "slo_burn"
+        assert burns[0]["burn_fast"] >= live.burn_threshold
+        assert burns[0]["burn_slow"] >= live.burn_threshold
+        assert not [e for e in events if e["event"] == "digest_stale"]
+
+        # The driver contract: the external reader over the same
+        # channel directory, same knobs, reproduces the harness's own
+        # final rollup EXACTLY -- and twice in a row, byte-identically.
+        cli = [
+            digest_dir, "--json", "--now", str(harness.wall),
+            "--stale-after", str(live.stale_after_s),
+            "--straggler-factor", str(live.straggler_factor),
+        ]
+        assert live_main(cli) == 0
+        out1 = capsys.readouterr().out
+        assert live_main(cli) == 0
+        out2 = capsys.readouterr().out
+        assert out1 == out2
+        view = json.loads(out1)
+        assert view == harness.telemetry.last_view
+        assert view["stragglers"] == ["replica:1"]
+        assert view["roles"]["replica"]["keys"]["1"]["straggler"]
+        assert view["slo"]["attainment"] == lv["slo_attainment"]
+
+        # The fleet-merged Prometheus textfile (finalize writes it
+        # through $TPU_HPC_FLEET_PROM_FILE).
+        prom = open(prom_path).read()
+        assert 'tpu_hpc_fleet_straggler{role="replica",key="1"} 1' \
+            in prom
+        assert 'tpu_hpc_fleet_straggler{role="replica",key="0"} 0' \
+            in prom
+        assert "tpu_hpc_fleet_slo_attainment" in prom
+
+        # Report + regress ride the same run log: the Fleet rollup
+        # section renders, and the gate sees the verdict counters.
+        rep = build_report(events)
+        assert rep["live"]["slo_burns"] == 1
+        assert rep["live"]["stragglers"] == ["replica:1"]
+        assert "Fleet rollup" in format_report(rep)
+        flat = report_metrics(rep)
+        assert flat["slo.burns"] == 1.0
+        assert flat["live.stragglers"] == 1.0
+        assert flat["live.digest_stale"] == 0.0
+
+    def test_killed_replica_goes_digest_stale(
+        self, engines, tmp_path, monkeypatch, scoped_obs
+    ):
+        """digest_stale is non-vacuous: a replica silenced mid-run
+        stops publishing and the aggregation flags exactly that key,
+        exactly once -- and once the PR-14 restart brings the replica
+        back and it publishes again, the LIVE verdict clears (stale is
+        a live condition; the event is the incident record). The same
+        run's healthy SLO never pages (the monitor's clean-replay
+        side, fleet-path edition)."""
+        live = LiveConfig(
+            period_s=0.02, itl_slo_ms=100.0, slo_target=0.99,
+            fast_window_s=0.1, slow_window_s=0.4, burn_threshold=5.0,
+            stale_after_s=0.25, straggler_factor=3.0,
+        )
+        summary, harness, digest_dir, metrics_path = _fleet_run(
+            engines, tmp_path, monkeypatch,
+            faults="replica_kill_at=12", live=live,
+        )
+        lv = summary["live"]
+        assert lv["digest_stale"] == 1
+        assert lv["slo_burns"] == 0 and lv["stragglers"] == []
+        # The killed replica restarted (jittered backoff) and resumed
+        # publishing, so the FINAL rollup is clean again.
+        assert lv["stale_keys"] == []
+
+        events = load_records(metrics_path, validate=True)
+        stale = [e for e in events if e["event"] == "digest_stale"]
+        assert len(stale) == 1  # flagged once, not re-spammed per tick
+        assert stale[0]["age_s"] > live.stale_after_s
+        # The flagged key is the replica the health monitor lost.
+        (down,) = [e for e in events if e["event"] == "replica_down"]
+        assert stale[0]["key"] == str(down["replica"])
+        assert not [e for e in events if e["event"] == "slo_burn"]
+        assert not [
+            e for e in events if e["event"] == "capture_triggered"
+        ]
+
+    def test_live_cfg_without_env_refuses(
+        self, engines, monkeypatch, scoped_obs
+    ):
+        monkeypatch.delenv(ENV_DIGEST_DIR, raising=False)
+        with pytest.raises(ValueError, match="TPU_HPC_DIGEST_DIR"):
+            FleetHarness(
+                engines, _scenario(), FleetConfig(
+                    initial_replicas=N_REPLICAS,
+                    min_replicas=N_REPLICAS,
+                    max_replicas=N_REPLICAS,
+                ),
+                live_cfg=LiveConfig(),
+            )
+
+
+# ---------------------------------------------------------------------
+# CLI contract + the banked rows
+# ---------------------------------------------------------------------
+class TestLiveCli:
+    def test_no_dir_exits_2(self, monkeypatch, capsys):
+        monkeypatch.delenv(ENV_DIGEST_DIR, raising=False)
+        assert live_main(["--json"]) == 2
+        assert "no digest dir" in capsys.readouterr().err
+
+    def test_empty_dir_exits_2(self, tmp_path, capsys):
+        assert live_main([str(tmp_path), "--json"]) == 2
+        assert "no health digests" in capsys.readouterr().err
+
+    def test_scoreboard_default_output(
+        self, tmp_path, scoped_obs, capsys
+    ):
+        pub = DigestPublisher(str(tmp_path), "replica", "0")
+        pub.publish(t=1.0, counters={"ticks": 5.0})
+        assert live_main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "fleet rollup" in out and "replica" in out
+
+    def test_prom_flag_writes_textfile(
+        self, tmp_path, scoped_obs, capsys
+    ):
+        pub = DigestPublisher(str(tmp_path / "d"), "replica", "0")
+        pub.publish(t=1.0, counters={"ticks": 5.0})
+        prom = tmp_path / "fleet.prom"
+        assert live_main(
+            [str(tmp_path / "d"), "--json", "--prom", str(prom)]
+        ) == 0
+        assert "tpu_hpc_fleet_ticks" in prom.read_text()
+        capsys.readouterr()
+
+    def test_bench_rows_are_valid_and_inside_the_bound(
+        self, tmp_path, scoped_obs, capsys
+    ):
+        out = tmp_path / "bench.jsonl"
+        assert live_main(["--bench", str(out)]) == 0
+        capsys.readouterr()
+        rows = load_records(str(out), validate=True)
+        by_metric = {r["metric"]: r for r in rows}
+        assert by_metric["obs.digest_publish_ms"]["value"] > 0
+        # The measured merged-quantile error must sit under the
+        # pinned alpha bound -- the sketch's contract, measured.
+        assert by_metric["obs.digest_quantile_rel_err"]["value"] \
+            <= DEFAULT_ALPHA
+
+    def test_committed_live_rows_pass_the_bank_gate(self, capsys):
+        """CI leg of the acceptance: the committed BENCH_LIVE rows
+        are schema-valid and pass ``regress --bank`` against the
+        committed history."""
+        hist = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+        rows = os.path.join(REPO, "BENCH_LIVE_r19.jsonl")
+        recs = load_records(rows, validate=True)
+        metrics = {r["metric"] for r in recs}
+        assert "obs.digest_publish_ms" in metrics
+        assert "obs.digest_quantile_rel_err" in metrics
+        rc = regress_main([hist, rows, "--bank"])
+        assert rc == 0, capsys.readouterr().out

@@ -88,6 +88,18 @@ class TestCompare:
         assert lower_is_better(f"{tiered}.kv_spill_wire_bytes")
         assert lower_is_better(f"{tiered}.kv_refill_wire_bytes")
         assert not lower_is_better(f"{tiered}.resident_sessions")
+        # Live telemetry plane (obs/digest, obs/live, obs/slo): burn
+        # pages, stale publishers, flagged stragglers, and the banked
+        # sketch quantile error all regress UPWARD; slo_attainment and
+        # budget_remaining regress by DROPPING -- higher-is-better by
+        # deliberate token absence, like prefix_hit_rate.
+        assert lower_is_better("slo.burns")
+        assert lower_is_better("live.digest_stale")
+        assert lower_is_better("live.stragglers")
+        assert lower_is_better("obs.digest_quantile_rel_err")
+        assert lower_is_better("obs.digest_publish_ms")
+        assert not lower_is_better("slo.slo_attainment")
+        assert not lower_is_better("slo.budget_remaining")
 
     def test_spec_config_fields_not_compared(self):
         """spec_k is config; drafted/accepted/rejected/verify_steps
@@ -107,6 +119,41 @@ class TestCompare:
         assert flat == {
             "serve.acceptance_rate": 0.9,
             "serve.draft_ms": 2.5,
+        }
+
+    def test_live_plane_flattening(self):
+        """The report's live block flattens to the judged verdict
+        counters (stale/straggler/burn counts, attainment, budget);
+        the per-role tables and digest counts are identity detail
+        the gate must not diff."""
+        flat = report_metrics({
+            "live": {
+                "digests": 120, "digest_stale": 1,
+                "stragglers": ["replica:2"], "slo_burns": 1,
+                "slo_attainment": 0.93, "budget_remaining": -5.2,
+                "roles": {"replica": {"keys": {}}},
+            },
+        })
+        assert flat == {
+            "live.digest_stale": 1.0,
+            "live.stragglers": 1.0,
+            "slo.burns": 1.0,
+            "slo.slo_attainment": 0.93,
+            "slo.budget_remaining": -5.2,
+        }
+        # None attainment (no SLO traffic): the optional leaves stay
+        # absent instead of becoming NaN-ish zeros.
+        flat = report_metrics({
+            "live": {
+                "digests": 3, "digest_stale": 0, "stragglers": [],
+                "slo_burns": 0, "slo_attainment": None,
+                "budget_remaining": None,
+            },
+        })
+        assert flat == {
+            "live.digest_stale": 0.0,
+            "live.stragglers": 0.0,
+            "slo.burns": 0.0,
         }
 
     def test_paged_config_fields_not_compared(self):

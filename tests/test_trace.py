@@ -233,11 +233,54 @@ class TestSchemaKinds:
             "profile_dir": "/p", "flight_path": "/f",
         })
 
+    def test_health_digest_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, {
+            "event": "health_digest", "role": "replica", "key": "2",
+            "t": 0.35, "seq": 6, "counters": {"ticks": 40.0},
+            "gauges": {"occupancy": 0.5},
+            "hists": {"tick_ms": {"alpha": 0.01, "count": 0,
+                                  "sum": 0.0, "zero": 0,
+                                  "buckets": {}}},
+            "alpha": 0.01, "step_s": 0.008, "watermark_s": 0.009,
+            "period_s": 0.05,
+        })
+
+    def test_digest_stale_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, {
+            "event": "digest_stale", "role": "replica", "key": "1",
+            "age_s": 3.2, "stale_after_s": 2.0, "last_t": 0.4,
+            "last_seq": 7,
+        })
+
+    def test_slo_burn_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, {
+            "event": "slo_burn", "burn_fast": 12.0, "burn_slow": 10.5,
+            "threshold": 5.0, "budget": 0.01, "fast_window_s": 0.5,
+            "slow_window_s": 2.0, "error_rate_fast": 0.12,
+            "error_rate_slow": 0.105, "good": 300.0, "bad": 40.0,
+            "budget_remaining": -10.76, "reason": "fleet_itl_slo",
+            "t": 1.25, "trace_id": "r:slo:diurnal",
+        })
+
+    def test_live_plane_names_are_registered(self):
+        # The live-plane satellite: its kinds + span ride the same
+        # canonical tables the AST lint below walks -- pinned here so
+        # a schema refactor cannot drop them silently.
+        for kind in ("health_digest", "digest_stale", "slo_burn"):
+            assert kind in schema_mod.EVENTS, kind
+        assert "digest_publish" in schema_mod.SPANS
+
     def test_new_kinds_stay_closed(self):
         with pytest.raises(schema_mod.SchemaError, match="unknown"):
             validate_record(schema_mod.stamp({
                 "event": "trace_ctx", "trace_id": "a", "kind": "req",
                 "key": "k", "bogus": 1,
+            }))
+        with pytest.raises(schema_mod.SchemaError, match="unknown"):
+            validate_record(schema_mod.stamp({
+                "event": "slo_burn", "burn_fast": 1.0,
+                "burn_slow": 1.0, "threshold": 5.0, "budget": 0.01,
+                "bogus": 1,
             }))
 
 

@@ -24,6 +24,17 @@ schema-stamped JSONL discipline:
                anomaly-triggered capture (stall/guard/SLO trip ->
                bounded profiler trace + flight dump, keyed by
                trace_id).
+  digest.py    mergeable per-process health digests: cumulative
+               counters + gauges + log-bucket histogram sketches
+               (bounded relative error, associative merge), appended
+               to per-process channels under $TPU_HPC_DIGEST_DIR.
+  live.py      fleet rollup aggregator over the digest channels:
+               straggler/stale verdicts, ``python -m tpu_hpc.obs.live``
+               --json driver contract / --watch scoreboard, and the
+               fleet-merged Prometheus textfile.
+  slo.py       multi-window error-budget burn-rate monitor (fast AND
+               slow window must both burn to page) over the rollup's
+               fleet SLO totals; pages once, arms AnomalyCapture.
   report.py    ``python -m tpu_hpc.obs.report run.jsonl`` -- goodput /
                MFU / step-time-breakdown report from a run's JSONL.
   regress.py   ``python -m tpu_hpc.obs.regress base.jsonl cand.jsonl``
@@ -42,6 +53,20 @@ from tpu_hpc.obs.events import (  # noqa: F401
     get_bus,
     set_bus,
 )
+from tpu_hpc.obs.digest import (  # noqa: F401
+    ENV_DIGEST_DIR,
+    DigestPublisher,
+    LogBucketSketch,
+    read_digest_dir,
+)
+from tpu_hpc.obs.live import (  # noqa: F401
+    ENV_FLEET_PROM_FILE,
+    Rollup,
+    format_scoreboard,
+    rollup_from_dir,
+    stale_entries,
+    write_fleet_prometheus,
+)
 from tpu_hpc.obs.quantiles import quantile, summarize  # noqa: F401
 from tpu_hpc.obs.registry import (  # noqa: F401
     ENV_PROM_FILE,
@@ -56,6 +81,7 @@ from tpu_hpc.obs.schema import (  # noqa: F401
     validate_file,
     validate_record,
 )
+from tpu_hpc.obs.slo import BurnRateMonitor  # noqa: F401
 from tpu_hpc.obs.spans import emit_span, span  # noqa: F401
 from tpu_hpc.obs.stall import StallDetector  # noqa: F401
 
@@ -85,12 +111,18 @@ def __getattr__(name):
 
 __all__ = [
     "AnomalyCapture",
+    "BurnRateMonitor",
+    "DigestPublisher",
+    "ENV_DIGEST_DIR",
     "ENV_EVENTS",
+    "ENV_FLEET_PROM_FILE",
     "ENV_FLIGHT_DIR",
     "ENV_PROM_FILE",
     "ENV_RUN_ID",
     "EventBus",
+    "LogBucketSketch",
     "MetricsRegistry",
+    "Rollup",
     "SCHEMA_VERSION",
     "SchemaError",
     "StallDetector",
@@ -98,13 +130,17 @@ __all__ = [
     "activate",
     "dump_flight",
     "emit_span",
+    "format_scoreboard",
     "get_bus",
     "get_registry",
     "quantile",
+    "read_digest_dir",
     "request_trace_id",
+    "rollup_from_dir",
     "set_bus",
     "set_registry",
     "span",
+    "stale_entries",
     "stamp",
     "step_trace_id",
     "summarize",

@@ -28,6 +28,7 @@ import re
 import threading
 from typing import Deque, Dict, Optional
 
+from tpu_hpc.obs.digest import LogBucketSketch
 from tpu_hpc.obs.quantiles import quantile as _quantile
 
 ENV_PROM_FILE = "TPU_HPC_PROM_FILE"
@@ -46,13 +47,21 @@ class MetricsRegistry:
     histogram's sample memory (the summary is over the most recent
     window, which is what an operator alarming on p95 wants anyway)."""
 
-    def __init__(self, hist_window: int = 4096):
+    def __init__(
+        self, hist_window: int = 4096, sketch_alpha: float = 0.01,
+    ):
         if hist_window < 1:
             raise ValueError(f"hist_window {hist_window} must be >= 1")
         self.hist_window = hist_window
+        self.sketch_alpha = sketch_alpha
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Deque[float]] = {}
+        # Mergeable log-bucket sketches (obs/digest.py) fed alongside
+        # the sample windows: the window answers "recent p95 here",
+        # the sketch answers "fleet p99.9 across every process" --
+        # window quantiles cannot merge, sketch quantiles can.
+        self._sketches: Dict[str, "LogBucketSketch"] = {}
         self._help: Dict[str, str] = {}
         self._lock = threading.Lock()
 
@@ -97,13 +106,18 @@ class MetricsRegistry:
                 hist = self._hists[name] = collections.deque(
                     maxlen=self.hist_window
                 )
+                self._sketches[name] = LogBucketSketch(
+                    alpha=self.sketch_alpha
+                )
             hist.append(float(value))
+            self._sketches[name].add(float(value))
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._sketches.clear()
             self._help.clear()
 
     # -- reads ---------------------------------------------------------
@@ -126,7 +140,19 @@ class MetricsRegistry:
             "p50": _quantile(vals, 0.50),
             "p95": _quantile(vals, 0.95),
             "p99": _quantile(vals, 0.99),
+            "p999": _quantile(vals, 0.999),
         }
+
+    def sketch_snapshot(self) -> Dict[str, LogBucketSketch]:
+        """Copies of the mergeable sketches, one per histogram -- the
+        payload a DigestPublisher ships. Copies, not references: the
+        caller serializes outside the lock while producers keep
+        observing."""
+        with self._lock:
+            return {
+                n: LogBucketSketch.from_dict(sk.to_dict())
+                for n, sk in self._sketches.items()
+            }
 
     def snapshot(self) -> Dict[str, Dict]:
         with self._lock:
@@ -185,6 +211,7 @@ class MetricsRegistry:
                 f'{m}{{quantile="0.5"}} {s["p50"]}',
                 f'{m}{{quantile="0.95"}} {s["p95"]}',
                 f'{m}{{quantile="0.99"}} {s["p99"]}',
+                f'{m}{{quantile="0.999"}} {s["p999"]}',
                 f"{m}_sum {s['sum']}",
                 f"{m}_count {s['count']}",
             ]

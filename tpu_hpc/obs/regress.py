@@ -127,6 +127,14 @@ _LOWER_IS_BETTER = (
     # prefix_hit_rate it judges higher-is-better by absence: a tier
     # change that sheds returning sessions fails the gate.)
     "spill", "refill",
+    # Live telemetry plane (obs/digest, obs/live, obs/slo): more
+    # burn-rate pages, more publishers going stale, or more flagged
+    # stragglers at the same workload is a fleet-health regression;
+    # "rel_err" covers the banked sketch quantile error bound -- a
+    # sketch change that loosens the merge accuracy fails the gate.
+    # ("slo_attainment" and "budget_remaining" deliberately match NO
+    # token: higher-is-better by absence, like prefix_hit_rate.)
+    "burn", "stale", "straggler", "rel_err",
 )
 
 
@@ -264,6 +272,23 @@ def report_metrics(rep: dict) -> Dict[str, float]:
         # a run whose peak grew against baseline fails the gate even
         # while latency holds.
         flat["memory.hbm_peak_bytes"] = float(mem["hbm_peak_bytes"])
+    lv = rep.get("live")
+    if lv:
+        # The judged live-plane signals: stale publishers (lower via
+        # "stale"), flagged stragglers (lower via "straggler"),
+        # burn-rate pages (lower via "burn"), and SLO attainment /
+        # budget remaining (higher-is-better by token absence). The
+        # digest count and per-role tables are workload-size /
+        # identity detail the verdict counters already cover.
+        flat["live.digest_stale"] = float(lv["digest_stale"])
+        flat["live.stragglers"] = float(len(lv.get("stragglers", [])))
+        flat["slo.burns"] = float(lv["slo_burns"])
+        if lv.get("slo_attainment") is not None:
+            flat["slo.slo_attainment"] = float(lv["slo_attainment"])
+        if lv.get("budget_remaining") is not None:
+            flat["slo.budget_remaining"] = float(
+                lv["budget_remaining"]
+            )
     return flat
 
 

@@ -383,6 +383,69 @@ def _pipeline(records: Sequence[dict]) -> Optional[dict]:
     }
 
 
+def _live(records: Sequence[dict]) -> Optional[dict]:
+    """Live telemetry plane breakdown (obs/digest, obs/live, obs/slo):
+    the fleet-rollup verdicts -- per-role straggler/stale flags, SLO
+    attainment and error-budget remaining, burn-rate pages -- from
+    the ``health_digest``/``digest_stale``/``slo_burn`` records in
+    the log plus the closing summary's ``live`` block. The regress
+    gate's ``live.*``/``slo.*`` namespaces judge exactly these."""
+    digests = [
+        r for r in records if r.get("event") == "health_digest"
+    ]
+    stales = [r for r in records if r.get("event") == "digest_stale"]
+    burns = [r for r in records if r.get("event") == "slo_burn"]
+    summaries = [
+        r for r in records
+        if r.get("event") == "serve_summary" and "live" in r
+    ]
+    if not (digests or stales or burns or summaries):
+        return None
+    out: dict = {
+        "digests": len(digests),
+        "digest_stale": len(stales),
+        "stale_keys": sorted({
+            f"{r['role']}:{r['key']}" for r in stales
+        }),
+        "slo_burns": len(burns),
+        "stragglers": [],
+    }
+    if digests:
+        # Re-derive the per-role rollup from the digests the log
+        # holds -- same merge the live aggregator runs, so the
+        # post-hoc report and the live scoreboard cannot disagree.
+        from tpu_hpc.obs.live import Rollup
+
+        view = Rollup().ingest(digests).build()
+        out["roles"] = {
+            role: {
+                "keys": sorted(block["keys"]),
+                "stragglers": block["stragglers"],
+                "stale": block["stale"],
+                "counters": block["counters"],
+            }
+            for role, block in view["roles"].items()
+        }
+        out["stragglers"] = view["stragglers"]
+    if burns:
+        b = burns[-1]
+        out["burn_fast"] = b["burn_fast"]
+        out["burn_slow"] = b["burn_slow"]
+        out["burn_trace_id"] = b.get("trace_id")
+        if b.get("budget_remaining") is not None:
+            out["budget_remaining"] = b["budget_remaining"]
+    if summaries:
+        lv = summaries[-1]["live"]
+        for k in ("stragglers", "slo_attainment", "budget_remaining",
+                  "slo_good", "slo_bad", "digests"):
+            if lv.get(k) is not None:
+                out[k] = lv[k]
+        out["digest_stale"] = max(
+            out["digest_stale"], lv.get("digest_stale", 0) or 0
+        )
+    return out
+
+
 def _elastic(records: Sequence[dict]) -> Optional[dict]:
     """Topology-morph breakdown (tpu_hpc.elastic): the per-morph
     timeline plus the totals the regress gate's ``elastic.*``
@@ -550,6 +613,7 @@ def build_report(
         "fleet": _fleet(records),
         "pipeline": _pipeline(records),
         "elastic": _elastic(records),
+        "live": _live(records),
         "guard": _guard(records),
         "ckpt": _ckpt(records),
         "memory": _memory(records),
@@ -862,6 +926,58 @@ def format_report(rep: dict) -> str:
             f"- autoscale: {fl['scale_ups']} grow, "
             f"{fl['scale_downs']} shrink",
         ]
+    lv = rep.get("live")
+    if lv is not None:
+        lines += [
+            "",
+            "## Fleet rollup (live telemetry plane)",
+            "",
+            f"- {lv['digests']} health digest(s) merged; "
+            f"{lv['digest_stale']} publisher(s) went stale"
+            + (
+                f" ({', '.join(lv['stale_keys'])})"
+                if lv.get("stale_keys") else ""
+            ),
+        ]
+        if lv.get("roles"):
+            lines += [
+                "",
+                "| role | keys | stragglers | stale |",
+                "|---|---|---|---|",
+            ]
+            for role, block in sorted(lv["roles"].items()):
+                lines.append(
+                    f"| {role} | {len(block['keys'])} "
+                    f"| {', '.join(block['stragglers']) or '-'} "
+                    f"| {', '.join(block['stale']) or '-'} |"
+                )
+            lines.append("")
+        if lv.get("stragglers"):
+            lines.append(
+                f"- stragglers vs peer median: "
+                f"{', '.join(lv['stragglers'])}"
+            )
+        if lv.get("slo_attainment") is not None:
+            budget = lv.get("budget_remaining")
+            lines.append(
+                f"- SLO attainment {lv['slo_attainment']:.4f}"
+                + (
+                    f"; error budget remaining {budget:.1%}"
+                    if budget is not None else ""
+                )
+            )
+        if lv["slo_burns"]:
+            lines.append(
+                f"- {lv['slo_burns']} burn-rate page(s): fast burn "
+                f"{lv.get('burn_fast', '?')}x, slow burn "
+                f"{lv.get('burn_slow', '?')}x"
+                + (
+                    f" (trace {lv['burn_trace_id']})"
+                    if lv.get("burn_trace_id") else ""
+                )
+            )
+        else:
+            lines.append("- no burn-rate pages")
     return "\n".join(lines) + "\n"
 
 

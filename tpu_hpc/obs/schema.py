@@ -63,6 +63,8 @@ SPANS: Dict[str, str] = {
     "compute": "training forward/backward/update (fused chunk)",
     "data": "host-side batch generation (host-fed path only)",
     "decode": "one batched decode step (all slots)",
+    "digest_publish": "health-digest build + append to the per-process "
+                      "digest channel (obs/digest.py)",
     "elastic_reshard": "cross-topology restore reshard",
     "kv_transfer": "disagg prefill->decode KV hop",
     "morph": "live topology transition: quiesce -> reshard -> "
@@ -363,6 +365,37 @@ EVENTS: Dict[str, EventSpec] = {
     "pipeline_bubble": EventSpec(
         ("step", "bubble_fraction"),
         optional=("makespan_s", "straggler_stage"),
+    ),
+    # -- live telemetry plane (obs/digest.py, obs/live.py, obs/slo.py):
+    #    the fleet-wide merge layer. One health_digest per publisher
+    #    period -- cumulative counters, gauge snapshot, and mergeable
+    #    log-bucket histogram sketches (bounded relative error), keyed
+    #    by (role, key) so the aggregator can roll N replicas, S
+    #    stages, and H hosts into one fleet view. ``t`` is the
+    #    publisher's clock (virtual under the harnesses -- replays are
+    #    bit-identical), ``seq`` dedups re-reads of the same channel. --
+    "health_digest": EventSpec(
+        ("role", "key", "t", "counters", "gauges", "hists"),
+        optional=("step_s", "watermark_s", "period_s", "alpha"),
+    ),
+    # A publisher stopped publishing: the aggregator's first-class
+    # "absence of telemetry is itself a signal" record -- a wedged or
+    # dead process must not silently drop out of the rollup.
+    "digest_stale": EventSpec(
+        ("role", "key", "age_s"),
+        optional=("stale_after_s", "last_t", "last_seq"),
+    ),
+    # Multi-window error-budget burn (obs/slo.py): emitted once when
+    # BOTH the fast and slow windows burn past the threshold -- the
+    # page-worthy condition, wired to AnomalyCapture for one
+    # correlated evidence bundle.
+    "slo_burn": EventSpec(
+        ("burn_fast", "burn_slow", "threshold", "budget"),
+        optional=(
+            "fast_window_s", "slow_window_s", "error_rate_fast",
+            "error_rate_slow", "good", "bad", "budget_remaining",
+            "reason", "t",
+        ),
     ),
     # -- supervisor attempt log (resilience/supervisor.py) --
     "attempt_start": EventSpec(("attempt", "cmd")),

@@ -749,6 +749,12 @@ class MpmdPipeline:
         self.straggler_flags: Dict[int, int] = {}
         self.losses: List[List[float]] = []
         self._step_busy: Dict[int, float] = {}
+        # Live telemetry plane (obs/digest.py): per-stage digest
+        # publishers, built lazily at the first step close so the
+        # env contract is read when the pipeline RUNS, not when it is
+        # constructed. None entries = plane unarmed (free).
+        self._digest_pubs: Optional[List] = None
+        self._digest_state: List[dict] = []
 
     # -- bring-up ------------------------------------------------------
     def _bus(self):
@@ -1160,8 +1166,49 @@ class MpmdPipeline:
             makespan_s=round(makespan, 3),
             straggler_stage=straggler,
         )
+        self._publish_digests(step, bubble)
         self._inflight = {}
         return loss_vals
+
+    def _publish_digests(self, step: int, bubble: float) -> None:
+        """Per-stage health digests onto $TPU_HPC_DIGEST_DIR (opt-in,
+        obs/digest.py): the bubble fraction becomes a LIVE fleet-
+        rollup number keyed by stage instead of a post-hoc event scan,
+        and each stage's per-step busy time is the normalized signal
+        the rollup's cross-stage straggler comparison judges on --
+        all on the runtime's virtual clock, so replays publish
+        bit-identical digests."""
+        from tpu_hpc.obs.digest import DigestPublisher, LogBucketSketch
+
+        if self._digest_pubs is None:
+            self._digest_pubs = [
+                DigestPublisher.from_env(role="stage", key=str(s))
+                for s in range(len(self.workers))
+            ]
+            self._digest_state = [
+                {"sketch": LogBucketSketch()} for _ in self.workers
+            ]
+        for s, (pub, w) in enumerate(
+            zip(self._digest_pubs, self.workers)
+        ):
+            if pub is None:
+                continue
+            st = self._digest_state[s]
+            # busy_s is zeroed at every step start (train_step's
+            # worker reset), so it IS this step's busy time.
+            busy = w.busy_s
+            st["sketch"].add(busy * 1e3)
+            pub.publish(
+                counters={"steps": float(step + 1)},
+                gauges={
+                    "bubble_fraction": round(bubble, 4),
+                    "busy_s": round(w.busy_s, 6),
+                },
+                hists={"stage_busy_ms": st["sketch"]},
+                t=self.clock_s,
+                step_s=busy,
+                step=step,
+            )
 
     def _poisoned(
         self, sid: int, step: int, m: int, phase: str

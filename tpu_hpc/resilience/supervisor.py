@@ -296,6 +296,40 @@ class Supervisor:
             budget_burned=0,
         )
 
+    def _surface_rollup(self, attempt: int) -> None:
+        """Between attempts, surface what the live digest channels say
+        (obs/live.py): the fleet scoreboard on stderr next to the
+        attempt rows -- which host/stage was the straggler, who went
+        silent -- plus one schema-stamped ``digest_stale`` record per
+        publisher whose feed stopped, so the restart decision's
+        context rides supervisor.jsonl. Diagnostics: every failure is
+        swallowed (the dump_flight contract -- surfacing telemetry
+        must never turn a restart loop into a new crash)."""
+        from tpu_hpc.obs.digest import ENV_DIGEST_DIR
+
+        digest_dir = os.environ.get(ENV_DIGEST_DIR)
+        if not digest_dir:
+            return
+        try:
+            from tpu_hpc.obs.live import (
+                format_scoreboard,
+                rollup_from_dir,
+                stale_entries,
+            )
+
+            view = rollup_from_dir(digest_dir).build()
+            if not view["sources"]:
+                return
+            for line in format_scoreboard(view).splitlines():
+                print(f"supervisor: {line}", file=sys.stderr)
+            sys.stderr.flush()
+            for e in stale_entries(view):
+                self._event(
+                    event="digest_stale", attempt=attempt, **e
+                )
+        except Exception:
+            return
+
     # -- the loop -----------------------------------------------------
     def run(self) -> int:
         old = {}
@@ -331,6 +365,7 @@ class Supervisor:
                     log=log_path,
                 )
                 self._account_morphs(attempt)
+                self._surface_rollup(attempt)
                 if rc == 0:
                     return 0
                 if self._stop_requested:

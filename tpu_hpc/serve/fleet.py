@@ -64,12 +64,20 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import statistics
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
 from tpu_hpc.obs import StallDetector, get_bus, get_registry
+from tpu_hpc.obs.digest import (
+    ENV_DIGEST_DIR,
+    DigestPublisher,
+    LogBucketSketch,
+)
+from tpu_hpc.obs.live import Rollup, stale_entries, write_fleet_prometheus
+from tpu_hpc.obs.slo import BurnRateMonitor
 from tpu_hpc.serve.scheduler import (
     AdmissionPolicy,
     ContinuousBatcher,
@@ -1131,6 +1139,198 @@ def _flip_one_value(tree: Any) -> Any:
 # ---------------------------------------------------------------------
 
 
+@dataclasses.dataclass(frozen=True)
+class LiveConfig:
+    """Knobs for the harness-driven live telemetry plane (obs/digest,
+    obs/live, obs/slo). All times are VIRTUAL seconds -- the digest
+    plane rides the harness's discrete-event clock, so a replayed
+    scenario publishes bit-identical digests and the breach tests are
+    deterministic. ``itl_slo_ms`` is the per-decode-tick latency SLO
+    the slo_good/slo_bad counters are judged against;
+    ``slo_target``/``burn_threshold`` and the two windows parameterize
+    the BurnRateMonitor (fast AND slow must both burn to page)."""
+
+    period_s: float = 0.05
+    itl_slo_ms: float = 25.0
+    slo_target: float = 0.99
+    fast_window_s: float = 0.5
+    slow_window_s: float = 2.0
+    burn_threshold: float = 5.0
+    stale_after_s: float = 2.0
+    straggler_factor: float = 3.0
+
+
+class FleetTelemetry:
+    """The fleet's live-plane producer + in-process aggregator.
+
+    One :class:`~tpu_hpc.obs.digest.DigestPublisher` per replica
+    (role="replica", key=idx) publishing every ``period_s`` of virtual
+    wall: cumulative tick/SLO counters, the occupancy gauge, the
+    mergeable per-tick decode-latency sketch, and the replica's
+    StallDetector watermark (the normalized straggler signal). Each
+    published record is also folded straight into a local
+    :class:`~tpu_hpc.obs.live.Rollup` -- the harness aggregates what
+    an external ``python -m tpu_hpc.obs.live`` reader of the same
+    channel directory would see, byte-for-byte, and feeds the fleet
+    SLO totals to the :class:`~tpu_hpc.obs.slo.BurnRateMonitor`
+    (paging arms the PR-13 AnomalyCapture for one correlated evidence
+    bundle). A replica that stops ticking (killed, wedged) stops
+    publishing, and the aggregation step surfaces it as a first-class
+    ``digest_stale`` event exactly once."""
+
+    def __init__(
+        self,
+        dir: str,
+        cfg: Optional[LiveConfig] = None,
+        *,
+        metrics_path: Optional[str] = None,
+        capture=None,
+        run_key: str = "fleet",
+    ):
+        from tpu_hpc.obs import trace_id_for
+
+        self.dir = dir
+        self.cfg = cfg or LiveConfig()
+        self.metrics_path = metrics_path
+        self.capture = capture
+        self.rollup = Rollup(
+            stale_after_s=self.cfg.stale_after_s,
+            straggler_factor=self.cfg.straggler_factor,
+        )
+        self.monitor = BurnRateMonitor(
+            target=self.cfg.slo_target,
+            fast_window_s=self.cfg.fast_window_s,
+            slow_window_s=self.cfg.slow_window_s,
+            threshold=self.cfg.burn_threshold,
+        )
+        # One trace id for the whole fleet-SLO condition: the slo_burn
+        # record, the capture bundle, and the flight dump all join on
+        # it -- "the fleet burned its budget on scenario X" is one
+        # correlated story, not three unlinked files.
+        self.trace_id = trace_id_for("slo", run_key)
+        self._pubs: Dict[int, DigestPublisher] = {}
+        self._state: Dict[int, dict] = {}
+        self._stale_flagged: set = set()
+        self.digests = 0
+        self.stale_events = 0
+        self.last_view: Optional[dict] = None
+
+    def _replica_state(self, idx: int) -> dict:
+        st = self._state.get(idx)
+        if st is None:
+            st = self._state[idx] = {
+                "ticks": 0.0, "slo_good": 0.0, "slo_bad": 0.0,
+                "sketch": LogBucketSketch(),
+            }
+        return st
+
+    def on_tick(
+        self, r: "Replica", now: float, decoded: bool,
+        decode_dur_s: float, wall: float,
+    ) -> None:
+        """Fold one replica tick in; publish + aggregate when the
+        replica's digest period has elapsed on ITS timeline."""
+        st = self._replica_state(r.idx)
+        st["ticks"] += 1
+        if decoded:
+            dur_ms = decode_dur_s * 1e3
+            st["sketch"].add(dur_ms)
+            if dur_ms <= self.cfg.itl_slo_ms:
+                st["slo_good"] += 1
+            else:
+                st["slo_bad"] += 1
+        pub = self._pubs.get(r.idx)
+        if pub is None:
+            pub = self._pubs[r.idx] = DigestPublisher(
+                self.dir, "replica", str(r.idx),
+                period_s=self.cfg.period_s,
+            )
+        if pub.due(now):
+            self._publish(
+                r.idx, now,
+                occupancy=r.batcher.occupancy, detector=r.detector,
+            )
+            self._aggregate(wall)
+
+    def _publish(
+        self, idx: int, t: float, occupancy: float, detector=None,
+    ) -> None:
+        st = self._replica_state(idx)
+        extra = detector.digest_extra() if detector is not None else {}
+        # Ring-only on the bus (the lg_token cadence discipline: one
+        # digest per period per replica would bloat the run JSONL);
+        # the channel file under self.dir is the durable copy.
+        rec = self._pubs[idx].publish(
+            counters={
+                "ticks": st["ticks"],
+                "slo_good": st["slo_good"],
+                "slo_bad": st["slo_bad"],
+            },
+            gauges={"occupancy": float(occupancy)},
+            hists={"tick_ms": st["sketch"]},
+            t=t,
+            step_s=extra.get("step_s"),
+            watermark_s=extra.get("watermark_s"),
+        )
+        self.rollup.ingest([rec])
+        self.digests += 1
+
+    def _aggregate(self, wall: float) -> None:
+        view = self.rollup.build(now=wall)
+        self.last_view = view
+        for e in stale_entries(view):
+            key = (e["role"], e["key"])
+            if key in self._stale_flagged:
+                continue
+            self._stale_flagged.add(key)
+            self.stale_events += 1
+            get_registry().inc("live_digest_stale_total")
+            get_bus().emit(
+                "digest_stale", sink=self.metrics_path, **e
+            )
+        slo = view.get("slo")
+        if slo:
+            self.monitor.observe(
+                wall, slo["good"], slo["bad"],
+                sink=self.metrics_path, trace_id=self.trace_id,
+                capture=self.capture, reason="fleet_itl_slo",
+            )
+
+    def finalize(self, fleet: "ServingFleet", wall: float) -> dict:
+        """Final per-replica publish (responsive replicas only -- a
+        dead one staying silent IS the signal), one last aggregation,
+        the fleet-merged Prometheus textfile when armed, and the
+        summary block the report/regress plane reads."""
+        for r in fleet.replicas:
+            if r.idx not in self._pubs:
+                continue
+            if r.status == DEAD or not r.responsive:
+                continue
+            self._publish(
+                r.idx, max(wall, self._pubs[r.idx].last_publish_t or 0.0),
+                occupancy=r.batcher.occupancy, detector=r.detector,
+            )
+        self._aggregate(wall)
+        view = self.last_view or self.rollup.build(now=wall)
+        write_fleet_prometheus(view)
+        remaining = self.monitor.budget_remaining()
+        slo = view.get("slo") or {}
+        return {
+            "digests": self.digests,
+            "digest_stale": self.stale_events,
+            "stragglers": view["stragglers"],
+            "stale_keys": view["stale"],
+            "slo_burns": self.monitor.burns,
+            "slo_attainment": slo.get("attainment"),
+            "slo_good": slo.get("good"),
+            "slo_bad": slo.get("bad"),
+            "budget_remaining": (
+                round(remaining, 4) if remaining is not None else None
+            ),
+            "trace_id": self.trace_id,
+        }
+
+
 class FleetHarness:
     """Drive one loadgen scenario over a :class:`ServingFleet` on
     per-replica virtual timelines.
@@ -1168,6 +1368,8 @@ class FleetHarness:
         swap_at: Optional[int] = None,
         swap_weights: Any = None,
         swap_checksums: Optional[Dict] = None,
+        live_cfg: Optional[LiveConfig] = None,
+        capture=None,
     ):
         if scenario.colocate_every:
             raise ValueError(
@@ -1240,6 +1442,23 @@ class FleetHarness:
         self._published = False
         self._occupancy: List[float] = []
         self.ticks = 0
+        self.wall = 0.0
+        # Live telemetry plane: strictly opt-in via the env contract
+        # (the digest.from_env discipline) -- an unconfigured harness
+        # publishes nothing and pays nothing.
+        digest_dir = os.environ.get(ENV_DIGEST_DIR)
+        if live_cfg is not None and not digest_dir:
+            raise ValueError(
+                f"live_cfg given but ${ENV_DIGEST_DIR} is unset: the "
+                "live plane would silently publish nowhere"
+            )
+        self.telemetry = (
+            FleetTelemetry(
+                digest_dir, live_cfg, metrics_path=metrics_path,
+                capture=capture, run_key=scenario.name,
+            )
+            if digest_dir else None
+        )
 
     # -- drive ----------------------------------------------------------
     def run(self, n_devices: int = 1, max_ticks: Optional[int] = None,
@@ -1380,6 +1599,10 @@ class FleetHarness:
             r.t_local = t_end
             wall = max(wall, t_end)
             fleet.observe_tick(r, t_end, decoded, decode_dur)
+            if self.telemetry is not None:
+                self.telemetry.on_tick(
+                    r, t_end, decoded, decode_dur, wall
+                )
             # Autoscale observes per TICK (not per event-loop
             # iteration): an arrival burst must not flood the
             # occupancy window with pre-admission zeros and trigger a
@@ -1417,6 +1640,7 @@ class FleetHarness:
                 f"drained after {self.ticks} tick(s) -- the mid-run "
                 "model update must not pass vacuously"
             )
+        self.wall = wall
 
     # -- aggregation ----------------------------------------------------
     def summarize(
@@ -1467,6 +1691,10 @@ class FleetHarness:
             lost_requests=arrived - finished - shed,
             fleet=fleet_block,
         )
+        if self.telemetry is not None:
+            summary["live"] = self.telemetry.finalize(
+                self.fleet, self.wall
+            )
         if extra:
             summary.update(extra)
         m.write_summary(summary)

@@ -827,6 +827,14 @@ class Trainer:
                 "when capture_on_anomaly is set"
             )
         self.capture: Optional[obs.AnomalyCapture] = None
+        # Live telemetry plane (obs/digest.py): per-HOST health
+        # digests into $TPU_HPC_DIGEST_DIR every chunk boundary, so a
+        # fleet rollup (python -m tpu_hpc.obs.live) can compare this
+        # host's step watermark against its peers while the run is
+        # still going. None (free) unless the env contract arms it.
+        self.digest = obs.DigestPublisher.from_env(
+            role="host", key=str(jax.process_index())
+        )
         # Optional callable(state, step) run when a preemption notice
         # stops the run, BEFORE the emergency snapshot -- the hook for
         # recipe-level cleanup (flush custom logs, export metrics).
@@ -1516,6 +1524,15 @@ class Trainer:
                 # (the supervisor, an operator's cat) can now tell
                 # "wedged" from "slower than its own recent past".
                 self.heartbeat.tick(done, **self.stall.heartbeat_extra())
+            if self.digest is not None:
+                # The digest twin of the heartbeat enrichment: the
+                # registry's counters/gauges + mergeable sketches and
+                # the SAME normalized (step_s, watermark_s) signal,
+                # published onto this host's channel for the fleet
+                # rollup's cross-host straggler comparison.
+                self.digest.publish_registry(
+                    step=done, **self.stall.digest_extra()
+                )
             summary = self.meter.epoch_summary(skip_first=0)
             run_summaries.append(summary)
             if jax.process_index() == 0:
