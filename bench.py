@@ -952,6 +952,20 @@ def bench_elastic(
     }
 
 
+def _kv_metric_tag(summary: dict) -> str:
+    """Metric-family suffix for the paged read path
+    (tpu_hpc.kernels.paged_attention): '' for the default gather/fp
+    pool -- pre-existing banked histories continue untouched --
+    '_pallas', '_q8', or '_pallas_q8' otherwise, so each read-path
+    trajectory banks against its own high-water marks."""
+    tag = ""
+    if summary.get("kv_kernel", "gather") == "pallas":
+        tag += "_pallas"
+    if summary.get("kv_quant", "none") == "int8":
+        tag += "_q8"
+    return tag
+
+
 def serve_record(summary: dict, disagg: bool = False) -> dict:
     """Serving summary -> the training-bench record schema
     (metric/value/unit/vs_baseline), with the serving-native latency
@@ -970,10 +984,13 @@ def serve_record(summary: dict, disagg: bool = False) -> dict:
         "recompiles": summary["recompiles"],
         "kv_layout": summary.get("kv_layout", "slab"),
     }
+    kv_tag = ""
     if summary.get("kv_layout") == "paged":
         rec_serve.update(
             kv_block_size=summary.get("kv_block_size"),
             kv_blocks=summary.get("kv_blocks"),
+            kv_kernel=summary.get("kv_kernel", "gather"),
+            kv_quant=summary.get("kv_quant", "none"),
             prefix_hit_rate=round(
                 summary.get("prefix_hit_rate", 0.0), 4
             ),
@@ -982,6 +999,12 @@ def serve_record(summary: dict, disagg: bool = False) -> dict:
                 "block_stalls", 0
             ),
         )
+        # Read path + storage dtype are part of the metric FAMILY
+        # (the kv_layout discipline): a pallas or int8 row banked
+        # under the gather/fp family would set high-water marks the
+        # next default row gets judged against. Default gather/none
+        # contributes no tag, so pre-ISSUE-20 histories continue.
+        kv_tag = _kv_metric_tag(summary)
     spec_mode = summary.get("spec_mode")
     acceptance = round(summary.get("acceptance_rate", 0.0), 4)
     if spec_mode:
@@ -1012,11 +1035,11 @@ def serve_record(summary: dict, disagg: bool = False) -> dict:
         # greedy row gets judged against (and draft-vs-ngram
         # trajectories would cross the same way) -- the
         # loadgen_record separation, applied here too.
-        metric = f"serve_spec_{spec_mode}_tokens_per_s_per_chip"
+        metric = f"serve_spec_{spec_mode}{kv_tag}_tokens_per_s_per_chip"
     elif disagg:
-        metric = "serve_disagg_tokens_per_s_per_chip"
+        metric = f"serve_disagg{kv_tag}_tokens_per_s_per_chip"
     else:
-        metric = "serve_tokens_per_s_per_chip"
+        metric = f"serve{kv_tag}_tokens_per_s_per_chip"
     rec = {
         "metric": metric,
         "value": round(summary["tokens_per_s_per_chip"], 1),
@@ -1040,7 +1063,7 @@ def serve_record(summary: dict, disagg: bool = False) -> dict:
 def _bench_paged_cfg(
     paged: bool, slots: int, max_seq: int, buckets,
     block_size=None, kv_blocks=None, prefill_chunk=None,
-    host_blocks=None,
+    host_blocks=None, kernel=None, kv_quant=None,
 ):
     """(PagedConfig | None, page-aligned max_seq) for the serve/
     loadgen rows. ONE derivation shared with server.py's CLI
@@ -1057,6 +1080,7 @@ def _bench_paged_cfg(
             block_size=block_size, num_blocks=kv_blocks,
             prefill_chunk=prefill_chunk, align_capacity=True,
             host_blocks=host_blocks or 0,
+            kernel=kernel, kv_quant=kv_quant,
         )
     except ValueError as e:
         raise SystemExit(f"bench.py: {e}")
@@ -1081,7 +1105,7 @@ def bench_serve(
     prompt_lens=(96, 192, 384), buckets=(128, 256, 512),
     model_cfg=None, disagg: bool = False, paged: bool = False,
     block_size=None, kv_blocks=None, prefill_chunk=None,
-    host_blocks=None,
+    host_blocks=None, kernel=None, kv_quant=None,
     spec: str = "off", spec_k=None, draft_ckpt=None,
 ) -> dict:
     """Batched-inference throughput: the SAME ~170M bench architecture
@@ -1109,6 +1133,7 @@ def bench_serve(
     paged_cfg, max_seq = _bench_paged_cfg(
         paged, slots, max(buckets) + max_new, buckets,
         block_size, kv_blocks, prefill_chunk, host_blocks,
+        kernel, kv_quant,
     )
     spec_cfg = _bench_spec_cfg(spec, spec_k)
     serve_cfg = ServeConfig(
@@ -1122,9 +1147,12 @@ def bench_serve(
         spec=spec_cfg, spec_draft_ckpt=draft_ckpt,
     )
     rec = serve_record(summary, disagg=disagg)
+    _attach_logit_rmse(rec, model_cfg, paged_cfg)
     print(
         f"serve{'-disagg' if disagg else ''}"
         f"{'-paged' if paged else ''}"
+        f"{f'-{kernel}' if kernel == 'pallas' else ''}"
+        f"{f'-{kv_quant}' if kv_quant == 'int8' else ''}"
         f"{f'-spec:{spec}' if spec != 'off' else ''} | "
         f"{summary['mesh']} slots={slots} | "
         f"{summary['tokens_per_s']:.0f} tokens/s | "
@@ -1133,6 +1161,29 @@ def bench_serve(
         file=sys.stderr,
     )
     return rec
+
+
+def _attach_logit_rmse(rec: dict, model_cfg, paged_cfg) -> None:
+    """Pin the quantization error onto every int8 row, top level
+    where the --bank reduction judges it (obs/regress
+    _BANKED_SIDE_KEYS, lower-is-better via the rmse token): the
+    deterministic pre-softmax score RMSE of per-page int8 K against
+    fp at THIS model's head geometry and page size. A quantizer
+    regression fails the gate even while the latency headline still
+    rides within tolerance."""
+    if paged_cfg is None or paged_cfg.kv_quant != "int8":
+        return
+    from tpu_hpc.kernels.paged_attention import int8_logit_rmse
+
+    rec["logit_rmse"] = round(
+        int8_logit_rmse(
+            head_dim=model_cfg.dim // model_cfg.n_heads,
+            kv_heads=model_cfg.n_kv_heads or model_cfg.n_heads,
+            n_heads=model_cfg.n_heads,
+            block_size=paged_cfg.block_size,
+        ),
+        6,
+    )
 
 
 def loadgen_record(summary: dict) -> dict:
@@ -1162,10 +1213,13 @@ def loadgen_record(summary: dict) -> dict:
         },
     }
     metric = f"loadgen_{summary['scenario']}_ttft_ms_p95"
+    kv_tag = ""
     if summary.get("kv_layout") == "paged":
         lg.update(
             kv_block_size=summary.get("kv_block_size"),
             kv_blocks=summary.get("kv_blocks"),
+            kv_kernel=summary.get("kv_kernel", "gather"),
+            kv_quant=summary.get("kv_quant", "none"),
             prefix_hit_rate=round(
                 summary.get("prefix_hit_rate", 0.0), 4
             ),
@@ -1176,7 +1230,11 @@ def loadgen_record(summary: dict) -> dict:
         # The cache layout is part of the metric's identity: the
         # --bank gate must track paged and slab trajectories
         # separately (at equal traffic they are different systems).
-        metric = f"loadgen_{summary['scenario']}_paged_ttft_ms_p95"
+        # So are the read path and the page storage dtype (the cost
+        # model charges them differently); gather/fp contributes no
+        # tag so pre-ISSUE-20 histories continue.
+        kv_tag = _kv_metric_tag(summary)
+        metric = f"loadgen_{summary['scenario']}_paged{kv_tag}_ttft_ms_p95"
     tiered = bool(summary.get("kv_host_blocks"))
     if tiered:
         # A host page tier changes what the same traffic measures
@@ -1192,7 +1250,8 @@ def loadgen_record(summary: dict) -> dict:
             kv_refill_pages=summary.get("kv_refill_pages", 0),
         )
         metric = (
-            f"loadgen_{summary['scenario']}_paged_tiered_ttft_ms_p95"
+            f"loadgen_{summary['scenario']}_paged{kv_tag}"
+            "_tiered_ttft_ms_p95"
         )
     spec_mode = summary.get("spec_mode")
     acceptance = round(summary.get("acceptance_rate", 0.0), 4)
@@ -1208,7 +1267,7 @@ def loadgen_record(summary: dict) -> dict:
             draft_ms=summary.get("draft_ms"),
         )
         metric = (
-            f"loadgen_{summary['scenario']}_paged_spec_"
+            f"loadgen_{summary['scenario']}_paged{kv_tag}_spec_"
             f"{spec_mode}_ttft_ms_p95"
         )
     fleet = summary.get("fleet")
@@ -1237,7 +1296,7 @@ def loadgen_record(summary: dict) -> dict:
             block_stalls=summary.get("block_stalls", 0),
         )
         metric = (
-            f"loadgen_{summary['scenario']}_fleet_ttft_ms_p95"
+            f"loadgen_{summary['scenario']}_fleet{kv_tag}_ttft_ms_p95"
         )
     rec = {
         "metric": metric,
@@ -1305,7 +1364,8 @@ def bench_loadgen(
     scenario: str = "multi_tenant", requests: int = 64,
     slots: int = 8, max_new: int = 32, seed: int = 0,
     paged: bool = False, block_size=None, kv_blocks=None,
-    prefill_chunk=None, host_blocks=None, model: str = "bench",
+    prefill_chunk=None, host_blocks=None, kernel=None,
+    kv_quant=None, model: str = "bench",
     spec: str = "off", spec_k=None, draft_ckpt=None,
     fleet: int = 0, fleet_min: int = 1, fleet_swap_at=None,
     fleet_router: str = "affinity",
@@ -1347,6 +1407,7 @@ def bench_loadgen(
     paged_cfg, max_seq = _bench_paged_cfg(
         paged, slots, max(buckets) + max_new, buckets,
         block_size, kv_blocks, prefill_chunk, host_blocks,
+        kernel, kv_quant,
     )
     spec_cfg = _bench_spec_cfg(spec, spec_k)
     serve_cfg = ServeConfig(
@@ -1368,8 +1429,11 @@ def bench_loadgen(
         )
     rec = loadgen_record(summary)
     rec["loadgen"]["model"] = model
+    _attach_logit_rmse(rec, model_cfg, paged_cfg)
     print(
         f"loadgen {scenario}{' paged' if paged else ''}"
+        f"{f' {kernel}' if kernel == 'pallas' else ''}"
+        f"{f' {kv_quant}' if kv_quant == 'int8' else ''}"
         f"{' tiered' if host_blocks else ''}"
         f"{f' fleet:{fleet}' if fleet else ''}"
         f"{f' spec:{spec}' if spec != 'off' else ''} | "
@@ -1694,6 +1758,21 @@ def main(argv=None) -> int:
         "whole-prompt prefill)",
     )
     ap.add_argument(
+        "--serve-kernel", choices=("gather", "pallas"), default=None,
+        help="paged attention read path for --serve-paged "
+        "(tpu_hpc.kernels.paged_attention): 'gather' materializes "
+        "pages before a dense flash call (the oracle), 'pallas' "
+        "walks the block table in-kernel -- one HBM read per page; "
+        "pallas rows bank under their own _pallas metric family",
+    )
+    ap.add_argument(
+        "--serve-kv-quant", choices=("none", "int8"), default=None,
+        help="KV page storage for --serve-paged: 'int8' quantizes "
+        "pages per page with fp32 scales -- half the bytes per "
+        "token, ~2x resident context at equal HBM; int8 rows bank "
+        "under their own _q8 family and carry logit_rmse",
+    )
+    ap.add_argument(
         "--serve-spec", choices=("off", "draft", "ngram"),
         default="off",
         help="speculative decoding (tpu_hpc/serve/spec.py; requires "
@@ -1917,6 +1996,8 @@ def main(argv=None) -> int:
             ("--serve-kv-blocks", args.serve_kv_blocks),
             ("--serve-host-blocks", args.serve_host_blocks),
             ("--serve-prefill-chunk", args.serve_prefill_chunk),
+            ("--serve-kernel", args.serve_kernel),
+            ("--serve-kv-quant", args.serve_kv_quant),
         ):
             if val is not None:
                 ap.error(
@@ -1991,6 +2072,15 @@ def main(argv=None) -> int:
             ap.error(
                 "--serve-spec is not consumed by --serve-disagg "
                 "(the verify program is a single-mesh paged program)"
+            )
+        if args.serve_kv_quant == "int8":
+            # server.py's guard, mirrored: verify would replay
+            # drafted positions against requantized pages and drift
+            # from the greedy oracle.
+            ap.error(
+                "--serve-spec is not consumed with --serve-kv-quant "
+                "int8 (verify replays positions the draft loop "
+                "already requantized)"
             )
         if args.spec_k is not None and args.spec_k < 1:
             # server.py's guard, mirrored: `or`-defaulting would
@@ -2190,6 +2280,8 @@ def main(argv=None) -> int:
             kv_blocks=args.serve_kv_blocks,
             prefill_chunk=args.serve_prefill_chunk,
             host_blocks=args.serve_host_blocks,
+            kernel=args.serve_kernel,
+            kv_quant=args.serve_kv_quant,
             spec=args.serve_spec, spec_k=args.spec_k,
             draft_ckpt=args.serve_draft_ckpt,
         )
@@ -2204,6 +2296,8 @@ def main(argv=None) -> int:
             kv_blocks=args.serve_kv_blocks,
             prefill_chunk=args.serve_prefill_chunk,
             host_blocks=args.serve_host_blocks,
+            kernel=args.serve_kernel,
+            kv_quant=args.serve_kv_quant,
             model=args.serve_model,
             spec=args.serve_spec, spec_k=args.spec_k,
             draft_ckpt=args.serve_draft_ckpt,

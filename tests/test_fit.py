@@ -458,6 +458,97 @@ class TestPagedKVTerm:
         assert rc in (0, 1)
 
 
+class TestQuantizedKVTerm:
+    """The int8 page-storage budget (--kv-quant int8,
+    tpu_hpc.kernels.paged_attention): 1-byte pages + per-page fp32
+    scales, about half the bf16 pool -- and the report must print
+    the capacity multiplier the flag exists for."""
+
+    def test_formula_exact(self):
+        cfg = llama2.LlamaConfig(
+            dim=64, n_layers=3, n_heads=4, n_kv_heads=2,
+            vocab_size=128, multiple_of=16, max_seq_len=32,
+        )
+        # pages at 1 byte/elem + one fp32 scale per page per layer
+        # for K and V each.
+        want = 100 * 16 * 3 * 2 * 16 * 2 * 1 + 100 * 3 * 2 * 4
+        assert fit.kv_paged_bytes(cfg, 100, 16, kv_quant="int8") == want
+        # Just under half the bf16 pool (the scale side array is the
+        # difference from exactly half).
+        bf16 = fit.kv_paged_bytes(cfg, 100, 16)
+        assert want < bf16 * 0.51
+
+    @pytest.fixture(scope="class")
+    def with_quant(self, full_7b):
+        return fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False,
+            kv_blocks=8192, kv_block_size=16, kv_quant="int8",
+        )
+
+    def test_halves_the_pool_term(self, full_7b, with_quant):
+        full = fit.kv_paged_bytes(
+            full_7b.cfg, 8192, 16, kv_quant="int8"
+        )
+        assert with_quant.kv_block_bytes == -(-full // 8)
+        bf16 = fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False,
+            kv_blocks=8192, kv_block_size=16,
+        )
+        assert with_quant.kv_block_bytes < bf16.kv_block_bytes * 0.51
+        assert with_quant.to_json()["kv_quant"] == "int8"
+
+    def test_draft_mirror_quantizes_too(self, full_7b):
+        from tpu_hpc.serve.spec import default_draft_config
+
+        draft = default_draft_config(full_7b.cfg)
+        r = fit.analyze(
+            cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+            seq_len=4096, do_compile=False,
+            kv_blocks=8192, kv_block_size=16, kv_quant="int8",
+            draft_cfg=draft,
+        )
+        assert r.draft_kv_block_bytes == -(-fit.kv_paged_bytes(
+            draft, 8192, 16, kv_quant="int8"
+        ) // 8)
+
+    def test_markdown_capacity_multiplier(self, with_quant):
+        md = fit.to_markdown(with_quant)
+        assert "int8 + fp32 scales" in md
+        assert "Quantized KV capacity" in md
+        assert "2.0x the resident context at equal HBM" in md
+
+    def test_quant_requires_paged_pool(self, full_7b):
+        with pytest.raises(ValueError, match="kv_blocks"):
+            fit.analyze(
+                cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+                seq_len=4096, do_compile=False, kv_quant="int8",
+            )
+        with pytest.raises(ValueError, match="kv_quant"):
+            fit.analyze(
+                cfg=full_7b.cfg, dp=4, tp_size=8, global_batch=8,
+                seq_len=4096, do_compile=False,
+                kv_blocks=64, kv_quant="fp8",
+            )
+
+    def test_cli_requires_kv_blocks(self, capsys):
+        with pytest.raises(SystemExit):
+            fit.main(["--no-compile", "--kv-quant", "int8"])
+        assert "--kv-blocks" in capsys.readouterr().err
+
+    def test_cli_flag_reaches_analyze(self, capsys):
+        rc = fit.main([
+            "--no-compile", "--kv-blocks", "4096",
+            "--kv-quant", "int8", "--json",
+        ])
+        import json as _json
+
+        out = _json.loads(capsys.readouterr().out)
+        assert out["kv_quant"] == "int8"
+        assert rc in (0, 1)
+
+
 class TestSpecDraftTerm:
     """The speculative-draft HBM budget (serve/spec.py via
     --spec-draft): draft params + the mirrored paged pool must land

@@ -100,6 +100,25 @@ class TestCompare:
         assert lower_is_better("obs.digest_publish_ms")
         assert not lower_is_better("slo.slo_attainment")
         assert not lower_is_better("slo.budget_remaining")
+        # Quantized KV pages (kernels/paged_attention.py): the banked
+        # logit_rmse pin regresses UPWARD -- a quantizer change that
+        # widens the pre-softmax drift fails the gate even while the
+        # latency headline rides within tolerance. Composite banked
+        # names judge the rmse LEAF, and the kernel/quant family
+        # suffixes keep the latency direction of their headline.
+        assert lower_is_better("logit_rmse")
+        assert lower_is_better(
+            "loadgen_decode_heavy_paged_q8_ttft_ms_p95.logit_rmse"
+        )
+        assert lower_is_better(
+            "loadgen_shared_prefix_paged_pallas_ttft_ms_p95"
+        )
+        assert lower_is_better(
+            "loadgen_decode_heavy_paged_pallas_q8_ttft_ms_p95"
+        )
+        assert not lower_is_better(
+            "serve_pallas_q8_tokens_per_s_per_chip"
+        )
 
     def test_spec_config_fields_not_compared(self):
         """spec_k is config; drafted/accepted/rejected/verify_steps

@@ -104,6 +104,7 @@ def kv_paged_bytes(
     num_blocks: int,
     block_size: int,
     cache_dtype: str = "bfloat16",
+    kv_quant: str = "none",
 ) -> int:
     """Per-POD bytes of a PAGED decode KV cache
     (tpu_hpc/serve/paging.py): num_blocks pages x block_size tokens x
@@ -114,7 +115,19 @@ def kv_paged_bytes(
     fragmentation/slack headroom paging reclaims, which
     ``analyze(kv_blocks=...)`` reports next to the slab term. The
     pool shards KV heads over the model axis only (pages are globally
-    addressable, so the block dim stays whole per replica)."""
+    addressable, so the block dim stays whole per replica).
+
+    ``kv_quant="int8"`` (tpu_hpc.kernels.paged_attention) stores
+    pages at 1 byte/element plus a per-page fp32 scale side array
+    (one scale per page per layer, K and V each) -- the halved pool
+    the quantized-capacity report line budgets."""
+    if kv_quant == "int8":
+        page_bytes = (
+            num_blocks * block_size * cfg.n_layers * cfg.kv_heads
+            * cfg.head_dim * 2
+        )
+        scale_bytes = num_blocks * cfg.n_layers * 2 * 4
+        return page_bytes + scale_bytes
     itemsize = jnp.dtype(cache_dtype).itemsize
     return (
         num_blocks * block_size * cfg.n_layers * cfg.kv_heads
@@ -153,6 +166,7 @@ class FitResult:
     kv_block_bytes: int = 0      # per chip, PAGED decode KV pool
     kv_blocks: int = 0           # physical pages the paged term assumes
     kv_block_size: int = 0       # tokens per page
+    kv_quant: str = "none"       # page storage: "none" (dtype) | "int8"
     # Host-DRAM KV page tier (serve/tier.py): parked prefixes spill
     # into host buffers, so this term is DRAM, not HBM -- reported
     # for sizing but never part of total_bytes or the fits verdict.
@@ -557,6 +571,7 @@ def analyze(
     kv_cache_dtype: str = "bfloat16",
     kv_blocks: int = 0,
     kv_block_size: int = 16,
+    kv_quant: str = "none",
     kv_host_blocks: int = 0,
     draft_cfg: Optional[llama2.LlamaConfig] = None,
 ) -> FitResult:
@@ -632,13 +647,23 @@ def analyze(
     # divide; the block dim replicates over data (pages are globally
     # addressable within a replica).
     kv_block_bytes_chip = 0
+    if kv_quant not in ("none", "int8"):
+        raise ValueError(
+            f"unknown kv_quant {kv_quant!r} (none|int8)"
+        )
+    if kv_quant == "int8" and not kv_blocks:
+        raise ValueError(
+            "kv_quant='int8' needs the paged pool term (kv_blocks > "
+            "0): only paged pages quantize "
+            "(tpu_hpc.kernels.paged_attention)"
+        )
     if kv_blocks:
         if kv_block_size < 1:
             raise ValueError(
                 f"kv_block_size {kv_block_size} must be >= 1"
             )
         full = kv_paged_bytes(
-            cfg, kv_blocks, kv_block_size, kv_cache_dtype
+            cfg, kv_blocks, kv_block_size, kv_cache_dtype, kv_quant
         )
         denom = 1
         if layout == "tp" and tp_size > 1 \
@@ -658,8 +683,11 @@ def analyze(
                 "(kv_blocks > 0): the tier spills the paged pool's "
                 "pages"
             )
+        # The host buffers mirror the device pool's storage
+        # (serve/tier.py allocates at the pool dtype, int8 included).
         kv_host_bytes = kv_paged_bytes(
-            cfg, kv_host_blocks, kv_block_size, kv_cache_dtype
+            cfg, kv_host_blocks, kv_block_size, kv_cache_dtype,
+            kv_quant,
         )
 
     # Speculative-draft term (``draft_cfg``, serve/spec.py): the
@@ -686,8 +714,11 @@ def analyze(
             and draft_cfg.n_heads % tp_size == 0 else 1
         )
         draft_params_chip = -(-draft_n_params * 4 // tp_div)
+        # The mirror stores at the same discipline as the target pool
+        # (a quantized deployment would quantize both or neither).
         full = kv_paged_bytes(
-            draft_cfg, kv_blocks, kv_block_size, kv_cache_dtype
+            draft_cfg, kv_blocks, kv_block_size, kv_cache_dtype,
+            kv_quant,
         )
         kv_div = (
             tp_size
@@ -727,6 +758,7 @@ def analyze(
             kv_block_bytes=kv_block_bytes_chip,
             kv_blocks=kv_blocks,
             kv_block_size=kv_block_size if kv_blocks else 0,
+            kv_quant=kv_quant if kv_blocks else "none",
             kv_host_blocks=kv_host_blocks,
             kv_host_bytes=kv_host_bytes,
             draft_n_params=draft_n_params,
@@ -798,6 +830,7 @@ def analyze(
         kv_block_bytes=kv_block_bytes_chip,
         kv_blocks=kv_blocks,
         kv_block_size=kv_block_size if kv_blocks else 0,
+        kv_quant=kv_quant if kv_blocks else "none",
         kv_host_blocks=kv_host_blocks,
         kv_host_bytes=kv_host_bytes,
         draft_n_params=draft_n_params,
@@ -961,9 +994,12 @@ def to_markdown(r: FitResult) -> str:
             f"{r.kv_cache_bytes:,} | {r.kv_cache_bytes/GIB:.2f} |"
         )
     if r.kv_blocks:
+        quant_tag = (
+            ", int8 + fp32 scales" if r.kv_quant == "int8" else ""
+        )
         lines.append(
             f"| KV cache (paged, {r.kv_blocks} pages x "
-            f"{r.kv_block_size} tok) | "
+            f"{r.kv_block_size} tok{quant_tag}) | "
             f"{r.kv_block_bytes:,} | {r.kv_block_bytes/GIB:.2f} |"
         )
     if r.draft_param_bytes:
@@ -1041,6 +1077,30 @@ def to_markdown(r: FitResult) -> str:
                 "than the slab share -- this pool out-provisions the "
                 "mix; shrink --kv-blocks."
             ),
+        ]
+    if r.kv_blocks and r.kv_quant == "int8":
+        # The quantized-capacity line (tpu_hpc.kernels.paged_
+        # attention): int8 pages + per-page fp32 scales vs the same
+        # page count at bf16 -- the multiplier is how many MORE
+        # resident tokens the same HBM seats, the number --kv-quant
+        # exists to print. Full-pod bytes on both sides (one
+        # sharding), so the ratio is sharding-independent.
+        q_full = kv_paged_bytes(
+            cfg, r.kv_blocks, r.kv_block_size, kv_quant="int8"
+        )
+        fp_full = kv_paged_bytes(
+            cfg, r.kv_blocks, r.kv_block_size, "bfloat16"
+        )
+        q_pages_equal_hbm = fp_full * r.kv_blocks // q_full
+        lines += [
+            "",
+            f"Quantized KV capacity (int8 pages + per-page fp32 "
+            f"scales): the {r.kv_blocks:,}-page pool stores "
+            f"{q_full:,} bytes ({q_full/GIB:.2f} GiB) vs {fp_full:,} "
+            f"bytes ({fp_full/GIB:.2f} GiB) at bf16 -- the bf16 "
+            f"pool's HBM seats **{q_pages_equal_hbm:,} int8 pages, "
+            f"{fp_full/q_full:.1f}x the resident context at equal "
+            f"HBM**.",
         ]
     if r.kv_host_blocks:
         # The tier's sizing line: host DRAM buys parked-session KV
@@ -1304,6 +1364,15 @@ def main(argv=None) -> int:
     parser.add_argument("--kv-block-size", type=int, default=16,
                         help="tokens per page for --kv-blocks "
                         "(default 16)")
+    parser.add_argument("--kv-quant", choices=("none", "int8"),
+                        default=None,
+                        help="paged page storage "
+                        "(tpu_hpc.kernels.paged_attention): 'int8' "
+                        "budgets 1-byte pages + per-page fp32 scales "
+                        "-- about half the pool bytes, ~2x the "
+                        "resident context at equal HBM; the report "
+                        "adds the quantized-capacity line (requires "
+                        "--kv-blocks)")
     parser.add_argument("--kv-host-tier", type=int, default=0,
                         metavar="N",
                         help="budget a host-DRAM KV page tier "
@@ -1376,6 +1445,11 @@ def main(argv=None) -> int:
             "--kv-host-tier needs --kv-blocks: the tier spills the "
             "paged pool's pages"
         )
+    if args.kv_quant is not None and not args.kv_blocks:
+        parser.error(
+            "--kv-quant needs --kv-blocks: only paged pages quantize "
+            "(tpu_hpc.kernels.paged_attention)"
+        )
     draft_cfg = None
     if args.spec_draft is not None:
         if not args.kv_blocks:
@@ -1407,6 +1481,7 @@ def main(argv=None) -> int:
         kv_cache_dtype=args.kv_cache_dtype,
         kv_blocks=args.kv_blocks,
         kv_block_size=args.kv_block_size,
+        kv_quant=args.kv_quant or "none",
         kv_host_blocks=args.kv_host_tier,
         draft_cfg=draft_cfg,
     )

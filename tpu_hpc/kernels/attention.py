@@ -52,6 +52,23 @@ def _pad_seq(x: jax.Array, n: int) -> jax.Array:
     return jnp.pad(x, cfg)
 
 
+def pick_block_sizes(
+    block_q: int, block_k: int, sq: int, sk: int
+) -> Tuple[int, int]:
+    """Clamp requested flash block sizes to the (128-aligned) sequence
+    lengths. Short sequences must not pad all the way up to the
+    requested block -- a 37-token prompt under block 512 would burn
+    ~14x the VMEM and MXU work on masked rows -- but blocks stay
+    128-aligned so TPU lane tiling holds. The ONE selection rule for
+    every kernel in this package (forward, backward, and the paged
+    decode/prefill kernels in paged_attention.py); hand-synced copies
+    drifted once already."""
+    return (
+        min(block_q, _round_up(sq, 128)),
+        min(block_k, _round_up(sk, 128)),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Pure-XLA reference path (differentiable, runs on any backend)
 # ---------------------------------------------------------------------------
@@ -238,8 +255,7 @@ def _flash_forward(
         raise ValueError(f"GQA needs Hq % Hkv == 0, got {h} % {hkv}")
     g = h // hkv
     sk = k.shape[1]
-    block_q = min(block_q, _round_up(sq, 128))
-    block_k = min(block_k, _round_up(sk, 128))
+    block_q, block_k = pick_block_sizes(block_q, block_k, sq, sk)
     sq_p = _round_up(sq, block_q)
     sk_p = _round_up(sk, block_k)
     if sq_p != sq:
@@ -512,8 +528,7 @@ def _flash_backward(
     hkv = k.shape[2]
     g = h // hkv
     sk = k.shape[1]
-    block_q = min(block_q, _round_up(sq, 128))
-    block_k = min(block_k, _round_up(sk, 128))
+    block_q, block_k = pick_block_sizes(block_q, block_k, sq, sk)
     sq_p = _round_up(sq, block_q)
     sk_p = _round_up(sk, block_k)
     # Zero-pad to block multiples. Padded q rows contribute exactly

@@ -135,6 +135,13 @@ _LOWER_IS_BETTER = (
     # ("slo_attainment" and "budget_remaining" deliberately match NO
     # token: higher-is-better by absence, like prefix_hit_rate.)
     "burn", "stale", "straggler", "rel_err",
+    # Quantized KV pages (tpu_hpc.kernels.paged_attention): the
+    # banked logit_rmse side key pins the int8 quantizer's
+    # pre-softmax score error -- a quantizer change that widens the
+    # drift fails the gate even while the latency headline still
+    # rides within tolerance. ("kv_kernel"/"kv_quant" are identity,
+    # carried in the metric family name, never judged.)
+    "rmse",
 )
 
 
@@ -325,6 +332,11 @@ _BANKED_SIDE_KEYS = (
     # ANALYTIC bubble_fraction; it is schedule-determined and
     # constant at equal config, so judging it is a no-op there.)
     "bubble_fraction", "recovery_mttr_s",
+    # int8 KV rows (tpu_hpc.kernels.paged_attention): the
+    # deterministic quantizer-error pin rides next to the latency
+    # headline (lower-is-better via the "rmse" token) -- see the
+    # _LOWER_IS_BETTER note above.
+    "logit_rmse",
     # Elastic rows (bench.py --workload elastic): the morph count and
     # total transition wire bytes ride next to the stall-seconds
     # headline (all lower-is-better via the "morph"/"wire_bytes"

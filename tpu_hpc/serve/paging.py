@@ -36,13 +36,20 @@ AOT executable tables and token-exact oracles:
   runs through the same per-bucket program -- plain prefill is just
   the one-chunk case.
 
-Attention reads the logical sequence through a gather over the block
-table (``ks[layer][table]``), the XLA-level reference formulation of
-paged attention: correct on every backend, token-exact against the
-no-cache forward (the tests/test_serve.py oracle applies verbatim).
-A production TPU deployment would drop a Pallas paged-attention kernel
-into the same program slots; the block-table plumbing, allocator and
-scheduler contracts here are what that kernel would inherit.
+Attention reads the logical sequence one of two ways, selected by
+``PagedConfig.kernel``: ``"gather"`` -- a gather over the block table
+(``ks[layer][table]``), the XLA-level reference formulation, correct
+on every backend and token-exact against the no-cache forward (the
+tests/test_serve.py oracle applies verbatim) -- or ``"pallas"`` -- the
+kernels/paged_attention.py kernels dropped into the SAME program
+slots: block table walked in-kernel as a scalar-prefetch operand, one
+HBM read per page, no gathered intermediate (interpret mode off-TPU,
+token-exact vs gather by the parity suite in
+tests/test_paged_kernels.py). ``PagedConfig.kv_quant="int8"`` stores
+the pool as per-page symmetric int8 with f32 scale side arrays
+(``k_scales``/``v_scales``, one scalar per page per layer): half the
+pool HBM, ~2x the resident context at equal bytes, gated by a
+bounded-divergence oracle instead of token-exactness.
 """
 from __future__ import annotations
 
@@ -55,6 +62,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_hpc.models import llama2
+from tpu_hpc.kernels.paged_attention import (
+    INT8_SCALE_FLOOR,
+    dequantize_pages_int8,
+    paged_decode_attention,
+    paged_prefill_attention,
+    quantize_pages_int8,
+)
 from tpu_hpc.obs import get_bus, get_registry, span
 from tpu_hpc.serve.engine import (
     Engine,
@@ -98,13 +112,21 @@ class PagedConfig:
     finished prompts' full pages in the prefix trie for reuse.
     ``host_blocks``: host-DRAM page slots behind the HBM pool
     (serve/tier.py; 0 = no tier). Like ``num_blocks`` it INCLUDES a
-    reserved scratch slot 0, so a non-zero tier needs >= 2 slots."""
+    reserved scratch slot 0, so a non-zero tier needs >= 2 slots.
+    ``kernel``: how attention reads the pool -- ``"gather"`` (the XLA
+    data-indexed gather, the oracle and the CPU path) or ``"pallas"``
+    (kernels/paged_attention.py: block table walked in-kernel, one HBM
+    read per page; interpret mode off-TPU). ``kv_quant``: pool storage
+    -- ``"none"`` (cache_dtype as configured) or ``"int8"`` (per-page
+    symmetric int8 with f32 scale side arrays; half the pool bytes)."""
 
     block_size: int = 16
     num_blocks: int = 64
     prefill_chunk: int = 0
     prefix_cache: bool = True
     host_blocks: int = 0
+    kernel: str = "gather"
+    kv_quant: str = "none"
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -136,6 +158,16 @@ class PagedConfig:
                 f"multiple of block_size {self.block_size} (chunks "
                 "start on page boundaries)"
             )
+        if self.kernel not in ("gather", "pallas"):
+            raise ValueError(
+                f"kernel must be 'gather' or 'pallas', got "
+                f"{self.kernel!r}"
+            )
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'none' or 'int8', got "
+                f"{self.kv_quant!r}"
+            )
 
     @property
     def usable_blocks(self) -> int:
@@ -158,6 +190,8 @@ def derive_paged_config(
     prefill_chunk: Optional[int] = None,
     align_capacity: bool = False,
     host_blocks: Optional[int] = None,
+    kernel: Optional[str] = None,
+    kv_quant: Optional[str] = None,
 ) -> Tuple["PagedConfig", int]:
     """CLI-shared sizing: ``(PagedConfig, capacity)`` from the flag
     values, with every invalid combination raising ``ValueError``
@@ -194,6 +228,8 @@ def derive_paged_config(
         ),
         prefill_chunk=prefill_chunk or 0,
         host_blocks=host_blocks or 0,
+        kernel=kernel or "gather",
+        kv_quant=kv_quant or "none",
     )
     return cfg, max_seq
 
@@ -672,6 +708,8 @@ def make_chunk_logits_fn(
     block_size: int,
     max_blocks: int,
     table_width: int,
+    kernel: str = "gather",
+    kv_quant: str = "none",
 ):
     """One prefill **chunk** at a padded bucket length -- the paged
     generalisation of the slab prefill program (whole-prompt prefill
@@ -684,12 +722,23 @@ def make_chunk_logits_fn(
     ``(params, ks, vs, tokens [1, bucket], start, true_len,
     table [table_width])`` -> ``(ks, vs, next_token)``: the chunk's
     K/V is scattered into the pages ``table[start/bs :]`` names, then
-    attention runs over the WHOLE logical sequence view (a gather of
-    the first ``max_blocks`` table entries) under the global causal
-    mask ``key_pos <= start + q`` -- so a chunk attends to every
-    previously prefilled chunk and to the shared prefix pages it
-    never computed. The greedy token from row ``true_len - 1`` is
+    attention runs over the WHOLE logical sequence view under the
+    global causal mask ``key_pos <= start + q`` -- so a chunk attends
+    to every previously prefilled chunk and to the shared prefix pages
+    it never computed. The greedy token from row ``true_len - 1`` is
     meaningful on the final chunk only.
+
+    ``kernel="gather"`` reads the view through a data-indexed gather
+    of the first ``max_blocks`` table entries (the oracle);
+    ``kernel="pallas"`` hands the table row to
+    :func:`tpu_hpc.kernels.paged_attention.paged_prefill_attention`,
+    which walks it in-kernel (interpret mode off-TPU -- the
+    ``attention.py`` precedent). ``kv_quant="int8"`` changes the
+    program signature to ``(params, ks, vs, ksc, vsc, tokens, start,
+    true_len, table) -> (ks, vs, ksc, vsc, next_token)``: the scatter
+    quantizes whole pages (per-page f32 scale into the ``ksc``/``vsc``
+    side arrays) and both read paths dequantize -- so gather and
+    pallas always see the identical pool state.
 
     ``table_width > max_blocks``: the trailing entries are scratch
     padding, so a bucket-padded write near the capacity edge can
@@ -698,8 +747,15 @@ def make_chunk_logits_fn(
     """
     nb_chunk = bucket // block_size
     cache_cap = max_blocks * block_size
+    quant = kv_quant == "int8"
+    use_pallas = kernel == "pallas"
+    # Decided at build time, like blockwise_attention's impl="auto":
+    # off-TPU the kernel runs under the Pallas interpreter (pure XLA
+    # ops, so mesh-sharded pools partition normally).
+    interpret = jax.default_backend() != "tpu"
+    groups = cfg.n_heads // cfg.kv_heads
 
-    def chunk_logits(params, ks, vs, tokens, start, true_len, table):
+    def body(params, ks, vs, ksc, vsc, tokens, start, true_len, table):
         x = _embed(params, tokens, cfg)
         qpos = start + jnp.arange(bucket)
         cos, sin = llama2.rope_cos_sin(
@@ -717,24 +773,56 @@ def make_chunk_logits_fn(
             q, k, v = _qkv(h, lp, cfg)
             q = llama2.apply_rope(q, cos, sin)
             k = llama2.apply_rope(k, cos, sin)
-            kb = k[0].astype(ks.dtype).reshape(
+            kb = k[0].reshape(
                 nb_chunk, block_size, cfg.kv_heads, cfg.head_dim
             )
-            vb = v[0].astype(vs.dtype).reshape(
+            vb = v[0].reshape(
                 nb_chunk, block_size, cfg.kv_heads, cfg.head_dim
             )
-            ks = ks.at[i, blk_ids].set(kb)
-            vs = vs.at[i, blk_ids].set(vb)
-            k_view = ks[i][view_ids].reshape(
-                1, cache_cap, cfg.kv_heads, cfg.head_dim
-            )
-            v_view = vs[i][view_ids].reshape(
-                1, cache_cap, cfg.kv_heads, cfg.head_dim
-            )
-            attn = _grouped_attention(
-                q, k_view.astype(cfg.dtype), v_view.astype(cfg.dtype),
-                mask, cfg,
-            )
+            if quant:
+                kq, k_sc = quantize_pages_int8(kb)
+                vq, v_sc = quantize_pages_int8(vb)
+                ks = ks.at[i, blk_ids].set(kq)
+                vs = vs.at[i, blk_ids].set(vq)
+                ksc = ksc.at[i, blk_ids].set(k_sc)
+                vsc = vsc.at[i, blk_ids].set(v_sc)
+            else:
+                ks = ks.at[i, blk_ids].set(kb.astype(ks.dtype))
+                vs = vs.at[i, blk_ids].set(vb.astype(vs.dtype))
+            if use_pallas:
+                qp = q[0].astype(cfg.dtype).reshape(
+                    bucket, cfg.kv_heads, groups, cfg.head_dim
+                ).transpose(1, 0, 2, 3)
+                ctx = paged_prefill_attention(
+                    qp, ks[i], vs[i], table, start,
+                    block_size=block_size, max_blocks=max_blocks,
+                    k_scale=ksc[i] if quant else None,
+                    v_scale=vsc[i] if quant else None,
+                    interpret=interpret,
+                )
+                attn = ctx.transpose(1, 0, 2, 3).reshape(
+                    1, bucket, cfg.n_heads, cfg.head_dim
+                )
+            else:
+                k_view = ks[i][view_ids]
+                v_view = vs[i][view_ids]
+                if quant:
+                    k_view = dequantize_pages_int8(
+                        k_view, ksc[i][view_ids]
+                    )
+                    v_view = dequantize_pages_int8(
+                        v_view, vsc[i][view_ids]
+                    )
+                k_view = k_view.reshape(
+                    1, cache_cap, cfg.kv_heads, cfg.head_dim
+                )
+                v_view = v_view.reshape(
+                    1, cache_cap, cfg.kv_heads, cfg.head_dim
+                )
+                attn = _grouped_attention(
+                    q, k_view.astype(cfg.dtype),
+                    v_view.astype(cfg.dtype), mask, cfg,
+                )
             x = x + _attn_out_proj(attn, lp, cfg)
             h = _rmsnorm(x, lp["ffn_norm"]["scale"], cfg.norm_eps)
             x = x + _mlp(h, lp, cfg)
@@ -742,7 +830,23 @@ def make_chunk_logits_fn(
             x, (0, true_len - 1, 0), (1, 1, cfg.dim)
         )
         logits = _logits_head(last, params, cfg)
-        return ks, vs, logits[0, 0]
+        return ks, vs, ksc, vsc, logits[0, 0]
+
+    if quant:
+        def chunk_logits_q(params, ks, vs, ksc, vsc, tokens, start,
+                           true_len, table):
+            return body(
+                params, ks, vs, ksc, vsc, tokens, start, true_len,
+                table,
+            )
+
+        return chunk_logits_q
+
+    def chunk_logits(params, ks, vs, tokens, start, true_len, table):
+        ks, vs, _, _, logits = body(
+            params, ks, vs, None, None, tokens, start, true_len, table
+        )
+        return ks, vs, logits
 
     return chunk_logits
 
@@ -753,12 +857,26 @@ def make_chunk_prefill_fn(
     block_size: int,
     max_blocks: int,
     table_width: int,
+    kernel: str = "gather",
+    kv_quant: str = "none",
 ):
     """The greedy chunk-prefill program: :func:`make_chunk_logits_fn`
     with the argmax token rule (meaningful on the final chunk only)."""
     inner = make_chunk_logits_fn(
-        cfg, bucket, block_size, max_blocks, table_width
+        cfg, bucket, block_size, max_blocks, table_width,
+        kernel=kernel, kv_quant=kv_quant,
     )
+    if kv_quant == "int8":
+        def chunk_prefill_q(params, ks, vs, ksc, vsc, tokens, start,
+                            true_len, table):
+            ks, vs, ksc, vsc, logits = inner(
+                params, ks, vs, ksc, vsc, tokens, start, true_len,
+                table,
+            )
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return ks, vs, ksc, vsc, tok
+
+        return chunk_prefill_q
 
     def chunk_prefill(params, ks, vs, tokens, start, true_len, table):
         ks, vs, logits = inner(
@@ -774,6 +892,8 @@ def make_paged_decode_fn(
     block_size: int,
     max_blocks: int,
     table_width: int,
+    kernel: str = "gather",
+    kv_quant: str = "none",
 ):
     """The single-token decode program over every slot, block-table
     edition.
@@ -784,14 +904,30 @@ def make_paged_decode_fn(
     scattered into page ``tables[s, pos/bs]`` at offset ``pos % bs``;
     inactive slots (free, or still prefilling their prompt) are
     redirected to the scratch block so their garbage write cannot
-    corrupt a live page. Attention gathers each slot's logical view
+    corrupt a live page. Attention reads each slot's logical view
     through its table and masks columns ``> pos`` -- stale pages from
     an evicted tenant are unreachable, which is what makes page reuse
     safe (the slab engine's slot-reuse invariant, per page).
+
+    ``kernel="pallas"`` swaps the view gather + dense attention for
+    :func:`tpu_hpc.kernels.paged_attention.paged_decode_attention`
+    (table walked in-kernel, one pool read per page). ``kv_quant=
+    "int8"`` threads the scale side arrays through the signature
+    (``..., ks, vs, ksc, vsc, ...``) and the token write becomes a
+    page REQUANTIZE: dequantize the target page, insert the token,
+    zero the not-yet-written tail (so stale garbage cannot leak into
+    the scale), requantize with a fresh per-page amax scale. The
+    page's scale is monotone non-decreasing over a request's decode
+    (amax only grows among live positions), so requantization drift
+    of earlier tokens is bounded -- the int8 oracle's contract.
     """
     cache_cap = max_blocks * block_size
+    quant = kv_quant == "int8"
+    use_pallas = kernel == "pallas"
+    interpret = jax.default_backend() != "tpu"
+    groups = cfg.n_heads // cfg.kv_heads
 
-    def decode(params, ks, vs, tokens, pos, tables, active):
+    def body(params, ks, vs, ksc, vsc, tokens, pos, tables, active):
         slots = tokens.shape[0]
         x = _embed(params, tokens[:, None], cfg)
         cos, sin = llama2.rope_cos_sin(
@@ -807,38 +943,99 @@ def make_paged_decode_fn(
             active > 0, tables[rows, blk], SCRATCH_BLOCK
         )
         view_ids = tables[:, :max_blocks]
+        idx = jnp.arange(block_size)
+        written = idx[None, :] <= off[:, None]  # page tail not yet live
         for i in range(cfg.n_layers):
             lp = params[f"layers_{i}"]
             h = _rmsnorm(x, lp["attention_norm"]["scale"], cfg.norm_eps)
             q, k, v = _qkv(h, lp, cfg)
             q = llama2.apply_rope(q, cos, sin)
             k = llama2.apply_rope(k, cos, sin)
-            ks = ks.at[i, pb, off].set(k[:, 0].astype(ks.dtype))
-            vs = vs.at[i, pb, off].set(v[:, 0].astype(vs.dtype))
-            k_view = ks[i][view_ids].reshape(
-                slots, cache_cap, cfg.kv_heads, cfg.head_dim
-            )
-            v_view = vs[i][view_ids].reshape(
-                slots, cache_cap, cfg.kv_heads, cfg.head_dim
-            )
-            attn = _grouped_attention(
-                q, k_view.astype(cfg.dtype), v_view.astype(cfg.dtype),
-                mask, cfg,
-            )
+            if quant:
+                k_page = dequantize_pages_int8(ks[i, pb], ksc[i, pb])
+                v_page = dequantize_pages_int8(vs[i, pb], vsc[i, pb])
+                k_page = k_page.at[rows, off].set(
+                    k[:, 0].astype(jnp.float32)
+                )
+                v_page = v_page.at[rows, off].set(
+                    v[:, 0].astype(jnp.float32)
+                )
+                k_page = jnp.where(written[..., None, None], k_page, 0.0)
+                v_page = jnp.where(written[..., None, None], v_page, 0.0)
+                kq, k_sc = quantize_pages_int8(k_page)
+                vq, v_sc = quantize_pages_int8(v_page)
+                ks = ks.at[i, pb].set(kq)
+                vs = vs.at[i, pb].set(vq)
+                ksc = ksc.at[i, pb].set(k_sc)
+                vsc = vsc.at[i, pb].set(v_sc)
+            else:
+                ks = ks.at[i, pb, off].set(k[:, 0].astype(ks.dtype))
+                vs = vs.at[i, pb, off].set(v[:, 0].astype(vs.dtype))
+            if use_pallas:
+                qd = q[:, 0].astype(cfg.dtype).reshape(
+                    slots, cfg.kv_heads, groups, cfg.head_dim
+                )
+                ctx = paged_decode_attention(
+                    qd, ks[i], vs[i], tables, pos, active,
+                    block_size=block_size, max_blocks=max_blocks,
+                    k_scale=ksc[i] if quant else None,
+                    v_scale=vsc[i] if quant else None,
+                    interpret=interpret,
+                )
+                attn = ctx.reshape(
+                    slots, 1, cfg.n_heads, cfg.head_dim
+                )
+            else:
+                k_view = ks[i][view_ids]
+                v_view = vs[i][view_ids]
+                if quant:
+                    k_view = dequantize_pages_int8(
+                        k_view, ksc[i][view_ids]
+                    )
+                    v_view = dequantize_pages_int8(
+                        v_view, vsc[i][view_ids]
+                    )
+                k_view = k_view.reshape(
+                    slots, cache_cap, cfg.kv_heads, cfg.head_dim
+                )
+                v_view = v_view.reshape(
+                    slots, cache_cap, cfg.kv_heads, cfg.head_dim
+                )
+                attn = _grouped_attention(
+                    q, k_view.astype(cfg.dtype),
+                    v_view.astype(cfg.dtype), mask, cfg,
+                )
             x = x + _attn_out_proj(attn, lp, cfg)
             h = _rmsnorm(x, lp["ffn_norm"]["scale"], cfg.norm_eps)
             x = x + _mlp(h, lp, cfg)
         logits = _logits_head(x, params, cfg)
-        return ks, vs, jnp.argmax(logits[:, 0], axis=-1).astype(
-            jnp.int32
+        tok = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return ks, vs, ksc, vsc, tok
+
+    if quant:
+        def decode_q(params, ks, vs, ksc, vsc, tokens, pos, tables,
+                     active):
+            return body(
+                params, ks, vs, ksc, vsc, tokens, pos, tables, active
+            )
+
+        return decode_q
+
+    def decode(params, ks, vs, tokens, pos, tables, active):
+        ks, vs, _, _, tok = body(
+            params, ks, vs, None, None, tokens, pos, tables, active
         )
+        return ks, vs, tok
 
     return decode
 
 
-def make_copy_block_fn():
+def make_copy_block_fn(kv_quant: str = "none"):
     """``(ks, vs, src, dst)``: copy one physical page (all layers) --
-    the device half of copy-on-write."""
+    the device half of copy-on-write. In int8 mode the signature is
+    ``(ks, vs, ksc, vsc, src, dst)``: a page's scale entry travels
+    with its payload (a copied page that kept the source's bytes but
+    not its scale would dequantize to garbage)."""
 
     def copy_block(ks, vs, src, dst):
         k_page = jax.lax.dynamic_slice_in_dim(ks, src, 1, axis=1)
@@ -847,7 +1044,18 @@ def make_copy_block_fn():
         vs = jax.lax.dynamic_update_slice_in_dim(vs, v_page, dst, axis=1)
         return ks, vs
 
-    return copy_block
+    if kv_quant != "int8":
+        return copy_block
+
+    def copy_block_q(ks, vs, ksc, vsc, src, dst):
+        ks, vs = copy_block(ks, vs, src, dst)
+        k_sc = jax.lax.dynamic_slice_in_dim(ksc, src, 1, axis=1)
+        v_sc = jax.lax.dynamic_slice_in_dim(vsc, src, 1, axis=1)
+        ksc = jax.lax.dynamic_update_slice_in_dim(ksc, k_sc, dst, axis=1)
+        vsc = jax.lax.dynamic_update_slice_in_dim(vsc, v_sc, dst, axis=1)
+        return ks, vs, ksc, vsc
+
+    return copy_block_q
 
 
 # ---------------------------------------------------------------------
@@ -925,6 +1133,12 @@ class PagedEngine(Engine):
                 f"largest compiled bucket "
                 f"{max(serve_cfg.prefill_buckets)}"
             )
+        if paged.kv_quant == "int8" and serve_cfg.cache_dtype is not None:
+            raise ValueError(
+                "kv_quant='int8' fixes the pool storage dtype; drop "
+                f"cache_dtype={serve_cfg.cache_dtype!r} (the scale "
+                "side arrays are always f32)"
+            )
         per_seq = serve_cfg.max_seq_len // bs
         # A pool SMALLER than one full-capacity sequence is legal --
         # it simply cannot serve max-length requests, and
@@ -932,6 +1146,10 @@ class PagedEngine(Engine):
         # page-budget error (the whole point of paging is that HBM no
         # longer has to be provisioned for worst-case length).
         self.paged = paged
+        # Read by the loadgen cost model and the bench metric-family
+        # suffixing; mirrors paged_summary()'s kv_kernel / kv_quant.
+        self.kv_kernel = paged.kernel
+        self.kv_quant = paged.kv_quant
         self.max_blocks_per_seq = per_seq
         # Table rows carry extra scratch entries past capacity so a
         # bucket-padded chunk write at the capacity edge stays
@@ -1006,6 +1224,47 @@ class PagedEngine(Engine):
     def _cache_pspec(self) -> P:
         return paged_kv_cache_pspec(self.mesh, self.cfg.kv_heads)
 
+    def _init_cache(self) -> None:
+        """int8 pools override the slab allocation: int8 payload pages
+        plus replicated f32 per-page scale side arrays
+        ``[n_layers, num_blocks]`` for K and V (scales are scalars per
+        page -- sharding them would turn every page write into a
+        collective for 4 bytes). ``cache_bytes`` counts both, which is
+        what makes the fit-report capacity claim honest."""
+        if getattr(self.paged, "kv_quant", "none") != "int8":
+            super()._init_cache()
+            self.k_scales = self.v_scales = None
+            return
+        shape = self._cache_shape()
+        sc_shape = (self.cfg.n_layers, self.paged.num_blocks)
+        self._cache_sharding = NamedSharding(
+            self.mesh, self._cache_pspec()
+        )
+        alloc = jax.jit(
+            lambda: (
+                jnp.zeros(shape, jnp.int8),
+                jnp.zeros(shape, jnp.int8),
+                # Floor, not zero: a never-written page must
+                # dequantize to exact zeros without a 0/0 hazard on
+                # the requantize round trip.
+                jnp.full(sc_shape, INT8_SCALE_FLOOR, jnp.float32),
+                jnp.full(sc_shape, INT8_SCALE_FLOOR, jnp.float32),
+            ),
+            out_shardings=(
+                self._cache_sharding, self._cache_sharding,
+                self._rep, self._rep,
+            ),
+        )
+        self.ks, self.vs, self.k_scales, self.v_scales = alloc()
+        self.cache_bytes = (
+            2 * int(np.prod(shape)) + 2 * int(np.prod(sc_shape)) * 4
+        )
+
+    def _scale_abstract(self):
+        return jax.ShapeDtypeStruct(
+            self.k_scales.shape, self.k_scales.dtype, sharding=self._rep
+        )
+
     # -- executable table ----------------------------------------------
     def _build(self, key):
         self.compile_count += 1
@@ -1028,11 +1287,22 @@ class PagedEngine(Engine):
         )
         scalar = jax.ShapeDtypeStruct((), jnp.int32, sharding=self._rep)
         slots = self.serve_cfg.slots
+        quant = self.paged.kv_quant == "int8"
+        # int8 mode threads the f32 scale side arrays through every
+        # paged program: (ks, vs) becomes (ks, vs, ksc, vsc) in both
+        # args and results, all engine-resident and donated.
+        state = (cache, cache) + (
+            (self._scale_abstract(), self._scale_abstract())
+            if quant else ()
+        )
+        state_shardings = (self._cache_sharding, self._cache_sharding) \
+            + ((self._rep, self._rep) if quant else ())
         if key[0] == "prefill":
             bucket = key[1]
             fn = make_chunk_prefill_fn(
                 self.cfg, bucket, self.paged.block_size,
                 self.max_blocks_per_seq, self.table_width,
+                kernel=self.paged.kernel, kv_quant=self.paged.kv_quant,
             )
             tokens = jax.ShapeDtypeStruct(
                 (1, bucket), jnp.int32, sharding=self._rep
@@ -1040,12 +1310,13 @@ class PagedEngine(Engine):
             table = jax.ShapeDtypeStruct(
                 (self.table_width,), jnp.int32, sharding=self._rep
             )
-            args = (params_abs, cache, cache, tokens, scalar, scalar,
-                    table)
+            args = (params_abs,) + state + (tokens, scalar, scalar,
+                                            table)
         elif key[0] == "decode":
             fn = make_paged_decode_fn(
                 self.cfg, self.paged.block_size,
                 self.max_blocks_per_seq, self.table_width,
+                kernel=self.paged.kernel, kv_quant=self.paged.kv_quant,
             )
             vec = jax.ShapeDtypeStruct(
                 (slots,), jnp.int32, sharding=self._rep
@@ -1053,23 +1324,19 @@ class PagedEngine(Engine):
             tables = jax.ShapeDtypeStruct(
                 (slots, self.table_width), jnp.int32, sharding=self._rep
             )
-            args = (params_abs, cache, cache, vec, vec, tables, vec)
+            args = (params_abs,) + state + (vec, vec, tables, vec)
         else:  # ("copy_block",)
-            fn = make_copy_block_fn()
+            fn = make_copy_block_fn(kv_quant=self.paged.kv_quant)
             jitted = jax.jit(
                 fn,
-                donate_argnums=(0, 1),
-                out_shardings=(
-                    self._cache_sharding, self._cache_sharding
-                ),
+                donate_argnums=tuple(range(len(state))),
+                out_shardings=state_shardings,
             )
-            return jitted.lower(cache, cache, scalar, scalar).compile()
+            return jitted.lower(*state, scalar, scalar).compile()
         jitted = jax.jit(
             fn,
-            donate_argnums=(1, 2),
-            out_shardings=(
-                self._cache_sharding, self._cache_sharding, self._rep
-            ),
+            donate_argnums=tuple(range(1, 1 + len(state))),
+            out_shardings=state_shardings + (self._rep,),
         )
         return jitted.lower(*args).compile()
 
@@ -1357,8 +1624,11 @@ class PagedEngine(Engine):
         start, run, bucket = st.plan[st.next_chunk]
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :run] = st.prompt[start:start + run]
-        args = [
-            self.params, self.ks, self.vs,
+        quant = self.paged.kv_quant == "int8"
+        state = [self.ks, self.vs] + (
+            [self.k_scales, self.v_scales] if quant else []
+        )
+        args = [self.params, *state,
             self._rep_arr(padded), self._rep_arr(start),
             self._rep_arr(run),
             self._rep_arr(self._tables[slot]),
@@ -1377,7 +1647,11 @@ class PagedEngine(Engine):
         else:
             exec_ = self._get_exec(("prefill", bucket))
         with span("prefill", hist="serve_prefill_s", n=bucket):
-            self.ks, self.vs, tok = exec_(*args)
+            if quant:
+                (self.ks, self.vs, self.k_scales, self.v_scales,
+                 tok) = exec_(*args)
+            else:
+                self.ks, self.vs, tok = exec_(*args)
             st.next_chunk += 1
             st.forwarded += bucket
             self.prefill_forwarded_total += bucket
@@ -1410,10 +1684,16 @@ class PagedEngine(Engine):
         new, copied = self.allocator.cow(blk)
         if copied:
             exec_ = self._get_exec(("copy_block",))
-            self.ks, self.vs = exec_(
-                self.ks, self.vs, self._rep_arr(blk),
-                self._rep_arr(new),
-            )
+            if self.paged.kv_quant == "int8":
+                self.ks, self.vs, self.k_scales, self.v_scales = exec_(
+                    self.ks, self.vs, self.k_scales, self.v_scales,
+                    self._rep_arr(blk), self._rep_arr(new),
+                )
+            else:
+                self.ks, self.vs = exec_(
+                    self.ks, self.vs, self._rep_arr(blk),
+                    self._rep_arr(new),
+                )
             st.blocks[idx] = new
             self._write_table(slot, st.blocks)
             self.paged_stats["cow_copies"] += 1
@@ -1437,14 +1717,23 @@ class PagedEngine(Engine):
             if is_on and s in self._slot_state:
                 self._cow_write_target(s, int(pos))
         exec_ = self._get_exec(("decode",))
+        quant = self.paged.kv_quant == "int8"
+        state = [self.ks, self.vs] + (
+            [self.k_scales, self.v_scales] if quant else []
+        )
         with span("decode", hist="serve_decode_s"):
-            self.ks, self.vs, toks = exec_(
-                self.params, self.ks, self.vs,
+            out = exec_(
+                self.params, *state,
                 self._rep_arr(np.asarray(tokens, np.int32)),
                 self._rep_arr(np.asarray(positions, np.int32)),
                 self._tables_device(),
                 self._rep_arr(np.asarray(active, np.int32)),
             )
+            if quant:
+                (self.ks, self.vs, self.k_scales, self.v_scales,
+                 toks) = out
+            else:
+                self.ks, self.vs, toks = out
             return np.asarray(toks)
 
     def release(self, slot: int) -> None:
@@ -1530,6 +1819,8 @@ class PagedEngine(Engine):
         lookups = s["prefix_lookups"]
         return {
             "kv_layout": "paged",
+            "kv_kernel": self.paged.kernel,
+            "kv_quant": self.paged.kv_quant,
             "kv_block_size": self.paged.block_size,
             "kv_blocks": self.paged.num_blocks,
             "kv_blocks_usable": self.paged.usable_blocks,

@@ -604,6 +604,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "the largest bucket are servable); requires --paged",
     )
     ap.add_argument(
+        "--kv-kernel", choices=("gather", "pallas"), default=None,
+        help="paged attention read path "
+        "(tpu_hpc.kernels.paged_attention): 'gather' materializes "
+        "each slot's pages with a take() before a dense flash call "
+        "(the oracle path), 'pallas' walks the block table inside "
+        "the kernel -- one HBM read per page, no gathered copy "
+        "(interpreted on CPU); token-exact vs gather under greedy; "
+        "requires --paged",
+    )
+    ap.add_argument(
+        "--kv-quant", choices=("none", "int8"), default=None,
+        help="KV page storage dtype: 'int8' stores pages quantized "
+        "per page with a float32 scale side array -- half the bytes "
+        "per token, ~2x resident context at equal HBM (size it with "
+        "python -m tpu_hpc.checks.fit --kv-quant int8); logits "
+        "drift within the pinned tolerance (tests/"
+        "test_paged_kernels.py); requires --paged",
+    )
+    ap.add_argument(
         "--spec", choices=("off", "draft", "ngram"), default="off",
         help="speculative decoding (serve/spec.py; requires --paged): "
         "'draft' drafts k tokens with a small draft model "
@@ -760,6 +779,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--kv-blocks", args.kv_blocks),
             ("--kv-host-blocks", args.kv_host_blocks),
             ("--prefill-chunk", args.prefill_chunk),
+            ("--kv-kernel", args.kv_kernel),
+            ("--kv-quant", args.kv_quant),
         ):
             if val is not None:
                 ap.error(
@@ -783,6 +804,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "--spec is not consumed by --disagg (the verify program "
             "is a single-mesh paged program; the decode tier would "
             "silently run greedy)"
+        )
+    if args.spec != "off" and args.kv_quant == "int8":
+        ap.error(
+            "--spec is not consumed with --kv-quant int8 (verify "
+            "replays drafted positions against pages the draft loop "
+            "already requantized -- the accept/reject decision would "
+            "drift from the greedy oracle)"
         )
     if args.spec == "off":
         for flag, val in (
@@ -927,6 +955,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 prefill_chunk=args.prefill_chunk,
                 align_capacity=args.max_seq_len is None,
                 host_blocks=args.kv_host_blocks,
+                kernel=args.kv_kernel,
+                kv_quant=args.kv_quant,
             )
         except ValueError as e:
             ap.error(str(e))

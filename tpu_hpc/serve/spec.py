@@ -577,6 +577,14 @@ class SpecRunner:
                 f"{max(engine.serve_cfg.prefill_buckets)} (the verify "
                 "write window must fit the table's scratch slack)"
             )
+        if getattr(engine.paged, "kv_quant", "none") != "none":
+            raise ValueError(
+                "speculative decoding on a quantized KV pool is not "
+                "supported: the verify window's multi-token rewrites "
+                "would requantize shared pages per candidate (and the "
+                "mirrored draft pool would need its own scale "
+                "arrays); serve int8 pools with plain greedy decode"
+            )
         if engine._execs:
             # Attaching to an already-warmed engine would leave the
             # spec programs to lazy-compile mid-traffic -- a latency
@@ -744,6 +752,7 @@ class SpecRunner:
         inner = make_chunk_logits_fn(
             engine.cfg, bucket, engine.paged.block_size,
             engine.max_blocks_per_seq, engine.table_width,
+            kernel=engine.paged.kernel,
         )
 
         def spec_prefill(params, ks, vs, tokens, start, true_len,

@@ -79,6 +79,21 @@ class HostTier:
         self._host_k = np.zeros(shape, dtype)
         self._host_v = np.zeros(shape, dtype)
         self.host_bytes = int(self._host_k.nbytes + self._host_v.nbytes)
+        # int8 pools: a page is its bytes PLUS its f32 scale -- a
+        # spilled page that came back without its scale would
+        # dequantize to garbage, so the scale rows ride every hop in
+        # mirrored host side arrays.
+        self._quant = (
+            getattr(engine.paged, "kv_quant", "none") == "int8"
+        )
+        self._host_ksc = self._host_vsc = None
+        if self._quant:
+            sc_shape = (c.n_layers, self.host_blocks)
+            self._host_ksc = np.zeros(sc_shape, np.float32)
+            self._host_vsc = np.zeros(sc_shape, np.float32)
+            self.host_bytes += int(
+                self._host_ksc.nbytes + self._host_vsc.nbytes
+            )
         # One page's K (or V) leaf: the transfer-group unit.
         self._page_bytes = int(
             c.n_layers * bs * c.kv_heads * c.head_dim * dtype.itemsize
@@ -148,6 +163,23 @@ class HostTier:
             (self.group,), jnp.int32, sharding=eng._rep
         )
 
+        if self._quant:
+            sc = eng._scale_abstract()
+
+            def gather_q(ks, vs, ksc, vsc, page_ids):
+                return (
+                    ks[:, page_ids], vs[:, page_ids],
+                    ksc[:, page_ids], vsc[:, page_ids],
+                )
+
+            return jax.jit(
+                gather_q,
+                out_shardings=(
+                    self._rows_sharding, self._rows_sharding,
+                    eng._rep, eng._rep,
+                ),
+            ).lower(cache, cache, sc, sc, ids).compile()
+
         def gather(ks, vs, page_ids):
             return ks[:, page_ids], vs[:, page_ids]
 
@@ -165,6 +197,33 @@ class HostTier:
         rows = jax.ShapeDtypeStruct(
             self._rows_shape, eng.ks.dtype, sharding=self._rows_sharding
         )
+
+        if self._quant:
+            sc = eng._scale_abstract()
+            sc_rows = jax.ShapeDtypeStruct(
+                (eng.cfg.n_layers, self.group), jnp.float32,
+                sharding=eng._rep,
+            )
+
+            def scatter_q(ks, vs, ksc, vsc, k_rows, v_rows, ksc_rows,
+                          vsc_rows, page_ids):
+                return (
+                    ks.at[:, page_ids].set(k_rows),
+                    vs.at[:, page_ids].set(v_rows),
+                    ksc.at[:, page_ids].set(ksc_rows),
+                    vsc.at[:, page_ids].set(vsc_rows),
+                )
+
+            return jax.jit(
+                scatter_q,
+                donate_argnums=(0, 1, 2, 3),
+                out_shardings=(
+                    eng._cache_sharding, eng._cache_sharding,
+                    eng._rep, eng._rep,
+                ),
+            ).lower(
+                cache, cache, sc, sc, rows, rows, sc_rows, sc_rows, ids
+            ).compile()
 
         def scatter(ks, vs, k_rows, v_rows, page_ids):
             return (
@@ -206,13 +265,26 @@ class HostTier:
         eng = self.engine
         n = len(blocks)
         ex = eng._get_exec(("spill_gather",))
-        k, v = ex(eng.ks, eng.vs, eng._rep_arr(self._pad_ids(blocks)))
+        ids = eng._rep_arr(self._pad_ids(blocks))
+        if self._quant:
+            k, v, ksc, vsc = ex(
+                eng.ks, eng.vs, eng.k_scales, eng.v_scales, ids
+            )
+            ksc_np, vsc_np = jax.device_get((ksc, vsc))
+            self._host_ksc[:, list(slots)] = ksc_np[:, :n]
+            self._host_vsc[:, list(slots)] = vsc_np[:, :n]
+        else:
+            k, v = ex(eng.ks, eng.vs, ids)
+            ksc = vsc = None
         # device_get blocks until the rows are host-side -- the same
         # dispatch-to-result bracketing every hop timer relies on.
         k_np, v_np = jax.device_get((k, v))
         self._host_k[:, list(slots)] = k_np[:, :n]
         self._host_v[:, list(slots)] = v_np[:, :n]
-        return int(k.nbytes + v.nbytes)
+        nbytes = int(k.nbytes + v.nbytes)
+        if self._quant:
+            nbytes += int(ksc.nbytes + vsc.nbytes)
+        return nbytes
 
     def _move_in(
         self, slots: Sequence[int], blocks: Sequence[int]
@@ -228,13 +300,29 @@ class HostTier:
         k_dev = jax.device_put(k_np, self._rows_sharding)
         v_dev = jax.device_put(v_np, self._rows_sharding)
         ex = eng._get_exec(("refill_scatter",))
-        eng.ks, eng.vs = ex(
-            eng.ks, eng.vs, k_dev, v_dev,
-            eng._rep_arr(self._pad_ids(blocks)),
-        )
+        ids = eng._rep_arr(self._pad_ids(blocks))
+        nbytes = int(k_dev.nbytes + v_dev.nbytes)
+        if self._quant:
+            sc_shape = (eng.cfg.n_layers, self.group)
+            # Padding lanes write scale 0 over page 0's entry -- safe:
+            # scale is only ever multiplied on read, and the decode
+            # requantize floors its fresh scale (INT8_SCALE_FLOOR).
+            ksc_np = np.zeros(sc_shape, np.float32)
+            vsc_np = np.zeros(sc_shape, np.float32)
+            ksc_np[:, :n] = self._host_ksc[:, list(slots)]
+            vsc_np[:, :n] = self._host_vsc[:, list(slots)]
+            ksc_dev = jax.device_put(ksc_np, eng._rep)
+            vsc_dev = jax.device_put(vsc_np, eng._rep)
+            eng.ks, eng.vs, eng.k_scales, eng.v_scales = ex(
+                eng.ks, eng.vs, eng.k_scales, eng.v_scales,
+                k_dev, v_dev, ksc_dev, vsc_dev, ids,
+            )
+            nbytes += int(ksc_dev.nbytes + vsc_dev.nbytes)
+        else:
+            eng.ks, eng.vs = ex(eng.ks, eng.vs, k_dev, v_dev, ids)
         eng.ks.block_until_ready()
         eng.vs.block_until_ready()
-        return int(k_dev.nbytes + v_dev.nbytes)
+        return nbytes
 
     # -- tier operations -----------------------------------------------
     def spill_parked(self, n_needed: int) -> int:
